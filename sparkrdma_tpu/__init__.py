@@ -1,0 +1,39 @@
+"""sparkrdma_tpu: a TPU-native shuffle framework.
+
+A ground-up re-design of the capabilities of Mellanox/SparkRDMA (a drop-in
+Spark ``ShuffleManager`` that replaces the TCP shuffle fetch path with
+one-sided RDMA READ over InfiniBand/RoCE) for TPU hardware:
+
+* The data plane — the reference's M×R matrix of one-sided RDMA READs
+  (reference: scala/RdmaShuffleFetcherIterator.scala:171-180) — becomes an XLA
+  **ragged all-to-all over ICI** (`jax.lax.ragged_all_to_all` inside
+  `shard_map` over a `jax.sharding.Mesh`), preceded by a dense int32
+  size-exchange that replaces the reference's three-level metadata READ
+  scheme (reference: scala/RdmaShuffleManager.scala:341-418).
+* The registered-memory layer — pinned, pre-registered MR pools behind
+  libdisni (reference: java/RdmaBufferManager.java, java/RdmaBuffer.java) —
+  becomes an HBM/host arena pool with power-of-two bins, preallocation and
+  LRU trim, backed by a C++ shim (``csrc/``) with a pure-Python fallback.
+* The transport bootstrap — RDMA-CM + SEND/RECV hello/announce RPCs
+  (reference: java/RdmaNode.java, scala/RdmaRpcMsg.scala) — becomes a small
+  host-side TCP control plane (hello/announce membership, driver-hosted
+  map-output table), since control traffic in the reference is two messages
+  plus 12-byte writes and is latency-tolerant.
+* The engine-facing API keeps the reference's shape — Manager / Reader /
+  Writer / Resolver (reference: scala/RdmaShuffleManager.scala:143-310) — so
+  an engine switches shuffle implementations with one config line.
+
+Subpackages
+-----------
+``config``    typed, range-validated configuration (RdmaShuffleConf equiv).
+``utils``     ids, binary codecs, histograms, logging.
+``runtime``   device/host buffer pools and spill staging (L1 equiv, C++-backed).
+``parallel``  mesh endpoints, control RPC, ragged exchange (L2/L3 equiv).
+``ops``       TPU kernels: partitioning, sorting, ragged collectives (data plane).
+``shuffle``   engine-facing Manager/Reader/Writer/Resolver (L5/L4 equiv).
+``models``    end-to-end workloads: TeraSort, PageRank, ALS, joins.
+"""
+
+__version__ = "0.1.0"
+
+from sparkrdma_tpu.config import TpuShuffleConf  # noqa: F401

@@ -1,0 +1,199 @@
+"""Typed, range-validated configuration.
+
+TPU-native re-design of the reference's ``RdmaShuffleConf``
+(scala/RdmaShuffleConf.scala:36-142): every key lives under one prefix,
+values are parsed with type + range validation and fall back to defaults on
+any invalid input rather than raising (scala/RdmaShuffleConf.scala:36-47).
+
+Keys that only make sense for verbs hardware (queue-pair depths, ODP, CPU
+vectors) are re-interpreted for their TPU-native analogue where one exists
+and dropped where none does; TPU-specific knobs (mesh axis, exchange chunk
+bytes, staging concurrency) are added.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional
+
+PREFIX = "spark.shuffle.tpu."
+
+_SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kmgtp]?)b?\s*$", re.IGNORECASE)
+_SIZE_MULT = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40, "p": 1 << 50}
+
+
+def parse_bytes(value: Any) -> int:
+    """Parse a byte-size string like ``'8m'``/``'256k'``/``'10g'`` to bytes.
+
+    Mirrors the JVM-style size strings the reference accepts via
+    ``getSizeAsBytes`` (scala/RdmaShuffleConf.scala:44-47).
+    """
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return int(value)
+    m = _SIZE_RE.match(str(value))
+    if not m:
+        raise ValueError(f"cannot parse byte size: {value!r}")
+    return int(float(m.group(1)) * _SIZE_MULT[m.group(2).lower()])
+
+
+def format_bytes(n: int) -> str:
+    for unit, shift in (("t", 40), ("g", 30), ("m", 20), ("k", 10)):
+        if n >= (1 << shift) and n % (1 << shift) == 0:
+            return f"{n >> shift}{unit}"
+    return str(n)
+
+
+@dataclass
+class _Key:
+    name: str
+    default: Any
+    kind: str  # "int" | "bytes" | "bool" | "str" | "float"
+    min: Optional[float] = None
+    max: Optional[float] = None
+    doc: str = ""
+
+
+# Full key set. Reference key-for-key parity is documented per entry
+# (scala/RdmaShuffleConf.scala:61-142); TPU-only keys say so.
+_KEYS = [
+    # --- exchange / data-plane sizing (reference: write/read block sizes, 107-111)
+    _Key("shuffle_write_block_size", "8m", "bytes", 4096, 1 << 34,
+         doc="Partition-aligned staging chunk size (ref shuffleWriteBlockSize=8m)."),
+    _Key("shuffle_read_block_size", "256k", "bytes", 1024, 1 << 34,
+         doc="Max bytes fetched by one grouped read (ref shuffleReadBlockSize=256k)."),
+    _Key("max_bytes_in_flight", "48m", "bytes", 1 << 16, 1 << 40,
+         doc="Bound on outstanding fetched-but-unconsumed bytes (ref maxBytesInFlight=48m)."),
+    _Key("exchange_chunk_bytes", "64m", "bytes", 1 << 16, 1 << 34,
+         doc="TPU-only: max per-device payload bytes per ragged all-to-all round."),
+    _Key("exchange_row_bytes", 16, "int", 1, 4096,
+         doc="TPU-only: record row stride in bytes for on-device exchange buffers."),
+    # --- buffer pool (reference: RdmaBufferManager, maxBufferAllocationSize 97-99)
+    _Key("max_buffer_allocation_size", "10g", "bytes", 1 << 20, 1 << 44,
+         doc="Pool high-water mark before LRU trim (ref maxBufferAllocationSize=10g)."),
+    _Key("prealloc_buffers", "", "str",
+         doc="'size:count,size:count' eager pool carve-up (ref preAllocateBuffers)."),
+    _Key("min_block_size", "16k", "bytes", 256, 1 << 30,
+         doc="Smallest pool bin; sizes round up to pow2 of at least this "
+             "(ref RdmaBufferManager.java:93 MIN_BLOCK_SIZE=16k)."),
+    # --- flow control (reference: recv/send queue depths, swFlowControl 61-68)
+    _Key("send_queue_depth", 4096, "int", 16, 1 << 20,
+         doc="Outstanding async fetch budget per peer (ref sendQueueDepth=4096)."),
+    _Key("recv_queue_depth", 256, "int", 4, 1 << 16,
+         doc="Control-plane inflight message budget (ref recvQueueDepth=256)."),
+    _Key("rpc_msg_size", "4k", "bytes", 256, 1 << 24,
+         doc="Control RPC segment size (ref recvWrSize=4k)."),
+    _Key("sw_flow_control", True, "bool",
+         doc="Enable credit-based backpressure on the control plane (ref swFlowControl)."),
+    # --- control plane endpooints (reference: driverHost/Port, executorPort 124-131)
+    _Key("driver_host", "", "str", doc="Control-plane driver bind host."),
+    _Key("driver_port", 0, "int", 0, 65535, doc="Control-plane driver port (0=ephemeral)."),
+    _Key("executor_port", 0, "int", 0, 65535, doc="Executor control port (0=ephemeral)."),
+    _Key("port_max_retries", 16, "int", 1, 1024, doc="Bind retry budget (ref portMaxRetries=16)."),
+    _Key("connect_timeout_ms", 20000, "int", 1, 3600_000,
+         doc="Per-attempt connect/event timeout (ref rdmaCmEventTimeout=20000)."),
+    _Key("max_connection_attempts", 5, "int", 1, 100,
+         doc="Connection retry budget (ref maxConnectionAttempts=5)."),
+    _Key("teardown_timeout_ms", 50, "int", 1, 60000,
+         doc="Listener join timeout at stop (ref teardownListenTimeout=50)."),
+    _Key("partition_location_fetch_timeout_ms", 120000, "int", 1, 3600_000,
+         doc="Timeout awaiting map-output locations (ref partitionLocationFetchTimeout)."),
+    # --- observability (reference: stats keys 114-123, 133-141)
+    _Key("collect_shuffle_reader_stats", False, "bool",
+         doc="Collect per-remote fetch-latency histograms (ref collectShuffleReaderStats)."),
+    _Key("fetch_time_bucket_size_ms", 300, "int", 1, 60000,
+         doc="Histogram bucket width (ref fetchTimeBucketSizeInMs=300)."),
+    _Key("fetch_time_num_buckets", 5, "int", 1, 1000,
+         doc="Histogram bucket count (ref fetchTimeNumBuckets=5)."),
+    # --- TPU-only: mesh / staging
+    _Key("mesh_axis_name", "shuffle", "str", doc="TPU-only: mesh axis for the exchange."),
+    _Key("staging_threads", 4, "int", 1, 256,
+         doc="TPU-only: host threads for spill-file gather into staging buffers."),
+    _Key("use_cpp_runtime", True, "bool",
+         doc="TPU-only: use the C++ arena/staging shim when built; else pure-Python."),
+]
+
+_KEY_MAP: Dict[str, _Key] = {k.name: k for k in _KEYS}
+
+
+class TpuShuffleConf:
+    """Range-validated view over a flat string config map.
+
+    Like the reference (scala/RdmaShuffleConf.scala:36-47), invalid values
+    never raise at read time: they log-and-default. Unknown keys under the
+    prefix are ignored.
+    """
+
+    def __init__(self, conf: Optional[Mapping[str, Any]] = None, **overrides: Any):
+        self._raw: Dict[str, Any] = {}
+        for src in (conf or {}), overrides:
+            for key, value in src.items():
+                name = key[len(PREFIX):] if key.startswith(PREFIX) else key
+                name = name.replace(".", "_")
+                self._raw[name] = value
+        self._cache: Dict[str, Any] = {}
+
+    def _get(self, name: str) -> Any:
+        if name in self._cache:
+            return self._cache[name]
+        spec = _KEY_MAP[name]
+        raw = self._raw.get(name, spec.default)
+        try:
+            if spec.kind == "bytes":
+                val = parse_bytes(raw)
+            elif spec.kind == "int":
+                val = int(raw)
+            elif spec.kind == "float":
+                val = float(raw)
+            elif spec.kind == "bool":
+                val = raw if isinstance(raw, bool) else str(raw).strip().lower() in ("1", "true", "yes", "on")
+            else:
+                val = str(raw)
+            if spec.kind in ("bytes", "int", "float"):
+                if (spec.min is not None and val < spec.min) or (spec.max is not None and val > spec.max):
+                    raise ValueError(f"{val} out of [{spec.min}, {spec.max}]")
+        except (ValueError, TypeError):
+            # Fall back to the validated default, reference behavior
+            # (scala/RdmaShuffleConf.scala:36-47).
+            val = parse_bytes(spec.default) if spec.kind == "bytes" else spec.default
+        self._cache[name] = val
+        return val
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in _KEY_MAP:
+            return self._get(name)
+        raise AttributeError(f"unknown config key: {name}")
+
+    def prealloc_spec(self) -> Dict[int, int]:
+        """Parse 'size:count,size:count' into {bytes: count}.
+
+        Reference: preAllocateBuffers parsing (scala/RdmaShuffleConf.scala:100-106,
+        consumed at scala/RdmaShuffleManager.scala:227-231).
+        """
+        spec: Dict[int, int] = {}
+        text = self.prealloc_buffers.strip()
+        if not text:
+            return spec
+        for part in text.split(","):
+            try:
+                size_s, count_s = part.split(":")
+                size, count = parse_bytes(size_s), int(count_s)
+                if size > 0 and count > 0:
+                    spec[size] = spec.get(size, 0) + count
+            except ValueError:
+                continue
+        return spec
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k.name: self._get(k.name) for k in _KEYS}
+
+    @staticmethod
+    def keys() -> Dict[str, str]:
+        """name -> one-line doc, for help output."""
+        return {k.name: k.doc for k in _KEYS}
+
+    def __repr__(self) -> str:
+        shown = {k: v for k, v in self.to_dict().items() if k in self._raw}
+        return f"TpuShuffleConf({shown})"

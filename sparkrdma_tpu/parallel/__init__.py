@@ -1,0 +1,8 @@
+from sparkrdma_tpu.parallel.rpc_msg import (  # noqa: F401
+    AnnounceMsg,
+    HelloMsg,
+    RpcMsg,
+    decode_message,
+    segments,
+    Reassembler,
+)

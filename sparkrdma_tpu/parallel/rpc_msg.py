@@ -1,0 +1,142 @@
+"""Control-plane RPC message framing.
+
+Re-design of the reference's ``RdmaRpcMsg`` (scala/RdmaRpcMsg.scala): a tiny
+self-describing frame — ``[total_length:4][msg_type:4][payload]`` — chopped
+into fixed-size segments so each segment fits one pre-posted receive buffer
+(scala/RdmaRpcMsg.scala:40-58: segments of ``recvWrSize``). The reference
+needs segmentation because RDMA RECV buffers are fixed-size; we keep it as
+the flow-control accounting unit (credits are per segment) and as the wire
+format for datagram-ish transports, while the TCP transport can also write a
+frame contiguously.
+
+The reference defines exactly two message types — Hello (executor→driver,
+scala/RdmaRpcMsg.scala:81-112) and Announce (driver→all, 114-173). The TPU
+control plane adds table/location/publish messages in
+``sparkrdma_tpu.parallel.rpc`` via the same registry.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import ClassVar, Dict, Iterator, List, Optional, Type
+
+from sparkrdma_tpu.utils.ids import ShuffleManagerId
+
+HEADER = struct.Struct("<II")  # (total_length incl. header, msg_type)
+
+_REGISTRY: Dict[int, Type["RpcMsg"]] = {}
+
+
+def register(msg_type: int):
+    def deco(cls: Type["RpcMsg"]):
+        if msg_type in _REGISTRY:
+            raise ValueError(f"duplicate msg_type {msg_type}")
+        cls.MSG_TYPE = msg_type
+        _REGISTRY[msg_type] = cls
+        return cls
+    return deco
+
+
+class RpcMsg:
+    """Base frame. Subclasses implement payload (de)serialization."""
+
+    MSG_TYPE: ClassVar[int] = -1
+
+    def payload(self) -> bytes:
+        raise NotImplementedError
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "RpcMsg":
+        raise NotImplementedError
+
+    def encode(self) -> bytes:
+        body = self.payload()
+        return HEADER.pack(HEADER.size + len(body), self.MSG_TYPE) + body
+
+
+def decode_message(frame: bytes) -> RpcMsg:
+    """Decode one complete frame (scala/RdmaRpcMsg.scala:64-78)."""
+    total, msg_type = HEADER.unpack_from(frame, 0)
+    if total != len(frame):
+        raise ValueError(f"frame length mismatch: header={total} actual={len(frame)}")
+    cls = _REGISTRY.get(msg_type)
+    if cls is None:
+        raise ValueError(f"unknown msg_type {msg_type}")
+    return cls.from_payload(frame[HEADER.size:total])
+
+
+def segments(frame: bytes, seg_size: int) -> List[bytes]:
+    """Chop an encoded frame into ≤seg_size chunks
+    (scala/RdmaRpcMsg.scala:42-58)."""
+    if seg_size < HEADER.size + 1:
+        raise ValueError("segment size too small")
+    return [frame[i:i + seg_size] for i in range(0, len(frame), seg_size)]
+
+
+class Reassembler:
+    """Streaming decoder: feed arbitrary chunks, yields complete messages.
+
+    Covers both the segmented path and a TCP byte stream.
+    """
+
+    def __init__(self, max_frame: int = 1 << 30):
+        self._buf = bytearray()
+        self._max_frame = max_frame
+
+    def feed(self, chunk: bytes) -> Iterator[RpcMsg]:
+        self._buf.extend(chunk)
+        while len(self._buf) >= HEADER.size:
+            total, _ = HEADER.unpack_from(self._buf, 0)
+            if total < HEADER.size or total > self._max_frame:
+                raise ValueError(f"bad frame length {total}")
+            if len(self._buf) < total:
+                return
+            frame = bytes(self._buf[:total])
+            del self._buf[:total]
+            yield decode_message(frame)
+
+
+@register(1)
+class HelloMsg(RpcMsg):
+    """Executor → driver introduction (scala/RdmaRpcMsg.scala:81-112)."""
+
+    def __init__(self, manager_id: ShuffleManagerId):
+        self.manager_id = manager_id
+
+    def payload(self) -> bytes:
+        return self.manager_id.serialize()
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "HelloMsg":
+        mid, _ = ShuffleManagerId.deserialize(payload)
+        return cls(mid)
+
+    def __eq__(self, other):
+        return isinstance(other, HelloMsg) and self.manager_id == other.manager_id
+
+
+@register(2)
+class AnnounceMsg(RpcMsg):
+    """Driver → all executors membership broadcast
+    (scala/RdmaRpcMsg.scala:114-173)."""
+
+    def __init__(self, manager_ids: List[ShuffleManagerId]):
+        self.manager_ids = list(manager_ids)
+
+    def payload(self) -> bytes:
+        out = [struct.pack("<I", len(self.manager_ids))]
+        out += [m.serialize() for m in self.manager_ids]
+        return b"".join(out)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "AnnounceMsg":
+        (n,) = struct.unpack_from("<I", payload, 0)
+        off = 4
+        ids = []
+        for _ in range(n):
+            mid, off = ShuffleManagerId.deserialize(payload, off)
+            ids.append(mid)
+        return cls(ids)
+
+    def __eq__(self, other):
+        return isinstance(other, AnnounceMsg) and self.manager_ids == other.manager_ids

@@ -1,0 +1,7 @@
+from sparkrdma_tpu.shuffle.map_output import (  # noqa: F401
+    BlockLocation,
+    DriverTable,
+    MapTaskOutput,
+    ENTRY_SIZE,
+    MAP_ENTRY_SIZE,
+)

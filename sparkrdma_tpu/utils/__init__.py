@@ -1,0 +1,1 @@
+from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId, BlockId  # noqa: F401

@@ -1,0 +1,79 @@
+"""Config parsing/validation tests (reference behavior:
+scala/RdmaShuffleConf.scala:36-47 — invalid values fall back to defaults)."""
+
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf, parse_bytes, format_bytes
+
+
+def test_parse_bytes():
+    assert parse_bytes("8m") == 8 << 20
+    assert parse_bytes("256k") == 256 << 10
+    assert parse_bytes("10g") == 10 << 30
+    assert parse_bytes("4K") == 4096
+    assert parse_bytes(1234) == 1234
+    assert parse_bytes("1.5k") == 1536
+    with pytest.raises(ValueError):
+        parse_bytes("abc")
+
+
+def test_format_bytes_roundtrip():
+    for s in ("8m", "256k", "10g", "16k"):
+        assert format_bytes(parse_bytes(s)) == s
+
+
+def test_defaults():
+    c = TpuShuffleConf()
+    assert c.shuffle_write_block_size == 8 << 20
+    assert c.shuffle_read_block_size == 256 << 10
+    assert c.max_bytes_in_flight == 48 << 20
+    assert c.send_queue_depth == 4096
+    assert c.recv_queue_depth == 256
+    assert c.rpc_msg_size == 4096
+    assert c.max_buffer_allocation_size == 10 << 30
+    assert c.port_max_retries == 16
+    assert c.max_connection_attempts == 5
+    assert c.fetch_time_bucket_size_ms == 300
+    assert c.fetch_time_num_buckets == 5
+    assert c.sw_flow_control is True
+    assert c.collect_shuffle_reader_stats is False
+
+
+def test_prefixed_and_override_keys():
+    c = TpuShuffleConf({"spark.shuffle.tpu.shuffle_read_block_size": "1m"},
+                       max_bytes_in_flight="96m")
+    assert c.shuffle_read_block_size == 1 << 20
+    assert c.max_bytes_in_flight == 96 << 20
+    # dotted key form also accepted
+    c2 = TpuShuffleConf({"spark.shuffle.tpu.shuffle.read.block.size": "2m"})
+    assert c2.shuffle_read_block_size == 2 << 20
+
+
+def test_invalid_falls_back_to_default():
+    c = TpuShuffleConf(shuffle_read_block_size="not-a-size",
+                       send_queue_depth=-5,
+                       max_connection_attempts=10**9)
+    assert c.shuffle_read_block_size == 256 << 10
+    assert c.send_queue_depth == 4096
+    assert c.max_connection_attempts == 5
+
+
+def test_unknown_key_raises():
+    c = TpuShuffleConf()
+    with pytest.raises(AttributeError):
+        _ = c.no_such_key
+
+
+def test_prealloc_spec():
+    c = TpuShuffleConf(prealloc_buffers="4k:128,1m:16,4k:2")
+    assert c.prealloc_spec() == {4096: 130, 1 << 20: 16}
+    assert TpuShuffleConf().prealloc_spec() == {}
+    # malformed entries skipped
+    c2 = TpuShuffleConf(prealloc_buffers="4k:xx,oops,1m:4")
+    assert c2.prealloc_spec() == {1 << 20: 4}
+
+
+def test_bool_parsing():
+    assert TpuShuffleConf(sw_flow_control="false").sw_flow_control is False
+    assert TpuShuffleConf(sw_flow_control="1").sw_flow_control is True
+    assert TpuShuffleConf(collect_shuffle_reader_stats="True").collect_shuffle_reader_stats is True

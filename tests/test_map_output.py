@@ -1,0 +1,83 @@
+"""Table layout math tests, mirroring the offset arithmetic the reference
+relies on (scala/RdmaMapTaskOutput.scala:25-83,
+scala/RdmaShuffleManager.scala:410-412)."""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.shuffle.map_output import (
+    ENTRY_SIZE,
+    MAP_ENTRY_SIZE,
+    BlockLocation,
+    DriverTable,
+    MapTaskOutput,
+)
+
+
+def test_entry_sizes_match_reference():
+    assert ENTRY_SIZE == 16  # (offset:8, length:4, buf:4) ~ (addr:8, len:4, mkey:4)
+    assert MAP_ENTRY_SIZE == 12  # (token:8, exec:4) ~ (addr:8, lkey:4)
+
+
+def test_put_get_roundtrip():
+    out = MapTaskOutput(8)
+    out.put(3, offset=4096, length=1234, buf=7)
+    assert out.get_block_location(3) == BlockLocation(4096, 1234, 7)
+    assert out.get_block_location(0) == BlockLocation(0, 0, 0)
+    assert out.total_bytes == 1234
+
+
+def test_put_all_vectorized():
+    lengths = np.array([10, 0, 30, 5], dtype=np.uint32)
+    offsets = np.array([0, 10, 10, 40], dtype=np.uint64)
+    out = MapTaskOutput(4)
+    out.put_all(offsets, lengths, buf=42)
+    assert out.get_block_location(2) == BlockLocation(10, 30, 42)
+    assert out.total_bytes == 45
+
+
+def test_range_wire_format():
+    out = MapTaskOutput(16)
+    for r in range(16):
+        out.put(r, offset=r * 100, length=r, buf=1)
+    payload = out.get_range(4, 9)
+    assert len(payload) == 5 * ENTRY_SIZE
+    locs = MapTaskOutput.locations_from_range(payload)
+    assert locs[0] == BlockLocation(400, 4, 1)
+    assert locs[-1] == BlockLocation(800, 8, 1)
+
+
+def test_serialize_roundtrip():
+    out = MapTaskOutput(5)
+    out.put(4, 999, 7, 3)
+    clone = MapTaskOutput.from_bytes(out.to_bytes())
+    assert clone.num_partitions == 5
+    assert clone.get_block_location(4) == BlockLocation(999, 7, 3)
+
+
+def test_driver_table_publish_and_offsets():
+    t = DriverTable(10)
+    assert t.num_published == 0
+    assert t.entry(5) is None
+    t.publish(5, table_token=0xDEADBEEF, exec_index=2)
+    assert t.entry(5) == (0xDEADBEEF, 2)
+    assert t.num_published == 1
+    # one-sided positional write at map_id * MAP_ENTRY_SIZE
+    t.write_raw(7 * MAP_ENTRY_SIZE, DriverTable.pack_entry(123, 0))
+    assert t.entry(7) == (123, 0)
+    with pytest.raises(ValueError):
+        t.write_raw(5, b"x" * MAP_ENTRY_SIZE)  # unaligned
+    with pytest.raises(IndexError):
+        t.write_raw(10 * MAP_ENTRY_SIZE, DriverTable.pack_entry(1, 1))
+
+
+def test_driver_table_roundtrip():
+    t = DriverTable(4)
+    t.publish(0, 11, 1)
+    t.publish(3, 22, 0)
+    clone = DriverTable.from_bytes(t.to_bytes())
+    assert clone.num_maps == 4
+    assert clone.entry(0) == (11, 1)
+    assert clone.entry(1) is None
+    assert clone.entry(3) == (22, 0)
+    assert len(t.to_bytes()) == 4 * MAP_ENTRY_SIZE
