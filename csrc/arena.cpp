@@ -1,0 +1,285 @@
+// Host-memory arena pool for the TPU shuffle runtime.
+//
+// Native equivalent of the reference's registered-memory layer
+// (java/RdmaBufferManager.java + java/RdmaBuffer.java behind libdisni):
+//  * power-of-two size bins with a configurable minimum block size
+//    (RdmaBufferManager.java:93,147-161),
+//  * preallocation that carves many buffers out of one large region
+//    (RdmaBufferManager.java:124-135; <=2 GiB per region),
+//  * LRU trim when idle bytes exceed 90% of the allocation budget,
+//    freeing down to 65% (RdmaBufferManager.java:169-211),
+//  * allocation statistics dumped at stop (RdmaBufferManager.java:217-231),
+//  * zero-fill on hand-out so stale bytes never leak across leases
+//    (RdmaBuffer.java:74-76).
+//
+// There is no NIC, so "registration" here means: page-aligned, madvise'd
+// host memory suitable as a DMA staging source for host->HBM transfers.
+// Exposed as a C ABI for ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include <sys/mman.h>
+#include <unistd.h>
+
+namespace {
+
+struct Buffer {
+  void* ptr = nullptr;
+  uint64_t size = 0;          // usable size (the bin size)
+  int32_t bin = -1;
+  bool carved = false;        // part of a preallocated region: not individually freeable
+  bool in_use = false;
+  uint64_t last_free_seq = 0; // LRU ordering for trim
+};
+
+struct Region {  // one big preallocated carve source
+  void* ptr;
+  uint64_t size;
+};
+
+struct BinStats {
+  uint64_t gets = 0;
+  uint64_t puts = 0;
+  uint64_t fresh_allocs = 0;
+  uint64_t trims = 0;
+};
+
+struct Arena {
+  std::mutex mu;
+  uint64_t max_alloc_bytes;
+  uint64_t min_block;
+  int zero_on_get;
+  std::vector<Buffer> bufs;               // id -> buffer
+  std::vector<std::vector<uint64_t>> free_stacks;  // bin -> ids (stack: hot reuse)
+  std::vector<Region> regions;
+  std::vector<BinStats> stats;
+  uint64_t total_bytes = 0;   // all live allocations owned by the arena
+  uint64_t idle_bytes = 0;    // bytes sitting in free stacks
+  uint64_t free_seq = 0;
+};
+
+constexpr uint64_t kMaxRegion = 1ull << 31;  // 2 GiB per carve region, ref RdmaBufferManager.java:124-135
+
+int bin_of(const Arena* a, uint64_t size) {
+  uint64_t s = std::max(size, a->min_block);
+  int bin = 0;
+  uint64_t b = a->min_block;
+  while (b < s) { b <<= 1; bin++; }
+  return bin;
+}
+
+uint64_t bin_size(const Arena* a, int bin) { return a->min_block << bin; }
+
+void ensure_bin(Arena* a, int bin) {
+  if ((int)a->free_stacks.size() <= bin) {
+    a->free_stacks.resize(bin + 1);
+    a->stats.resize(bin + 1);
+  }
+}
+
+void* alloc_aligned(uint64_t size) {
+  const long page = sysconf(_SC_PAGESIZE);
+  void* p = nullptr;
+  if (posix_memalign(&p, (size_t)page, size) != 0) return nullptr;
+#ifdef MADV_HUGEPAGE
+  if (size >= (2u << 20)) madvise(p, size, MADV_HUGEPAGE);
+#endif
+  return p;
+}
+
+// Trim idle buffers, oldest-free first, until idle <= target. Caller holds mu.
+// Reference policy: trigger >90% of budget idle, clean to 65%
+// (RdmaBufferManager.java:169-211).
+void trim_locked(Arena* a, uint64_t target_idle) {
+  // Collect (seq, id) of non-carved idle buffers.
+  std::vector<std::pair<uint64_t, uint64_t>> idle;
+  for (uint64_t id = 0; id < a->bufs.size(); ++id) {
+    Buffer& b = a->bufs[id];
+    if (!b.in_use && b.ptr && !b.carved) idle.emplace_back(b.last_free_seq, id);
+  }
+  std::sort(idle.begin(), idle.end());
+  for (auto& [seq, id] : idle) {
+    if (a->idle_bytes <= target_idle) break;
+    Buffer& b = a->bufs[id];
+    auto& stack = a->free_stacks[b.bin];
+    auto it = std::find(stack.begin(), stack.end(), id);
+    if (it == stack.end()) continue;  // defensive; shouldn't happen
+    stack.erase(it);
+    a->idle_bytes -= b.size;
+    a->total_bytes -= b.size;
+    a->stats[b.bin].trims++;
+    free(b.ptr);
+    b.ptr = nullptr;
+    b.bin = -1;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* arena_create(uint64_t max_alloc_bytes, uint64_t min_block, int zero_on_get) {
+  Arena* a = new Arena();
+  a->max_alloc_bytes = max_alloc_bytes ? max_alloc_bytes : (10ull << 30);
+  uint64_t mb = min_block ? min_block : (16ull << 10);
+  // round min block to a power of two
+  uint64_t p = 256;
+  while (p < mb) p <<= 1;
+  a->min_block = p;
+  a->zero_on_get = zero_on_get;
+  return a;
+}
+
+// Returns buffer id (>=0) or -1 on allocation failure.
+int64_t arena_get(void* handle, uint64_t size) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  int bin = bin_of(a, size);
+  ensure_bin(a, bin);
+  a->stats[bin].gets++;
+  uint64_t id;
+  if (!a->free_stacks[bin].empty()) {
+    id = a->free_stacks[bin].back();
+    a->free_stacks[bin].pop_back();
+    a->idle_bytes -= a->bufs[id].size;
+  } else {
+    uint64_t sz = bin_size(a, bin);
+    void* p = alloc_aligned(sz);
+    if (!p) return -1;
+    a->stats[bin].fresh_allocs++;
+    a->total_bytes += sz;
+    id = a->bufs.size();
+    a->bufs.push_back(Buffer{p, sz, bin, /*carved=*/false, /*in_use=*/true, 0});
+    if (a->zero_on_get) memset(p, 0, sz);
+    return (int64_t)id;
+  }
+  Buffer& b = a->bufs[id];
+  b.in_use = true;
+  if (a->zero_on_get) memset(b.ptr, 0, b.size);
+  return (int64_t)id;
+}
+
+// Return a buffer to its bin; may trigger the idle trim.
+int arena_put(void* handle, int64_t id) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  if (id < 0 || (uint64_t)id >= a->bufs.size()) return -1;
+  Buffer& b = a->bufs[id];
+  if (!b.in_use || !b.ptr) return -2;  // double-put or trimmed
+  b.in_use = false;
+  b.last_free_seq = ++a->free_seq;
+  a->free_stacks[b.bin].push_back((uint64_t)id);
+  a->idle_bytes += b.size;
+  a->stats[b.bin].puts++;
+  if (a->idle_bytes > a->max_alloc_bytes * 9 / 10)
+    trim_locked(a, a->max_alloc_bytes * 65 / 100);
+  return 0;
+}
+
+// Carve `count` buffers of `size` (rounded up to a bin size) out of as few
+// large regions as possible; push them all onto the free stack.
+int arena_preallocate(void* handle, uint64_t size, uint64_t count) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  int bin = bin_of(a, size);
+  ensure_bin(a, bin);
+  uint64_t sz = bin_size(a, bin);
+  uint64_t per_region = std::max<uint64_t>(1, kMaxRegion / sz);
+  uint64_t remaining = count;
+  while (remaining > 0) {
+    uint64_t n = std::min(per_region, remaining);
+    void* p = alloc_aligned(n * sz);
+    if (!p) return -1;
+    memset(p, 0, n * sz);
+    a->regions.push_back(Region{p, n * sz});
+    a->total_bytes += n * sz;
+    for (uint64_t i = 0; i < n; ++i) {
+      uint64_t id = a->bufs.size();
+      a->bufs.push_back(Buffer{(char*)p + i * sz, sz, bin, /*carved=*/true,
+                               /*in_use=*/false, ++a->free_seq});
+      a->free_stacks[bin].push_back(id);
+      a->idle_bytes += sz;
+    }
+    remaining -= n;
+  }
+  return 0;
+}
+
+void* arena_buf_ptr(void* handle, int64_t id) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  if (id < 0 || (uint64_t)id >= a->bufs.size()) return nullptr;
+  return a->bufs[id].ptr;
+}
+
+uint64_t arena_buf_size(void* handle, int64_t id) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  if (id < 0 || (uint64_t)id >= a->bufs.size()) return 0;
+  return a->bufs[id].size;
+}
+
+uint64_t arena_total_bytes(void* handle) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  return a->total_bytes;
+}
+
+uint64_t arena_idle_bytes(void* handle) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  return a->idle_bytes;
+}
+
+// Manual trim to `target_idle` idle bytes (0 = free everything idle).
+void arena_trim(void* handle, uint64_t target_idle) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  trim_locked(a, target_idle);
+}
+
+// JSON stats into caller buffer; returns bytes written (excl. NUL), or the
+// required size if cap is too small. Reference: alloc-stats dump at stop
+// (RdmaBufferManager.java:217-231).
+int arena_stats_json(void* handle, char* out, int cap) {
+  Arena* a = (Arena*)handle;
+  std::lock_guard<std::mutex> lk(a->mu);
+  std::string s = "{\"total_bytes\":" + std::to_string(a->total_bytes) +
+                  ",\"idle_bytes\":" + std::to_string(a->idle_bytes) + ",\"bins\":[";
+  for (size_t bin = 0; bin < a->stats.size(); ++bin) {
+    const BinStats& st = a->stats[bin];
+    if (bin) s += ",";
+    s += "{\"size\":" + std::to_string(bin_size(a, (int)bin)) +
+         ",\"gets\":" + std::to_string(st.gets) +
+         ",\"puts\":" + std::to_string(st.puts) +
+         ",\"fresh\":" + std::to_string(st.fresh_allocs) +
+         ",\"trimmed\":" + std::to_string(st.trims) + "}";
+  }
+  s += "]}";
+  if ((int)s.size() + 1 <= cap) {
+    memcpy(out, s.c_str(), s.size() + 1);
+    return (int)s.size();
+  }
+  return (int)s.size() + 1;
+}
+
+void arena_destroy(void* handle) {
+  Arena* a = (Arena*)handle;
+  {
+    std::lock_guard<std::mutex> lk(a->mu);
+    for (Buffer& b : a->bufs)
+      if (b.ptr && !b.carved) free(b.ptr);
+    for (Region& r : a->regions) free(r.ptr);
+  }
+  delete a;
+}
+
+}  // extern "C"

@@ -1,0 +1,358 @@
+"""Driver/executor control-plane endpoints.
+
+The reference splits roles the same way (java/RdmaNode.java:150-158 — the
+driver accepts RPC channels, executors accept passive read-responder
+channels; scala/RdmaShuffleManager.scala:73-134 — the driver's receive
+listener runs membership):
+
+* ``DriverEndpoint`` — accepts hellos, maintains the ordered membership
+  list, broadcasts announces to every known executor
+  (scala/RdmaShuffleManager.scala:76-115), hosts per-shuffle driver tables
+  (allocated at registerShuffle, scala/RdmaShuffleManager.scala:168-183),
+  applies positional publish writes, serves whole-table fetches.
+* ``ExecutorEndpoint`` — sends hello on start
+  (scala/RdmaShuffleManager.scala:204-226), learns membership from
+  announces, serves block-location and block-byte reads out of a local
+  ``ShuffleDataSource``, and exposes the client-side fetch calls used by the
+  fetcher iterator.
+
+Executor *indices* — the compact ints stored in driver-table entries — are
+positions in the announce-ordered membership list (append-only), playing the
+role the (address, lkey) pair plays in the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Protocol, Tuple
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel.rpc_msg import AnnounceMsg, HelloMsg, RpcMsg
+from sparkrdma_tpu.parallel.transport import (
+    Connection,
+    ConnectionCache,
+    ControlServer,
+    TransportError,
+)
+from sparkrdma_tpu.shuffle.map_output import DriverTable, MapTaskOutput
+from sparkrdma_tpu.utils.ids import ShuffleManagerId
+
+log = logging.getLogger(__name__)
+
+# Dead-slot marker in membership lists: keeps executor indices stable after a
+# loss while making the slot unroutable.
+from sparkrdma_tpu.utils.ids import ExecutorId as _ExecutorId  # noqa: E402
+
+TOMBSTONE = ShuffleManagerId(_ExecutorId("", "", 0), "", 0)
+
+
+class DeadExecutorError(RuntimeError):
+    """Raised when a fetch resolves to a tombstoned (lost) executor slot."""
+
+
+class ShuffleDataSource(Protocol):
+    """What an executor serves to its peers (implemented by the resolver)."""
+
+    def get_output_table(self, shuffle_id: int, map_id: int) -> Optional[MapTaskOutput]:
+        ...
+
+    def read_block(self, shuffle_id: int, buf_token: int, offset: int,
+                   length: int) -> Optional[bytes]:
+        ...
+
+
+class DriverEndpoint:
+    """Control-plane driver."""
+
+    def __init__(self, conf: Optional[TpuShuffleConf] = None, host: str = ""):
+        self.conf = conf or TpuShuffleConf()
+        bind_host = host or self.conf.driver_host or "127.0.0.1"
+        self.server = ControlServer(bind_host, self.conf.driver_port, self.conf,
+                                    self._handle, name="driver")
+        self._members: List[ShuffleManagerId] = []
+        self._members_lock = threading.Lock()
+        self._tables: Dict[int, DriverTable] = {}
+        self._tables_lock = threading.Lock()
+        self._clients = ConnectionCache(self.conf)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.host, self.server.port
+
+    # -- shuffle registry (driver side of registerShuffle) ---------------
+
+    def register_shuffle(self, shuffle_id: int, num_maps: int) -> None:
+        """Allocate the per-shuffle map-output table
+        (scala/RdmaShuffleManager.scala:168-172)."""
+        with self._tables_lock:
+            if shuffle_id not in self._tables:
+                self._tables[shuffle_id] = DriverTable(num_maps)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        with self._tables_lock:
+            self._tables.pop(shuffle_id, None)
+
+    def members(self) -> List[ShuffleManagerId]:
+        with self._members_lock:
+            return list(self._members)
+
+    def remove_member(self, manager_id: ShuffleManagerId) -> None:
+        """Executor-loss cleanup (scala/RdmaShuffleManager.scala:155-165).
+
+        The slot is kept (indices are stable); the entry is tombstoned so
+        fetchers fail fast instead of contacting a dead peer. The tombstoned
+        snapshot is re-announced so all executors converge.
+        """
+        with self._members_lock:
+            self._members = [TOMBSTONE if m == manager_id else m
+                             for m in self._members]
+            snapshot = list(self._members)
+        threading.Thread(target=self._broadcast, args=(snapshot,),
+                         daemon=True, name="driver-announce").start()
+
+    # -- message handling ------------------------------------------------
+
+    def _handle(self, conn: Connection, msg: RpcMsg) -> Optional[RpcMsg]:
+        if isinstance(msg, HelloMsg):
+            self._on_hello(msg.manager_id)
+            return None
+        if isinstance(msg, M.PublishMsg):
+            return self._on_publish(msg)
+        if isinstance(msg, M.FetchTableReq):
+            return self._on_fetch_table(msg)
+        log.warning("driver: unexpected %s", type(msg).__name__)
+        return None
+
+    def _on_hello(self, manager_id: ShuffleManagerId) -> None:
+        """(scala/RdmaShuffleManager.scala:76-115)."""
+        with self._members_lock:
+            if manager_id not in self._members:
+                self._members.append(manager_id)
+            snapshot = list(self._members)
+        # Broadcast the full ordered membership to everyone, async — the
+        # driver connects out to each executor's control server.
+        threading.Thread(target=self._broadcast, args=(snapshot,),
+                         daemon=True, name="driver-announce").start()
+
+    def _broadcast(self, members: List[ShuffleManagerId]) -> None:
+        announce = AnnounceMsg(members)
+        for m in members:
+            if m == TOMBSTONE:
+                continue
+            try:
+                self._clients.get(m.rpc_host, m.rpc_port).send(announce)
+            except TransportError as e:
+                log.warning("driver: announce to %s:%s failed: %s",
+                            m.rpc_host, m.rpc_port, e)
+
+    def _on_publish(self, msg: M.PublishMsg) -> Optional[RpcMsg]:
+        # Publish is one-sided in the reference (RDMA WRITE into the table,
+        # scala/RdmaShuffleManager.scala:410-412) — no remote reply; problems
+        # are only observable driver-side, so log rather than ack.
+        from sparkrdma_tpu.shuffle.map_output import MAP_ENTRY_SIZE
+        with self._tables_lock:
+            table = self._tables.get(msg.shuffle_id)
+        if table is None:
+            log.warning("driver: publish for unknown shuffle %d", msg.shuffle_id)
+            return None
+        if not 0 <= msg.map_id < table.num_maps:
+            log.warning("driver: publish with bad map_id %d for shuffle %d",
+                        msg.map_id, msg.shuffle_id)
+            return None
+        try:
+            table.write_raw(msg.map_id * MAP_ENTRY_SIZE, msg.entry)
+        except (ValueError, IndexError) as e:
+            log.warning("driver: bad publish for shuffle %d map %d: %s",
+                        msg.shuffle_id, msg.map_id, e)
+        return None
+
+    def _on_fetch_table(self, msg: M.FetchTableReq) -> RpcMsg:
+        with self._tables_lock:
+            table = self._tables.get(msg.shuffle_id)
+        if table is None:
+            return M.FetchTableResp(msg.req_id, -1, b"")
+        return M.FetchTableResp(msg.req_id, table.num_published, table.to_bytes())
+
+    def stop(self) -> None:
+        self._clients.close_all()
+        self.server.stop()
+
+
+class ExecutorEndpoint:
+    """Control-plane executor: serves peers, talks to the driver."""
+
+    def __init__(self, manager_id_host: str, executor: str,
+                 driver_addr: Tuple[str, int],
+                 data_source: Optional[ShuffleDataSource] = None,
+                 conf: Optional[TpuShuffleConf] = None,
+                 engine_port: int = 0):
+        self.conf = conf or TpuShuffleConf()
+        self.data_source = data_source
+        self.server = ControlServer(manager_id_host, self.conf.executor_port,
+                                    self.conf, self._handle,
+                                    name=f"exec-{executor}")
+        from sparkrdma_tpu.utils.ids import ExecutorId
+        self.manager_id = ShuffleManagerId(
+            ExecutorId(executor, manager_id_host, engine_port),
+            self.server.host, self.server.port)
+        self._driver_addr = driver_addr
+        self._members: List[ShuffleManagerId] = []
+        self._members_event = threading.Event()
+        self._members_lock = threading.Lock()
+        self._clients = ConnectionCache(self.conf, on_message=self._handle)
+        self._table_cache: Dict[int, DriverTable] = {}
+        self._table_lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Hello to the driver (scala/RdmaShuffleManager.scala:204-226)."""
+        self.driver_conn().send(HelloMsg(self.manager_id))
+
+    def driver_conn(self) -> Connection:
+        return self._clients.get(*self._driver_addr)
+
+    def stop(self) -> None:
+        self._clients.close_all()
+        self.server.stop()
+
+    # -- membership ------------------------------------------------------
+
+    def members(self) -> List[ShuffleManagerId]:
+        with self._members_lock:
+            return list(self._members)
+
+    def wait_for_members(self, n: int, timeout: float = 10.0) -> List[ShuffleManagerId]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._members_lock:
+                if len(self._members) >= n:
+                    return list(self._members)
+            self._members_event.wait(timeout=0.05)
+            self._members_event.clear()
+        raise TimeoutError(f"membership did not reach {n} "
+                           f"(have {len(self.members())})")
+
+    def exec_index(self) -> int:
+        """This executor's stable index in the membership order."""
+        with self._members_lock:
+            for i, m in enumerate(self._members):
+                if m == self.manager_id:
+                    return i
+        raise KeyError("executor not yet announced")
+
+    def member_at(self, index: int) -> ShuffleManagerId:
+        with self._members_lock:
+            m = self._members[index]
+        if m == TOMBSTONE:
+            raise DeadExecutorError(f"executor slot {index} was lost")
+        return m
+
+    # -- serving peers ---------------------------------------------------
+
+    def _handle(self, conn: Connection, msg: RpcMsg) -> Optional[RpcMsg]:
+        if isinstance(msg, AnnounceMsg):
+            with self._members_lock:
+                # Announce lists are append-only snapshots (slots only get
+                # tombstoned in place, never removed) — accept any list at
+                # least as long as ours so tombstone updates propagate.
+                if len(msg.manager_ids) >= len(self._members):
+                    self._members = list(msg.manager_ids)
+            self._members_event.set()
+            return None
+        if isinstance(msg, M.FetchOutputReq):
+            return self._on_fetch_output(msg)
+        if isinstance(msg, M.FetchBlocksReq):
+            return self._on_fetch_blocks(msg)
+        log.warning("%s: unexpected %s", self.manager_id.executor_id.executor,
+                    type(msg).__name__)
+        return None
+
+    def _on_fetch_output(self, msg: M.FetchOutputReq) -> RpcMsg:
+        """Serve 16B location entries
+        (scala/RdmaShuffleFetcherIterator.scala:293-315 analogue)."""
+        if self.data_source is None:
+            return M.FetchOutputResp(msg.req_id, M.STATUS_ERROR, b"")
+        table = self.data_source.get_output_table(msg.shuffle_id, msg.map_id)
+        if table is None:
+            return M.FetchOutputResp(msg.req_id, M.STATUS_UNKNOWN_MAP, b"")
+        if not (0 <= msg.start_partition <= msg.end_partition <= table.num_partitions):
+            return M.FetchOutputResp(msg.req_id, M.STATUS_BAD_RANGE, b"")
+        return M.FetchOutputResp(msg.req_id, M.STATUS_OK,
+                                 table.get_range(msg.start_partition, msg.end_partition))
+
+    def _on_fetch_blocks(self, msg: M.FetchBlocksReq) -> RpcMsg:
+        """Serve a scatter data read (DCN fallback of the one-sided READ,
+        scala/RdmaShuffleFetcherIterator.scala:119-180)."""
+        if self.data_source is None:
+            return M.FetchBlocksResp(msg.req_id, M.STATUS_ERROR, b"")
+        parts = []
+        for token, offset, length in msg.blocks:
+            data = self.data_source.read_block(msg.shuffle_id, token, offset, length)
+            if data is None:
+                return M.FetchBlocksResp(msg.req_id, M.STATUS_UNKNOWN_SHUFFLE, b"")
+            parts.append(data)
+        return M.FetchBlocksResp(msg.req_id, M.STATUS_OK, b"".join(parts))
+
+    # -- client-side fetch calls (used by the fetcher iterator) ----------
+
+    def publish_map_output(self, shuffle_id: int, map_id: int,
+                           table_token: int) -> None:
+        """(scala/RdmaShuffleManager.scala:384-418)."""
+        entry = DriverTable.pack_entry(table_token, self.exec_index())
+        conn = self.driver_conn()
+        msg = M.PublishMsg(shuffle_id, map_id, entry)
+        conn.send(msg)
+
+    def get_driver_table(self, shuffle_id: int, expect_published: int,
+                         timeout: Optional[float] = None) -> DriverTable:
+        """Fetch + poll until the expected publishes have landed
+        (scala/RdmaShuffleManager.scala:341-376; wait budget
+        partitionLocationFetchTimeout, scala/RdmaShuffleConf.scala:112-115).
+        Memoized per shuffle once complete."""
+        with self._table_lock:
+            cached = self._table_cache.get(shuffle_id)
+        if cached is not None:
+            return cached
+        tmo = (timeout if timeout is not None
+               else self.conf.partition_location_fetch_timeout_ms / 1000)
+        deadline = time.monotonic() + tmo
+        conn = self.driver_conn()
+        delay = 0.002
+        while True:
+            resp = conn.request(M.FetchTableReq(conn.next_req_id(), shuffle_id))
+            assert isinstance(resp, M.FetchTableResp)
+            if resp.num_published >= expect_published:
+                table = DriverTable.from_bytes(resp.table)
+                with self._table_lock:
+                    self._table_cache[shuffle_id] = table
+                return table
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shuffle {shuffle_id}: only {resp.num_published}/"
+                    f"{expect_published} map outputs published")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.25)
+
+    def fetch_output_range(self, peer: ShuffleManagerId, shuffle_id: int,
+                           map_id: int, start: int, end: int):
+        conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        resp = conn.request(M.FetchOutputReq(conn.next_req_id(), shuffle_id,
+                                             map_id, start, end))
+        assert isinstance(resp, M.FetchOutputResp)
+        if resp.status != M.STATUS_OK:
+            raise TransportError(f"fetch_output status={resp.status}")
+        return MapTaskOutput.locations_from_range(resp.entries)
+
+    def fetch_blocks(self, peer: ShuffleManagerId, shuffle_id: int,
+                     blocks) -> bytes:
+        conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        resp = conn.request(M.FetchBlocksReq(conn.next_req_id(), shuffle_id,
+                                             list(blocks)))
+        assert isinstance(resp, M.FetchBlocksResp)
+        if resp.status != M.STATUS_OK:
+            raise TransportError(f"fetch_blocks status={resp.status}")
+        return resp.data

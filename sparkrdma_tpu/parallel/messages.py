@@ -1,0 +1,195 @@
+"""Control-plane message set beyond hello/announce.
+
+The reference needs only two RPC types because everything else is one-sided
+RDMA (scala/RdmaRpcMsg.scala:29-32). Without a NIC to do one-sided reads,
+the TPU control plane carries those flows as explicit messages — but they
+remain exactly the reference's three-level scheme:
+
+* ``PublishMsg``      — the 12-byte driver-table entry WRITE at
+                        ``map_id * MAP_ENTRY_SIZE``
+                        (scala/RdmaShuffleManager.scala:384-418).
+* ``FetchTableReq/Resp`` — the whole-driver-table READ, once per
+                        (shuffle, executor) (scala/RdmaShuffleManager.scala:341-376).
+* ``FetchOutputReq/Resp`` — the per-(map, reduce-range) block-location READ
+                        of 16-byte entries out of the owning executor
+                        (scala/RdmaShuffleFetcherIterator.scala:293-315).
+* ``FetchBlocksReq/Resp`` — the scatter data READ (DCN fallback path; on-mesh
+                        traffic rides the ICI ragged all-to-all instead)
+                        (scala/RdmaShuffleFetcherIterator.scala:119-180).
+
+All carry a ``req_id`` echo so clients can pipeline requests per connection
+the way the reference pipelines work requests on a QP.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+from sparkrdma_tpu.parallel.rpc_msg import RpcMsg, register
+
+_QIII = struct.Struct("<qiii")
+_QI = struct.Struct("<qi")
+_Q = struct.Struct("<q")
+_BLOCK = struct.Struct("<IQI")  # (buf token, offset, length)
+
+
+@register(3)
+class PublishMsg(RpcMsg):
+    """Executor -> driver: positional driver-table entry write."""
+
+    def __init__(self, shuffle_id: int, map_id: int, entry: bytes):
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.entry = entry
+
+    def payload(self) -> bytes:
+        return struct.pack("<ii", self.shuffle_id, self.map_id) + self.entry
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "PublishMsg":
+        shuffle_id, map_id = struct.unpack_from("<ii", payload, 0)
+        return cls(shuffle_id, map_id, payload[8:])
+
+
+@register(4)
+class AckMsg(RpcMsg):
+    """Generic ack with status (0 = ok)."""
+
+    def __init__(self, req_id: int, status: int = 0):
+        self.req_id = req_id
+        self.status = status
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.status)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "AckMsg":
+        req_id, status = _QI.unpack_from(payload, 0)
+        return cls(req_id, status)
+
+
+@register(5)
+class FetchTableReq(RpcMsg):
+    def __init__(self, req_id: int, shuffle_id: int):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.shuffle_id)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchTableReq":
+        return cls(*_QI.unpack_from(payload, 0))
+
+
+@register(6)
+class FetchTableResp(RpcMsg):
+    """num_published lets clients poll until the maps they need have
+    committed (client-side analogue of the reference's wait on
+    partitionLocationFetchTimeout)."""
+
+    def __init__(self, req_id: int, num_published: int, table: bytes):
+        self.req_id = req_id
+        self.num_published = num_published
+        self.table = table
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.num_published) + self.table
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchTableResp":
+        req_id, num_published = _QI.unpack_from(payload, 0)
+        return cls(req_id, num_published, payload[_QI.size:])
+
+
+@register(7)
+class FetchOutputReq(RpcMsg):
+    """Read 16B location entries [start, end) of one map's output table."""
+
+    def __init__(self, req_id: int, shuffle_id: int, map_id: int,
+                 start_partition: int, end_partition: int):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.start_partition = start_partition
+        self.end_partition = end_partition
+
+    def payload(self) -> bytes:
+        return _QIII.pack(self.req_id, self.shuffle_id, self.map_id,
+                          self.start_partition) + struct.pack("<i", self.end_partition)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchOutputReq":
+        req_id, shuffle_id, map_id, start = _QIII.unpack_from(payload, 0)
+        (end,) = struct.unpack_from("<i", payload, _QIII.size)
+        return cls(req_id, shuffle_id, map_id, start, end)
+
+
+@register(8)
+class FetchOutputResp(RpcMsg):
+    def __init__(self, req_id: int, status: int, entries: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.entries = entries
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.status) + self.entries
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchOutputResp":
+        req_id, status = _QI.unpack_from(payload, 0)
+        return cls(req_id, status, payload[_QI.size:])
+
+
+@register(9)
+class FetchBlocksReq(RpcMsg):
+    """Scatter-read: list of (buf token, offset, length) to pack in order."""
+
+    def __init__(self, req_id: int, shuffle_id: int,
+                 blocks: List[Tuple[int, int, int]]):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+        self.blocks = list(blocks)
+
+    def payload(self) -> bytes:
+        head = _QI.pack(self.req_id, self.shuffle_id)
+        body = b"".join(_BLOCK.pack(t, o, ln) for t, o, ln in self.blocks)
+        return head + struct.pack("<I", len(self.blocks)) + body
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchBlocksReq":
+        req_id, shuffle_id = _QI.unpack_from(payload, 0)
+        off = _QI.size
+        (n,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        blocks = []
+        for _ in range(n):
+            t, o, ln = _BLOCK.unpack_from(payload, off)
+            off += _BLOCK.size
+            blocks.append((t, o, ln))
+        return cls(req_id, shuffle_id, blocks)
+
+
+@register(10)
+class FetchBlocksResp(RpcMsg):
+    def __init__(self, req_id: int, status: int, data: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.data = data
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.status) + self.data
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchBlocksResp":
+        req_id, status = _QI.unpack_from(payload, 0)
+        return cls(req_id, status, payload[_QI.size:])
+
+
+# Status codes shared by responses.
+STATUS_OK = 0
+STATUS_UNKNOWN_SHUFFLE = 1
+STATUS_UNKNOWN_MAP = 2
+STATUS_BAD_RANGE = 3
+STATUS_ERROR = 4

@@ -1,0 +1,2 @@
+from sparkrdma_tpu.runtime.pool import BufferPool, PoolBuffer, RegisteredBuffer  # noqa: F401
+from sparkrdma_tpu.runtime.staging import SpillFile  # noqa: F401

@@ -1,0 +1,66 @@
+"""ctypes bindings for the native runtime shim (``csrc/``).
+
+The reference's equivalent layer is libdisni's JNI binding of libibverbs
+(pom.xml:79-96; load-failure handling at java/RdmaNode.java:109-112 — a
+missing native library degrades with a clear message rather than crashing).
+We keep that behavior: if ``libtpushuffle.so`` is absent or unloadable,
+``LIB`` is ``None`` and callers fall back to pure-Python implementations.
+
+Rebuild with ``make -C csrc``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libtpushuffle.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    u64, i64, vp, cp = (ctypes.c_uint64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_char_p)
+    lib.arena_create.argtypes = [u64, u64, ctypes.c_int]
+    lib.arena_create.restype = vp
+    lib.arena_get.argtypes = [vp, u64]
+    lib.arena_get.restype = i64
+    lib.arena_put.argtypes = [vp, i64]
+    lib.arena_put.restype = ctypes.c_int
+    lib.arena_preallocate.argtypes = [vp, u64, u64]
+    lib.arena_preallocate.restype = ctypes.c_int
+    lib.arena_buf_ptr.argtypes = [vp, i64]
+    lib.arena_buf_ptr.restype = vp
+    lib.arena_buf_size.argtypes = [vp, i64]
+    lib.arena_buf_size.restype = u64
+    lib.arena_total_bytes.argtypes = [vp]
+    lib.arena_total_bytes.restype = u64
+    lib.arena_idle_bytes.argtypes = [vp]
+    lib.arena_idle_bytes.restype = u64
+    lib.arena_trim.argtypes = [vp, u64]
+    lib.arena_trim.restype = None
+    lib.arena_stats_json.argtypes = [vp, cp, ctypes.c_int]
+    lib.arena_stats_json.restype = ctypes.c_int
+    lib.arena_destroy.argtypes = [vp]
+    lib.arena_destroy.restype = None
+    lib.staging_map_file.argtypes = [cp, ctypes.POINTER(u64)]
+    lib.staging_map_file.restype = vp
+    lib.staging_unmap.argtypes = [vp]
+    lib.staging_unmap.restype = None
+    lib.staging_gather.argtypes = [vp, ctypes.POINTER(u64), ctypes.POINTER(u64),
+                                   u64, cp, ctypes.c_int]
+    lib.staging_gather.restype = i64
+    lib.mem_gather.argtypes = [cp, ctypes.POINTER(u64), ctypes.POINTER(u64),
+                               u64, cp, ctypes.c_int]
+    lib.mem_gather.restype = i64
+    return lib
+
+
+LIB = _load()
+
+
+def available() -> bool:
+    return LIB is not None

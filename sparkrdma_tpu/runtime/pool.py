@@ -1,0 +1,310 @@
+"""Host staging-buffer pool.
+
+Re-design of the reference's pinned-MR pool (java/RdmaBufferManager.java):
+
+* power-of-two bins with a minimum block size (RdmaBufferManager.java:93,
+  147-161) — requests round up to the bin size;
+* ``preallocate`` carving many buffers out of few large regions
+  (RdmaBufferManager.java:124-135);
+* LRU trim when idle bytes exceed 90% of the budget, down to 65%
+  (RdmaBufferManager.java:169-211);
+* allocation stats for the stop-time dump (RdmaBufferManager.java:217-231);
+* refcounted multi-view leases — one pool buffer serving several logical
+  blocks (java/RdmaRegisteredBuffer.java:28-87, used to land one
+  scatter-READ of many blocks in a single registration).
+
+Backed by the C++ arena (``csrc/arena.cpp``) when built; a pure-Python
+fallback with identical semantics keeps the framework importable anywhere.
+Buffer **tokens** (small ints) name pool buffers in MapTaskOutput entries —
+the role (address, lkey) pairs play in the reference.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.runtime import native
+
+
+def _round_up_pow2(size: int, min_block: int) -> int:
+    b = min_block
+    while b < size:
+        b <<= 1
+    return b
+
+
+class PoolBuffer:
+    """One leased pool buffer. ``view`` is a writable numpy uint8 view."""
+
+    __slots__ = ("token", "size", "view", "_pool", "_freed")
+
+    def __init__(self, token: int, size: int, view: np.ndarray, pool: "BufferPool"):
+        self.token = token
+        self.size = size
+        self.view = view
+        self._pool = pool
+        self._freed = False
+
+    def free(self) -> None:
+        if not self._freed:
+            self._freed = True
+            self._pool._release(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.free()
+
+
+class RegisteredBuffer:
+    """Refcounted lease that bump-allocates block views from one PoolBuffer.
+
+    Reference: java/RdmaRegisteredBuffer.java:28-87 — many blocks land in one
+    registered region; the region returns to the pool on last release.
+    """
+
+    def __init__(self, pool: "BufferPool", size: int):
+        self._buf = pool.get(size)
+        self._offset = 0
+        self._refs = 1  # creator's reference
+        self._lock = threading.Lock()
+
+    @property
+    def token(self) -> int:
+        return self._buf.token
+
+    def retain(self) -> None:
+        with self._lock:
+            self._refs += 1
+
+    def release(self) -> None:
+        with self._lock:
+            self._refs -= 1
+            last = self._refs == 0
+        if last:
+            self._buf.free()
+
+    def slice(self, length: int) -> np.ndarray:
+        """Bump-allocate the next `length` bytes (RdmaRegisteredBuffer.java:72-87)."""
+        with self._lock:
+            if self._offset + length > self._buf.size:
+                raise ValueError("registered buffer exhausted")
+            view = self._buf.view[self._offset:self._offset + length]
+            self._offset += length
+            self._refs += 1
+        return view
+
+
+class _PyArena:
+    """Pure-Python fallback arena with the same bin/trim semantics."""
+
+    def __init__(self, max_alloc: int, min_block: int, zero_on_get: bool):
+        self.max_alloc = max_alloc
+        self.min_block = min_block
+        self.zero_on_get = zero_on_get
+        self._bufs: Dict[int, np.ndarray] = {}
+        self._free: Dict[int, list] = {}  # bin_size -> [tokens]
+        self._sizes: Dict[int, int] = {}
+        self._carved: set = set()
+        self._seq: Dict[int, float] = {}
+        self._next = 0
+        self.total_bytes = 0
+        self.idle_bytes = 0
+        self.stats: Dict[int, Dict[str, int]] = {}
+
+    def _stat(self, size: int) -> Dict[str, int]:
+        return self.stats.setdefault(size, {"gets": 0, "puts": 0, "fresh": 0, "trimmed": 0})
+
+    def get(self, size: int) -> int:
+        b = _round_up_pow2(max(size, 1), self.min_block)
+        self._stat(b)["gets"] += 1
+        free = self._free.get(b)
+        if free:
+            token = free.pop()
+            self.idle_bytes -= b
+            if self.zero_on_get:
+                self._bufs[token][:] = 0
+            return token
+        token = self._next
+        self._next += 1
+        self._bufs[token] = np.zeros(b, dtype=np.uint8)
+        self._sizes[token] = b
+        self.total_bytes += b
+        self._stat(b)["fresh"] += 1
+        return token
+
+    def put(self, token: int) -> None:
+        b = self._sizes[token]
+        self._free.setdefault(b, []).append(token)
+        self._seq[token] = time.monotonic()
+        self.idle_bytes += b
+        self._stat(b)["puts"] += 1
+        if self.idle_bytes > self.max_alloc * 9 // 10:
+            self.trim(self.max_alloc * 65 // 100)
+
+    def preallocate(self, size: int, count: int) -> None:
+        b = _round_up_pow2(max(size, 1), self.min_block)
+        for _ in range(count):
+            token = self._next
+            self._next += 1
+            self._bufs[token] = np.zeros(b, dtype=np.uint8)
+            self._sizes[token] = b
+            self._carved.add(token)
+            self._free.setdefault(b, []).append(token)
+            self._seq[token] = time.monotonic()
+            self.total_bytes += b
+            self.idle_bytes += b
+
+    def trim(self, target_idle: int) -> None:
+        idle = sorted(
+            (t for free in self._free.values() for t in free if t not in self._carved),
+            key=lambda t: self._seq.get(t, 0.0),
+        )
+        for token in idle:
+            if self.idle_bytes <= target_idle:
+                break
+            b = self._sizes[token]
+            self._free[b].remove(token)
+            del self._bufs[token]
+            del self._sizes[token]
+            self.idle_bytes -= b
+            self.total_bytes -= b
+            self._stat(b)["trimmed"] += 1
+
+    def view(self, token: int) -> np.ndarray:
+        return self._bufs[token]
+
+    def size(self, token: int) -> int:
+        return self._sizes[token]
+
+    def stats_dict(self) -> dict:
+        return {
+            "total_bytes": self.total_bytes,
+            "idle_bytes": self.idle_bytes,
+            "bins": [dict(size=s, **st) for s, st in sorted(self.stats.items())],
+        }
+
+    def destroy(self) -> None:
+        self._bufs.clear()
+        self._free.clear()
+
+
+class BufferPool:
+    """Public pool API; picks the C++ arena when available."""
+
+    def __init__(self, conf: Optional[TpuShuffleConf] = None, zero_on_get: bool = False):
+        conf = conf or TpuShuffleConf()
+        self.min_block = _round_up_pow2(conf.min_block_size, 256)
+        self._use_native = bool(conf.use_cpp_runtime and native.available())
+        self._lock = threading.Lock()
+        self._stopped = False
+        if self._use_native:
+            self._h = native.LIB.arena_create(
+                conf.max_buffer_allocation_size, self.min_block, int(zero_on_get))
+        else:
+            self._py = _PyArena(conf.max_buffer_allocation_size, self.min_block, zero_on_get)
+        for size, count in conf.prealloc_spec().items():
+            self.preallocate(size, count)
+
+    @property
+    def is_native(self) -> bool:
+        return self._use_native
+
+    def get(self, size: int) -> PoolBuffer:
+        if self._use_native:
+            token = native.LIB.arena_get(self._h, max(size, 1))
+            if token < 0:
+                raise MemoryError(f"arena allocation of {size} bytes failed")
+            bin_size = native.LIB.arena_buf_size(self._h, token)
+            ptr = native.LIB.arena_buf_ptr(self._h, token)
+            raw = (ctypes.c_uint8 * bin_size).from_address(ptr)
+            view = np.frombuffer(raw, dtype=np.uint8)
+        else:
+            with self._lock:
+                token = self._py.get(size)
+                bin_size = self._py.size(token)
+                view = self._py.view(token)
+        return PoolBuffer(int(token), int(bin_size), view, self)
+
+    def get_registered(self, size: int) -> RegisteredBuffer:
+        return RegisteredBuffer(self, size)
+
+    def _release(self, buf: PoolBuffer) -> None:
+        if self._stopped:
+            return  # late frees after stop() are inert (lease views dangle)
+        if self._use_native:
+            rc = native.LIB.arena_put(self._h, buf.token)
+            if rc != 0:
+                raise RuntimeError(f"arena_put({buf.token}) failed: {rc}")
+        else:
+            with self._lock:
+                self._py.put(buf.token)
+
+    def preallocate(self, size: int, count: int) -> None:
+        if self._use_native:
+            rc = native.LIB.arena_preallocate(self._h, size, count)
+            if rc != 0:
+                raise MemoryError("preallocation failed")
+        else:
+            with self._lock:
+                self._py.preallocate(size, count)
+
+    def trim(self, target_idle: int = 0) -> None:
+        if self._use_native:
+            native.LIB.arena_trim(self._h, target_idle)
+        else:
+            with self._lock:
+                self._py.trim(target_idle)
+
+    @property
+    def total_bytes(self) -> int:
+        if self._use_native:
+            return native.LIB.arena_total_bytes(self._h)
+        return self._py.total_bytes
+
+    @property
+    def idle_bytes(self) -> int:
+        if self._use_native:
+            return native.LIB.arena_idle_bytes(self._h)
+        return self._py.idle_bytes
+
+    def stats(self) -> dict:
+        if self._use_native:
+            cap = 1 << 16
+            out = ctypes.create_string_buffer(cap)
+            n = native.LIB.arena_stats_json(self._h, out, cap)
+            if n >= cap:
+                out = ctypes.create_string_buffer(n + 1)
+                native.LIB.arena_stats_json(self._h, out, n + 1)
+            import json
+            return json.loads(out.value.decode())
+        return self._py.stats_dict()
+
+    def stop(self) -> dict:
+        """Stats snapshot + teardown (RdmaBufferManager.java:217-231).
+
+        Frees of still-outstanding leases after stop are inert no-ops; their
+        views must not be touched (the backing memory is gone on the native
+        path).
+        """
+        if self._stopped:
+            return {}
+        snapshot = self.stats()
+        self._stopped = True
+        if self._use_native:
+            with self._lock:
+                if self._h is not None:
+                    native.LIB.arena_destroy(self._h)
+                    self._h = None
+            self._use_native = False
+            self._py = _PyArena(0, self.min_block, False)  # inert post-stop
+        else:
+            self._py.destroy()
+        return snapshot

@@ -1,0 +1,127 @@
+"""Spill-file staging: committed map outputs -> contiguous staging buffers.
+
+Re-design of java/RdmaMappedFile.java. The reference mmaps the committed
+shuffle data file in partition-aligned chunks of at least
+``shuffleWriteBlockSize`` and registers each chunk as an RDMA MR
+(RdmaMappedFile.java:113-157, 163-189), filling the per-map
+``RdmaMapTaskOutput`` with each partition's location (141-156). With no NIC,
+the TPU path is: mmap the spill file (native shim), record per-partition
+(offset, length) in a MapTaskOutput against a *file* token, and on demand
+gather any block subset into one contiguous pool buffer (the scatter-READ
+analogue, multithreaded memcpy at host memory bandwidth) ready for a single
+host->HBM transfer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from sparkrdma_tpu.runtime import native
+from sparkrdma_tpu.runtime.pool import BufferPool, PoolBuffer
+from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+
+
+class SpillFile:
+    """A committed map-output data file, mapped for serving.
+
+    Like the reference's mapped file, the object owns the mapping for the
+    file's lifetime and deletes the file on dispose
+    (RdmaMappedFile.java:110, 208-218).
+    """
+
+    def __init__(self, path: str, partition_lengths: Sequence[int],
+                 file_token: int, delete_on_dispose: bool = True):
+        self.path = path
+        self.file_token = file_token
+        self._delete = delete_on_dispose
+        lengths = np.asarray(partition_lengths, dtype=np.uint64)
+        offsets = np.zeros(len(lengths), dtype=np.uint64)
+        if len(lengths) > 1:
+            offsets[1:] = np.cumsum(lengths[:-1])
+        self.partition_offsets = offsets
+        self.partition_lengths = lengths
+        self.size = int(lengths.sum())
+
+        # Per-map location table (RdmaMappedFile.java:141-156).
+        self.map_output = MapTaskOutput(len(lengths))
+        self.map_output.put_all(offsets, lengths.astype(np.uint32), file_token)
+
+        self._native_handle = None
+        self._py_data: Optional[np.ndarray] = None
+        actual = os.path.getsize(path)
+        if actual < self.size:
+            raise ValueError(f"spill file {path} shorter ({actual}) than "
+                             f"declared partitions ({self.size})")
+        if native.available() and self.size > 0:
+            out_size = ctypes.c_uint64()
+            h = native.LIB.staging_map_file(path.encode(), ctypes.byref(out_size))
+            if h:
+                self._native_handle = h
+        if self._native_handle is None and self.size > 0:
+            self._py_data = np.fromfile(path, dtype=np.uint8)
+
+    def gather(self, offsets: Sequence[int], lengths: Sequence[int],
+               dst: np.ndarray, nthreads: int = 4) -> int:
+        """Pack the given blocks back-to-back into ``dst``; returns bytes."""
+        offs = np.ascontiguousarray(offsets, dtype=np.uint64)
+        lens = np.ascontiguousarray(lengths, dtype=np.uint64)
+        total = int(lens.sum())
+        if total > dst.nbytes:
+            raise ValueError("destination buffer too small")
+        if total == 0:
+            return 0
+        if self._native_handle is not None:
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            n = native.LIB.staging_gather(
+                self._native_handle,
+                offs.ctypes.data_as(u64p), lens.ctypes.data_as(u64p),
+                len(offs), dst.ctypes.data_as(ctypes.c_char_p), nthreads)
+            if n < 0:
+                raise IndexError("block out of file bounds")
+            return int(n)
+        pos = 0
+        for off, ln in zip(offs.tolist(), lens.tolist()):
+            if off + ln > self.size:
+                raise IndexError("block out of file bounds")
+            dst[pos:pos + ln] = self._py_data[off:off + ln]
+            pos += ln
+        return pos
+
+    def gather_partitions(self, partition_ids: Sequence[int], pool: BufferPool,
+                          nthreads: int = 4) -> PoolBuffer:
+        """Gather whole partitions into one pool buffer (lease returned)."""
+        offs = self.partition_offsets[list(partition_ids)]
+        lens = self.partition_lengths[list(partition_ids)]
+        buf = pool.get(max(int(lens.sum()), 1))
+        self.gather(offs, lens, buf.view, nthreads)
+        return buf
+
+    def read_partition(self, partition_id: int) -> bytes:
+        """Serve one local partition (RdmaMappedFile.java:231-235)."""
+        off = int(self.partition_offsets[partition_id])
+        ln = int(self.partition_lengths[partition_id])
+        if self._native_handle is not None:
+            out = np.empty(ln, dtype=np.uint8)
+            self.gather([off], [ln], out)
+            return out.tobytes()
+        if ln == 0:
+            return b""
+        return self._py_data[off:off + ln].tobytes()
+
+    def dispose(self) -> None:
+        if self._native_handle is not None:
+            native.LIB.staging_unmap(self._native_handle)
+            self._native_handle = None
+        self._py_data = None
+        if self._delete and os.path.exists(self.path):
+            os.unlink(self.path)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.dispose()

@@ -140,7 +140,7 @@ class DriverTable:
         (scala/RdmaShuffleManager.scala:384-418). Must be entry-aligned."""
         if byte_offset % MAP_ENTRY_SIZE or len(payload) % MAP_ENTRY_SIZE:
             raise ValueError("unaligned driver-table write")
-        if byte_offset + len(payload) > len(self._buf):
+        if byte_offset < 0 or byte_offset + len(payload) > len(self._buf):
             raise IndexError("driver-table write out of bounds")
         self._buf[byte_offset:byte_offset + len(payload)] = payload
 

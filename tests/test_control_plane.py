@@ -1,0 +1,196 @@
+"""Control-plane integration tests: driver + executors in one process,
+real sockets on localhost.
+
+Covers the reference's bootstrap/membership flow
+(scala/RdmaShuffleManager.scala:73-134, 186-232), driver-table
+publish/fetch (341-418), and peer location/block serving
+(scala/RdmaShuffleFetcherIterator.scala:119-180, 293-315).
+"""
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel.endpoints import DriverEndpoint, ExecutorEndpoint
+from sparkrdma_tpu.parallel.transport import ConnectionCache, TransportError
+from sparkrdma_tpu.shuffle.map_output import MAP_ENTRY_SIZE, MapTaskOutput
+
+CONF = TpuShuffleConf(connect_timeout_ms=5000, max_connection_attempts=2)
+
+
+class FakeSource:
+    """In-memory ShuffleDataSource: buffers keyed by token."""
+
+    def __init__(self):
+        self.tables: Dict[Tuple[int, int], MapTaskOutput] = {}
+        self.buffers: Dict[int, bytes] = {}
+
+    def get_output_table(self, shuffle_id: int, map_id: int) -> Optional[MapTaskOutput]:
+        return self.tables.get((shuffle_id, map_id))
+
+    def read_block(self, shuffle_id: int, buf_token: int, offset: int,
+                   length: int) -> Optional[bytes]:
+        buf = self.buffers.get(buf_token)
+        if buf is None or offset + length > len(buf):
+            return None
+        return buf[offset:offset + length]
+
+
+@pytest.fixture
+def cluster():
+    driver = DriverEndpoint(CONF)
+    execs, sources = [], []
+    for i in range(3):
+        src = FakeSource()
+        ex = ExecutorEndpoint("127.0.0.1", str(i), driver.address,
+                              data_source=src, conf=CONF)
+        execs.append(ex)
+        sources.append(src)
+    for ex in execs:
+        ex.start()
+    for ex in execs:
+        ex.wait_for_members(3)
+    yield driver, execs, sources
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def test_membership_bootstrap(cluster):
+    driver, execs, _ = cluster
+    assert len(driver.members()) == 3
+    # all executors converge on the same ordered list
+    lists = [ex.members() for ex in execs]
+    assert lists[0] == lists[1] == lists[2] == driver.members()
+    # stable indices
+    indices = sorted(ex.exec_index() for ex in execs)
+    assert indices == [0, 1, 2]
+
+
+def test_publish_and_fetch_driver_table(cluster):
+    driver, execs, _ = cluster
+    driver.register_shuffle(7, num_maps=6)
+    # each executor publishes two map outputs
+    for m in range(6):
+        execs[m % 3].publish_map_output(7, m, table_token=1000 + m)
+    table = execs[0].get_driver_table(7, expect_published=6, timeout=5)
+    assert table.num_maps == 6
+    for m in range(6):
+        token, exec_idx = table.entry(m)
+        assert token == 1000 + m
+        assert exec_idx == execs[m % 3].exec_index()
+
+
+def test_fetch_table_polls_until_published(cluster):
+    driver, execs, _ = cluster
+    driver.register_shuffle(8, num_maps=2)
+    execs[0].publish_map_output(8, 0, table_token=1)
+
+    def late_publish():
+        time.sleep(0.2)
+        execs[1].publish_map_output(8, 1, table_token=2)
+
+    t = threading.Thread(target=late_publish)
+    t.start()
+    table = execs[2].get_driver_table(8, expect_published=2, timeout=5)
+    t.join()
+    assert table.entry(1)[0] == 2
+
+
+def test_fetch_table_timeout(cluster):
+    driver, execs, _ = cluster
+    driver.register_shuffle(9, num_maps=4)
+    with pytest.raises(TimeoutError):
+        execs[0].get_driver_table(9, expect_published=4, timeout=0.3)
+
+
+def test_fetch_output_range_and_blocks(cluster):
+    driver, execs, sources = cluster
+    # executor 1 stages a map output: 4 partitions in buffer 55
+    payload = np.arange(400, dtype=np.uint8).tobytes()
+    sources[1].buffers[55] = payload
+    table = MapTaskOutput(4)
+    for r in range(4):
+        table.put(r, offset=r * 100, length=100, buf=55)
+    sources[1].tables[(3, 0)] = table
+
+    peer = execs[1].manager_id
+    locs = execs[0].fetch_output_range(peer, 3, 0, 1, 3)
+    assert len(locs) == 2
+    assert locs[0].offset == 100 and locs[0].buf == 55
+
+    data = execs[0].fetch_blocks(peer, 3, [(l.buf, l.offset, l.length) for l in locs])
+    assert data == payload[100:300]
+
+
+def test_fetch_errors(cluster):
+    _, execs, _ = cluster
+    peer = execs[1].manager_id
+    with pytest.raises(TransportError):
+        execs[0].fetch_output_range(peer, 999, 0, 0, 1)  # unknown map
+    with pytest.raises(TransportError):
+        execs[0].fetch_blocks(peer, 3, [(12345, 0, 10)])  # unknown buffer
+
+
+def test_publish_unknown_shuffle_ignored(cluster):
+    driver, execs, _ = cluster
+    # publishing to an unregistered shuffle must not corrupt anything
+    execs[0].publish_map_output(12345, 0, table_token=9)
+    time.sleep(0.1)
+    driver.register_shuffle(12345, num_maps=1)
+    assert driver._tables[12345].num_published == 0
+
+
+def test_connect_failure_budget():
+    cache = ConnectionCache(TpuShuffleConf(connect_timeout_ms=200,
+                                           max_connection_attempts=2))
+    t0 = time.monotonic()
+    with pytest.raises(TransportError):
+        cache.get("127.0.0.1", 1)  # nothing listens on port 1
+    assert time.monotonic() - t0 < 5
+
+
+def test_request_after_peer_stop_fails_fast(cluster):
+    driver, execs, _ = cluster
+    driver.register_shuffle(1, num_maps=1)
+    execs[0].publish_map_output(1, 0, table_token=5)
+    execs[0].get_driver_table(1, expect_published=1, timeout=5)
+    conn = execs[0].driver_conn()
+    driver.server.stop()
+    time.sleep(0.1)
+    from sparkrdma_tpu.parallel import messages as M
+    with pytest.raises((TransportError, Exception)):
+        conn.request(M.FetchTableReq(conn.next_req_id(), 1), timeout=1)
+
+
+def test_tombstone_keeps_indices_stable(cluster):
+    driver, execs, _ = cluster
+    from sparkrdma_tpu.parallel.endpoints import TOMBSTONE, DeadExecutorError
+    idx_before = {ex.manager_id: ex.exec_index() for ex in execs}
+    lost = execs[1].manager_id
+    driver.remove_member(lost)
+    time.sleep(0.3)  # let the tombstone announce propagate
+    # surviving executors keep their indices
+    for ex in (execs[0], execs[2]):
+        assert ex.exec_index() == idx_before[ex.manager_id]
+        assert ex.members()[idx_before[lost]] == TOMBSTONE
+        with pytest.raises(DeadExecutorError):
+            ex.member_at(idx_before[lost])
+
+
+def test_negative_map_id_publish_ignored(cluster):
+    driver, execs, _ = cluster
+    from sparkrdma_tpu.parallel import messages as M
+    from sparkrdma_tpu.shuffle.map_output import DriverTable
+    driver.register_shuffle(77, num_maps=2)
+    conn = execs[0].driver_conn()
+    conn.send(M.PublishMsg(77, -1, DriverTable.pack_entry(9, 0)))
+    conn.send(M.PublishMsg(77, 2, DriverTable.pack_entry(9, 0)))
+    time.sleep(0.2)
+    table = driver._tables[77]
+    assert table.num_maps == 2 and table.num_published == 0
+    assert len(table.to_bytes()) == 2 * MAP_ENTRY_SIZE

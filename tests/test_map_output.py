@@ -81,3 +81,10 @@ def test_driver_table_roundtrip():
     assert clone.entry(1) is None
     assert clone.entry(3) == (22, 0)
     assert len(t.to_bytes()) == 4 * MAP_ENTRY_SIZE
+
+
+def test_driver_table_negative_offset_rejected():
+    t = DriverTable(4)
+    with pytest.raises(IndexError):
+        t.write_raw(-MAP_ENTRY_SIZE, DriverTable.pack_entry(1, 1))
+    assert len(t.to_bytes()) == 4 * MAP_ENTRY_SIZE

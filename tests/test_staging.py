@@ -1,0 +1,97 @@
+"""Spill staging tests (reference: java/RdmaMappedFile.java chunking/offset
+math 113-157 and partition serving 231-235; scatter-gather analogue of
+RdmaShuffleFetcherIterator.scala:119-180)."""
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.runtime.pool import BufferPool
+from sparkrdma_tpu.runtime.staging import SpillFile
+
+
+@pytest.fixture
+def spill(tmp_path):
+    """A spill file with 6 partitions of known content; partition p is filled
+    with byte value p+1 (partition 3 is empty)."""
+    lengths = [100, 5000, 0, 70000, 1, 300]
+    data = b"".join(bytes([p + 1]) * n for p, n in enumerate(lengths))
+    path = tmp_path / "shuffle_0_0.data"
+    path.write_bytes(data)
+    sf = SpillFile(str(path), lengths, file_token=99)
+    yield sf, lengths
+    sf.dispose()
+
+
+def test_map_output_locations(spill):
+    sf, lengths = spill
+    loc = sf.map_output.get_block_location(3)
+    assert loc.offset == 5100 and loc.length == 70000 and loc.buf == 99
+    assert sf.map_output.total_bytes == sum(lengths)
+
+
+def test_read_partition(spill):
+    sf, lengths = spill
+    for p, n in enumerate(lengths):
+        data = sf.read_partition(p)
+        assert len(data) == n
+        assert data == bytes([p + 1]) * n
+
+
+def test_gather_subset_multithreaded(spill):
+    sf, lengths = spill
+    ids = [1, 3, 5]
+    offs = sf.partition_offsets[ids]
+    lens = sf.partition_lengths[ids]
+    dst = np.zeros(int(lens.sum()), dtype=np.uint8)
+    n = sf.gather(offs, lens, dst, nthreads=4)
+    assert n == int(lens.sum())
+    expect = b"".join(bytes([p + 1]) * lengths[p] for p in ids)
+    assert dst.tobytes() == expect
+
+
+def test_gather_into_pool_buffer(spill):
+    sf, lengths = spill
+    pool = BufferPool(TpuShuffleConf(min_block_size="1k"))
+    buf = sf.gather_partitions([0, 4, 5], pool)
+    total = lengths[0] + lengths[4] + lengths[5]
+    assert buf.view[:total].tobytes() == (b"\x01" * 100 + b"\x05" + b"\x06" * 300)
+    buf.free()
+    pool.stop()
+
+
+def test_gather_bounds_checked(spill):
+    sf, _ = spill
+    dst = np.zeros(10, dtype=np.uint8)
+    with pytest.raises((IndexError, ValueError)):
+        sf.gather([10**9], [8], dst)
+
+
+def test_short_file_rejected(tmp_path):
+    path = tmp_path / "short.data"
+    path.write_bytes(b"xy")
+    with pytest.raises(ValueError):
+        SpillFile(str(path), [100], file_token=1)
+
+
+def test_dispose_deletes(tmp_path):
+    import os
+    path = tmp_path / "d.data"
+    path.write_bytes(b"a" * 64)
+    sf = SpillFile(str(path), [64], file_token=1)
+    sf.dispose()
+    assert not os.path.exists(str(path))
+
+
+def test_empty_gather(spill):
+    sf, _ = spill
+    dst = np.zeros(1, dtype=np.uint8)
+    assert sf.gather([], [], dst) == 0
+
+
+def test_gather_overflow_offsets_rejected(spill):
+    # offsets near 2^64 must not wrap the bounds check (native path)
+    sf, _ = spill
+    dst = np.zeros(0x2000, dtype=np.uint8)
+    with pytest.raises((IndexError, ValueError, OverflowError)):
+        sf.gather([0xFFFFFFFFFFFFF000], [0x2000], dst)
