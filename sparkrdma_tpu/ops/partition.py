@@ -1,0 +1,65 @@
+"""Partitioning ops: key -> destination assignment.
+
+The reference delegates partitioning to the host engine (Spark's
+``Partitioner``; the plugin only moves the resulting partition-contiguous
+bytes). A standalone TPU framework needs the partitioners in-tree, as
+jittable ops feeding ``parallel.exchange``:
+
+* ``hash_partition`` — the engine's default hash partitioner analogue.
+* ``range_partition`` + ``sample_splitters`` — the sampled range partitioner
+  TeraSort-style sorts use; splitter sampling is the tiny host-side step the
+  engine does once per job.
+
+All static-shape, MXU/VPU-friendly (vectorized compares, no host loops).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def hash_partition(keys: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
+    """Stateless integer hash -> partition id (i32)."""
+    k = keys.astype(jnp.uint32)
+    # Murmur3-style finalizer: good avalanche, cheap on VPU.
+    k = (k ^ (k >> 16)) * jnp.uint32(0x85EBCA6B)
+    k = (k ^ (k >> 13)) * jnp.uint32(0xC2B2AE35)
+    k = k ^ (k >> 16)
+    return (k % jnp.uint32(num_partitions)).astype(jnp.int32)
+
+
+def range_partition(keys: jnp.ndarray, splitters: jnp.ndarray) -> jnp.ndarray:
+    """Destination = number of splitters <= key (i32 in [0, len(splitters)])."""
+    return jnp.searchsorted(splitters, keys, side="right").astype(jnp.int32)
+
+
+def sample_splitters(sample: np.ndarray, num_partitions: int) -> np.ndarray:
+    """Choose ``num_partitions - 1`` splitters from a key sample (host-side,
+    once per job — the TeraSort recipe)."""
+    s = np.sort(np.asarray(sample))
+    if num_partitions <= 1 or len(s) == 0:
+        return np.zeros(0, dtype=s.dtype if len(s) else np.int64)
+    idx = (np.arange(1, num_partitions) * len(s)) // num_partitions
+    return s[np.minimum(idx, len(s) - 1)]
+
+
+def uniform_splitters(num_partitions: int, dtype=jnp.uint32) -> jnp.ndarray:
+    """Analytic splitters for keys uniform over the full dtype range —
+    avoids the sampling pass when the key distribution is known."""
+    info = jnp.iinfo(dtype)
+    span = int(info.max) - int(info.min) + 1
+    edges = [int(info.min) + (i * span) // num_partitions
+             for i in range(1, num_partitions)]
+    return jnp.array(edges, dtype=dtype)
+
+
+def partition_and_count(keys: jnp.ndarray, splitters: jnp.ndarray,
+                        num_partitions: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Destination ids + per-partition histogram in one pass."""
+    dest = range_partition(keys, splitters)
+    counts = jnp.bincount(dest, length=num_partitions).astype(jnp.int32)
+    return dest, counts

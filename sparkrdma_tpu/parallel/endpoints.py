@@ -73,6 +73,7 @@ class DriverEndpoint:
         self.server = ControlServer(bind_host, self.conf.driver_port, self.conf,
                                     self._handle, name="driver")
         self._members: List[ShuffleManagerId] = []
+        self._members_epoch = 0
         self._members_lock = threading.Lock()
         self._tables: Dict[int, DriverTable] = {}
         self._tables_lock = threading.Lock()
@@ -109,8 +110,9 @@ class DriverEndpoint:
         with self._members_lock:
             self._members = [TOMBSTONE if m == manager_id else m
                              for m in self._members]
-            snapshot = list(self._members)
-        threading.Thread(target=self._broadcast, args=(snapshot,),
+            self._members_epoch += 1
+            snapshot, epoch = list(self._members), self._members_epoch
+        threading.Thread(target=self._broadcast, args=(snapshot, epoch),
                          daemon=True, name="driver-announce").start()
 
     # -- message handling ------------------------------------------------
@@ -131,14 +133,15 @@ class DriverEndpoint:
         with self._members_lock:
             if manager_id not in self._members:
                 self._members.append(manager_id)
-            snapshot = list(self._members)
+            self._members_epoch += 1
+            snapshot, epoch = list(self._members), self._members_epoch
         # Broadcast the full ordered membership to everyone, async — the
         # driver connects out to each executor's control server.
-        threading.Thread(target=self._broadcast, args=(snapshot,),
+        threading.Thread(target=self._broadcast, args=(snapshot, epoch),
                          daemon=True, name="driver-announce").start()
 
-    def _broadcast(self, members: List[ShuffleManagerId]) -> None:
-        announce = AnnounceMsg(members)
+    def _broadcast(self, members: List[ShuffleManagerId], epoch: int) -> None:
+        announce = AnnounceMsg(members, epoch)
         for m in members:
             if m == TOMBSTONE:
                 continue
@@ -194,12 +197,12 @@ class ExecutorEndpoint:
         self.server = ControlServer(manager_id_host, self.conf.executor_port,
                                     self.conf, self._handle,
                                     name=f"exec-{executor}")
-        from sparkrdma_tpu.utils.ids import ExecutorId
         self.manager_id = ShuffleManagerId(
-            ExecutorId(executor, manager_id_host, engine_port),
+            _ExecutorId(executor, manager_id_host, engine_port),
             self.server.host, self.server.port)
         self._driver_addr = driver_addr
         self._members: List[ShuffleManagerId] = []
+        self._announce_epoch = -1
         self._members_event = threading.Event()
         self._members_lock = threading.Lock()
         self._clients = ConnectionCache(self.conf, on_message=self._handle)
@@ -256,10 +259,11 @@ class ExecutorEndpoint:
     def _handle(self, conn: Connection, msg: RpcMsg) -> Optional[RpcMsg]:
         if isinstance(msg, AnnounceMsg):
             with self._members_lock:
-                # Announce lists are append-only snapshots (slots only get
-                # tombstoned in place, never removed) — accept any list at
-                # least as long as ours so tombstone updates propagate.
-                if len(msg.manager_ids) >= len(self._members):
+                # Total order by driver epoch: stale snapshots (racing
+                # announce threads, reordered delivery) never overwrite a
+                # newer tombstoned list.
+                if msg.epoch > self._announce_epoch:
+                    self._announce_epoch = msg.epoch
                     self._members = list(msg.manager_ids)
             self._members_event.set()
             return None
@@ -312,10 +316,11 @@ class ExecutorEndpoint:
         """Fetch + poll until the expected publishes have landed
         (scala/RdmaShuffleManager.scala:341-376; wait budget
         partitionLocationFetchTimeout, scala/RdmaShuffleConf.scala:112-115).
-        Memoized per shuffle once complete."""
+        Memoized per shuffle only once ALL maps have published, so a later
+        call with a higher expectation never sees a stale partial table."""
         with self._table_lock:
             cached = self._table_cache.get(shuffle_id)
-        if cached is not None:
+        if cached is not None and cached.num_published >= expect_published:
             return cached
         tmo = (timeout if timeout is not None
                else self.conf.partition_location_fetch_timeout_ms / 1000)
@@ -327,8 +332,9 @@ class ExecutorEndpoint:
             assert isinstance(resp, M.FetchTableResp)
             if resp.num_published >= expect_published:
                 table = DriverTable.from_bytes(resp.table)
-                with self._table_lock:
-                    self._table_cache[shuffle_id] = table
+                if table.num_published == table.num_maps:
+                    with self._table_lock:
+                        self._table_cache[shuffle_id] = table
                 return table
             if time.monotonic() > deadline:
                 raise TimeoutError(

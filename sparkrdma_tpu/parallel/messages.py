@@ -52,21 +52,8 @@ class PublishMsg(RpcMsg):
         return cls(shuffle_id, map_id, payload[8:])
 
 
-@register(4)
-class AckMsg(RpcMsg):
-    """Generic ack with status (0 = ok)."""
-
-    def __init__(self, req_id: int, status: int = 0):
-        self.req_id = req_id
-        self.status = status
-
-    def payload(self) -> bytes:
-        return _QI.pack(self.req_id, self.status)
-
-    @classmethod
-    def from_payload(cls, payload: bytes) -> "AckMsg":
-        req_id, status = _QI.unpack_from(payload, 0)
-        return cls(req_id, status)
+# Wire type 4 reserved (was an ack; publish is one-sided like the
+# reference's RDMA WRITE, so nothing acks).
 
 
 @register(5)

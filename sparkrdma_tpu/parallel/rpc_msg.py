@@ -118,25 +118,33 @@ class HelloMsg(RpcMsg):
 @register(2)
 class AnnounceMsg(RpcMsg):
     """Driver → all executors membership broadcast
-    (scala/RdmaRpcMsg.scala:114-173)."""
+    (scala/RdmaRpcMsg.scala:114-173).
 
-    def __init__(self, manager_ids: List[ShuffleManagerId]):
+    ``epoch`` totally orders broadcasts: concurrent announce threads can
+    deliver out of order, and tombstoning changes list *content* without
+    changing length, so receivers keep the highest epoch, not the longest
+    list."""
+
+    def __init__(self, manager_ids: List[ShuffleManagerId], epoch: int = 0):
         self.manager_ids = list(manager_ids)
+        self.epoch = epoch
 
     def payload(self) -> bytes:
-        out = [struct.pack("<I", len(self.manager_ids))]
+        out = [struct.pack("<QI", self.epoch, len(self.manager_ids))]
         out += [m.serialize() for m in self.manager_ids]
         return b"".join(out)
 
     @classmethod
     def from_payload(cls, payload: bytes) -> "AnnounceMsg":
-        (n,) = struct.unpack_from("<I", payload, 0)
-        off = 4
+        epoch, n = struct.unpack_from("<QI", payload, 0)
+        off = 12
         ids = []
         for _ in range(n):
             mid, off = ShuffleManagerId.deserialize(payload, off)
             ids.append(mid)
-        return cls(ids)
+        return cls(ids, epoch)
 
     def __eq__(self, other):
-        return isinstance(other, AnnounceMsg) and self.manager_ids == other.manager_ids
+        return (isinstance(other, AnnounceMsg)
+                and self.manager_ids == other.manager_ids
+                and self.epoch == other.epoch)

@@ -218,16 +218,20 @@ class BufferPool:
         return self._use_native
 
     def get(self, size: int) -> PoolBuffer:
-        if self._use_native:
-            token = native.LIB.arena_get(self._h, max(size, 1))
-            if token < 0:
-                raise MemoryError(f"arena allocation of {size} bytes failed")
-            bin_size = native.LIB.arena_buf_size(self._h, token)
-            ptr = native.LIB.arena_buf_ptr(self._h, token)
-            raw = (ctypes.c_uint8 * bin_size).from_address(ptr)
-            view = np.frombuffer(raw, dtype=np.uint8)
-        else:
-            with self._lock:
+        # self._lock guards handle lifetime against concurrent stop(); the
+        # arena's own mutex guards its internal state.
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("pool is stopped")
+            if self._use_native:
+                token = native.LIB.arena_get(self._h, max(size, 1))
+                if token < 0:
+                    raise MemoryError(f"arena allocation of {size} bytes failed")
+                bin_size = native.LIB.arena_buf_size(self._h, token)
+                ptr = native.LIB.arena_buf_ptr(self._h, token)
+                raw = (ctypes.c_uint8 * bin_size).from_address(ptr)
+                view = np.frombuffer(raw, dtype=np.uint8)
+            else:
                 token = self._py.get(size)
                 bin_size = self._py.size(token)
                 view = self._py.view(token)
@@ -237,45 +241,61 @@ class BufferPool:
         return RegisteredBuffer(self, size)
 
     def _release(self, buf: PoolBuffer) -> None:
-        if self._stopped:
-            return  # late frees after stop() are inert (lease views dangle)
-        if self._use_native:
-            rc = native.LIB.arena_put(self._h, buf.token)
-            if rc != 0:
-                raise RuntimeError(f"arena_put({buf.token}) failed: {rc}")
-        else:
-            with self._lock:
+        with self._lock:
+            if self._stopped:
+                return  # late frees after stop() are inert (views dangle)
+            if self._use_native:
+                rc = native.LIB.arena_put(self._h, buf.token)
+                if rc != 0:
+                    raise RuntimeError(f"arena_put({buf.token}) failed: {rc}")
+            else:
                 self._py.put(buf.token)
 
     def preallocate(self, size: int, count: int) -> None:
-        if self._use_native:
-            rc = native.LIB.arena_preallocate(self._h, size, count)
-            if rc != 0:
-                raise MemoryError("preallocation failed")
-        else:
-            with self._lock:
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("pool is stopped")
+            if self._use_native:
+                rc = native.LIB.arena_preallocate(self._h, size, count)
+                if rc != 0:
+                    raise MemoryError("preallocation failed")
+            else:
                 self._py.preallocate(size, count)
 
     def trim(self, target_idle: int = 0) -> None:
-        if self._use_native:
-            native.LIB.arena_trim(self._h, target_idle)
-        else:
-            with self._lock:
+        with self._lock:
+            if self._stopped:
+                return
+            if self._use_native:
+                native.LIB.arena_trim(self._h, target_idle)
+            else:
                 self._py.trim(target_idle)
 
     @property
     def total_bytes(self) -> int:
-        if self._use_native:
-            return native.LIB.arena_total_bytes(self._h)
-        return self._py.total_bytes
+        with self._lock:
+            if self._stopped:
+                return 0
+            if self._use_native:
+                return native.LIB.arena_total_bytes(self._h)
+            return self._py.total_bytes
 
     @property
     def idle_bytes(self) -> int:
-        if self._use_native:
-            return native.LIB.arena_idle_bytes(self._h)
-        return self._py.idle_bytes
+        with self._lock:
+            if self._stopped:
+                return 0
+            if self._use_native:
+                return native.LIB.arena_idle_bytes(self._h)
+            return self._py.idle_bytes
 
     def stats(self) -> dict:
+        with self._lock:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
+        if self._stopped:
+            return {}
         if self._use_native:
             cap = 1 << 16
             out = ctypes.create_string_buffer(cap)
@@ -294,17 +314,16 @@ class BufferPool:
         views must not be touched (the backing memory is gone on the native
         path).
         """
-        if self._stopped:
-            return {}
-        snapshot = self.stats()
-        self._stopped = True
-        if self._use_native:
-            with self._lock:
+        with self._lock:
+            if self._stopped:
+                return {}
+            snapshot = self._stats_locked()
+            self._stopped = True
+            if self._use_native:
                 if self._h is not None:
                     native.LIB.arena_destroy(self._h)
                     self._h = None
-            self._use_native = False
-            self._py = _PyArena(0, self.min_block, False)  # inert post-stop
-        else:
-            self._py.destroy()
+                self._use_native = False
+            else:
+                self._py.destroy()
         return snapshot

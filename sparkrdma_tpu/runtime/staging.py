@@ -39,6 +39,11 @@ class SpillFile:
         self.file_token = file_token
         self._delete = delete_on_dispose
         lengths = np.asarray(partition_lengths, dtype=np.uint64)
+        if len(lengths) and int(lengths.max()) > 0xFFFFFFFF:
+            # the 16B wire entry stores u32 lengths (reference parity,
+            # scala/RdmaMapTaskOutput.scala:25); refuse rather than wrap
+            raise ValueError("partition length exceeds 4 GiB entry limit; "
+                             "split partitions or raise write parallelism")
         offsets = np.zeros(len(lengths), dtype=np.uint64)
         if len(lengths) > 1:
             offsets[1:] = np.cumsum(lengths[:-1])

@@ -127,13 +127,17 @@ class DriverTable:
             raise ValueError("num_maps must be positive")
         self.num_maps = num_maps
         self._buf = bytearray(num_maps * MAP_ENTRY_SIZE)
+        self._published = 0  # O(1) count for the poll-heavy fetch path
         for m in range(num_maps):
             _MAP_ENTRY.pack_into(self._buf, m * MAP_ENTRY_SIZE, 0, UNPUBLISHED)
 
     def publish(self, map_id: int, table_token: int, exec_index: int) -> None:
         if not 0 <= map_id < self.num_maps:
             raise IndexError(f"map_id {map_id} out of range [0, {self.num_maps})")
+        was = self.entry(map_id) is not None
         _MAP_ENTRY.pack_into(self._buf, map_id * MAP_ENTRY_SIZE, table_token, exec_index)
+        if not was and self.entry(map_id) is not None:
+            self._published += 1
 
     def write_raw(self, byte_offset: int, payload: bytes) -> None:
         """The one-sided-WRITE analogue: blind positional write into the table
@@ -142,7 +146,12 @@ class DriverTable:
             raise ValueError("unaligned driver-table write")
         if byte_offset < 0 or byte_offset + len(payload) > len(self._buf):
             raise IndexError("driver-table write out of bounds")
+        first = byte_offset // MAP_ENTRY_SIZE
+        n = len(payload) // MAP_ENTRY_SIZE
+        was = sum(1 for m in range(first, first + n) if self.entry(m) is not None)
         self._buf[byte_offset:byte_offset + len(payload)] = payload
+        now = sum(1 for m in range(first, first + n) if self.entry(m) is not None)
+        self._published += now - was
 
     def entry(self, map_id: int):
         token, exec_index = _MAP_ENTRY.unpack_from(self._buf, map_id * MAP_ENTRY_SIZE)
@@ -150,7 +159,7 @@ class DriverTable:
 
     @property
     def num_published(self) -> int:
-        return sum(1 for m in range(self.num_maps) if self.entry(m) is not None)
+        return self._published
 
     def to_bytes(self) -> bytes:
         return bytes(self._buf)
@@ -161,6 +170,7 @@ class DriverTable:
             raise ValueError("bad driver-table payload")
         t = DriverTable(len(payload) // MAP_ENTRY_SIZE)
         t._buf[:] = payload
+        t._published = sum(1 for m in range(t.num_maps) if t.entry(m) is not None)
         return t
 
     @staticmethod

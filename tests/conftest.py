@@ -5,11 +5,19 @@ split into N virtual devices, which exercises the same SPMD partitioner and
 collective lowering paths the TPU backend uses. This stands in for the
 multi-node cluster runs the reference was only ever validated on
 (reference: no src/test at all — see SURVEY.md §4).
+
+Note: the session's sitecustomize registers the real TPU backend and pins
+``jax_platforms`` via jax config (env vars alone don't win), so we override
+the config after import — backends initialize lazily, so this takes effect
+as long as it runs before any ``jax.devices()`` call.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

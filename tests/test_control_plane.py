@@ -194,3 +194,19 @@ def test_negative_map_id_publish_ignored(cluster):
     table = driver._tables[77]
     assert table.num_maps == 2 and table.num_published == 0
     assert len(table.to_bytes()) == 2 * MAP_ENTRY_SIZE
+
+
+def test_partial_table_not_memoized(cluster):
+    driver, execs, _ = cluster
+    driver.register_shuffle(55, num_maps=3)
+    execs[0].publish_map_output(55, 0, table_token=1)
+    partial = execs[2].get_driver_table(55, expect_published=1, timeout=5)
+    assert partial.num_published >= 1
+    # a later, stricter expectation must NOT be served the partial snapshot
+    execs[0].publish_map_output(55, 1, table_token=2)
+    execs[1].publish_map_output(55, 2, table_token=3)
+    full = execs[2].get_driver_table(55, expect_published=3, timeout=5)
+    assert full.num_published == 3
+    # complete table is memoized
+    again = execs[2].get_driver_table(55, expect_published=3, timeout=5)
+    assert again is full

@@ -80,3 +80,26 @@ def test_bad_frames():
     msg = HelloMsg(_mid(1)).encode()
     with pytest.raises(ValueError):
         decode_message(msg + b"extra")
+
+
+def test_announce_epoch_roundtrip():
+    msg = AnnounceMsg([_mid(1)], epoch=42)
+    decoded = decode_message(msg.encode())
+    assert decoded.epoch == 42 and decoded == msg
+
+
+def test_stale_epoch_announce_ignored():
+    from sparkrdma_tpu.config import TpuShuffleConf
+    from sparkrdma_tpu.parallel.endpoints import ExecutorEndpoint, DriverEndpoint
+    conf = TpuShuffleConf()
+    driver = DriverEndpoint(conf)
+    ex = ExecutorEndpoint("127.0.0.1", "0", driver.address, conf=conf)
+    try:
+        fresh = AnnounceMsg([_mid(1), _mid(2)], epoch=5)
+        stale = AnnounceMsg([_mid(9)], epoch=3)
+        ex._handle(None, fresh)
+        ex._handle(None, stale)  # must not overwrite
+        assert ex.members() == fresh.manager_ids
+    finally:
+        ex.stop()
+        driver.stop()

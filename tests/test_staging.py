@@ -95,3 +95,10 @@ def test_gather_overflow_offsets_rejected(spill):
     dst = np.zeros(0x2000, dtype=np.uint8)
     with pytest.raises((IndexError, ValueError, OverflowError)):
         sf.gather([0xFFFFFFFFFFFFF000], [0x2000], dst)
+
+
+def test_partition_over_4gib_rejected(tmp_path):
+    path = tmp_path / "big.data"
+    path.write_bytes(b"x")
+    with pytest.raises(ValueError, match="4 GiB"):
+        SpillFile(str(path), [5 << 30], file_token=1)
