@@ -1,0 +1,98 @@
+"""Benchmark: TeraSort shuffle throughput on the available TPU chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Metric: steady-state shuffle GB/s/chip through the full jitted
+partition + ragged-exchange + local-sort round on ~1 GiB of classic 100-byte
+TeraSort rows (BASELINE.json config #1 scale). ``vs_baseline`` is the
+speedup over the identical pipeline in numpy on the host CPU — the
+single-host stock sort-shuffle stand-in the reference was compared against
+(README.md:11-17; BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    size_mb = int(os.environ.get("BENCH_SIZE_MB", "1024"))
+    reps = int(os.environ.get("BENCH_REPS", "5"))
+
+    import jax
+    from jax.sharding import Mesh
+
+    from sparkrdma_tpu.models.terasort import (
+        TeraSortConfig,
+        generate_rows,
+        make_terasort_step,
+        numpy_terasort,
+        verify_terasort,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    row_bytes = 100  # 1 key word + 24 payload words
+    rows_per_device = (size_mb << 20) // row_bytes // n
+    cfg = TeraSortConfig(rows_per_device=rows_per_device, payload_words=24,
+                         out_factor=1 if n == 1 else 2)
+    mesh = Mesh(np.array(devs), ("shuffle",))
+    rows = generate_rows(cfg, n, seed=0)
+    total_bytes = rows.nbytes
+
+    step = make_terasort_step(mesh, "shuffle", cfg)
+    rows_d = jax.device_put(rows, NamedSharding(mesh, P("shuffle")))
+    # Warm until steady: under remote-compile backends the first dispatch's
+    # block_until_ready can return before compilation finishes, so warmup
+    # must materialize host-side, twice.
+    for _ in range(2):
+        _, counts, _of = step(rows_d)
+        np.asarray(counts)
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out, counts, overflowed = jax.block_until_ready(step(rows_d))
+        times.append(time.perf_counter() - t0)
+    tpu_dt = min(times)
+    assert not np.asarray(overflowed).any(), "receive-buffer overflow in bench"
+
+    # spot-verify on a subsample to keep bench time bounded
+    small_cfg = TeraSortConfig(rows_per_device=4096, payload_words=24,
+                               out_factor=cfg.out_factor)
+    small_rows = generate_rows(small_cfg, n, seed=1)
+    small_step = make_terasort_step(mesh, "shuffle", small_cfg)
+    s_out, s_counts, _ = jax.block_until_ready(
+        small_step(jax.device_put(small_rows, NamedSharding(mesh, P("shuffle")))))
+    verify_terasort(np.asarray(s_out), np.asarray(s_counts), small_rows, n)
+
+    # CPU baseline: identical pipeline, numpy, same data
+    t0 = time.perf_counter()
+    _ = numpy_terasort(rows, max(n, 8))
+    cpu_dt = time.perf_counter() - t0
+
+    gbps_per_chip = total_bytes / tpu_dt / 1e9 / n
+    result = {
+        "metric": "terasort_shuffle_throughput_per_chip",
+        "value": round(gbps_per_chip, 3),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(cpu_dt / tpu_dt, 3),
+        "detail": {
+            "data_bytes": total_bytes,
+            "devices": n,
+            "tpu_step_s": round(tpu_dt, 4),
+            "cpu_baseline_s": round(cpu_dt, 4),
+            "platform": devs[0].platform,
+            "device_kind": devs[0].device_kind,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+"""TeraSort: the flagship workload.
+
+The reference's headline benchmark is TeraSort-320GB, 2.63× faster than
+Spark's TCP shuffle on InfiniBand FDR (README.md:11-17; BASELINE.md). It is
+the canonical shuffle stress: every byte crosses the network exactly once.
+
+TPU-native design — the whole map/shuffle/reduce cycle is ONE jitted SPMD
+step per round:
+
+1. **partition**: analytic or sampled range splitters; ``range_partition``
+   assigns each row a destination device (VPU compares, no host loop).
+2. **exchange**: ``shuffle_shard`` — size pre-exchange + ragged all-to-all
+   over ICI (see ``parallel.exchange``). Rows are ``[N, 1+P]`` uint32
+   matrices (key word + P payload words), so the collective moves one dense
+   buffer.
+3. **local sort**: co-sort received rows by key (padded rows sort to the
+   end via the key-max sentinel).
+
+The result is globally sorted by (device order, local order) — the same
+contract as TeraSort's output files. A numpy reference pipeline provides the
+CPU baseline (the "stock local sort-shuffle" stand-in, BASELINE.json
+config #1).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.ops.partition import range_partition, uniform_splitters
+from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
+
+
+@dataclass(frozen=True)
+class TeraSortConfig:
+    rows_per_device: int
+    payload_words: int = 24  # 4B key word + 24*4B payload ≈ the classic 100B row
+    out_factor: int = 2      # receive headroom (uniform keys -> mild skew)
+
+    @property
+    def row_bytes(self) -> int:
+        return 4 * (1 + self.payload_words)
+
+
+def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
+                       impl: str = "auto"):
+    """Build the jitted one-round TeraSort step over ``mesh``.
+
+    Takes ``rows: u32[D*rows_per_device, 1+P]`` sharded on the leading axis
+    (column 0 is the key); returns ``(sorted_rows, recv_counts[D, D],
+    overflowed[D])`` with rows per device sorted by key, padding
+    (key=0xFFFFFFFF) at the end. ``overflowed[d]`` flags that device d's
+    receive buffer was too small for the skew (results there are truncated
+    and must not be trusted — raise ``out_factor`` or chunk the round).
+    """
+    n = mesh.shape[axis_name]
+    impl = resolve_impl(mesh, impl)
+    splitters = uniform_splitters(n, jnp.uint32)
+    spec = P(axis_name)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(spec,), out_specs=(spec, spec, spec))
+    def step(rows):
+        keys = rows[:, 0]
+        dest = range_partition(keys, splitters)
+        output = jnp.zeros((rows.shape[0] * cfg.out_factor, rows.shape[1]),
+                           dtype=rows.dtype)
+        received, recv_counts, _ = shuffle_shard(
+            rows, dest, axis_name, n, output=output, impl=impl)
+        # local sort by key; padding rows get the max-key sentinel
+        total = recv_counts.sum()
+        overflowed = total > output.shape[0]
+        valid = jnp.arange(received.shape[0], dtype=jnp.int32) < total
+        sentinel = jnp.uint32(0xFFFFFFFF)
+        sort_keys = jnp.where(valid, received[:, 0], sentinel)
+        order = jnp.argsort(sort_keys, stable=True)
+        sorted_rows = jnp.take(received, order, axis=0)
+        sorted_rows = sorted_rows.at[:, 0].set(jnp.sort(sort_keys))
+        return sorted_rows, recv_counts[None], overflowed[None]
+
+    return step
+
+
+def generate_rows(cfg: TeraSortConfig, num_devices: int,
+                  seed: int = 0) -> np.ndarray:
+    """Uniform random TeraSort input: u32 keys + incompressible payload."""
+    rng = np.random.default_rng(seed)
+    n = num_devices * cfg.rows_per_device
+    rows = rng.integers(0, 2**32, size=(n, 1 + cfg.payload_words),
+                        dtype=np.uint32)
+    return rows
+
+
+def numpy_terasort(rows: np.ndarray, num_partitions: int) -> np.ndarray:
+    """CPU baseline: the identical partition/shuffle/sort pipeline in numpy
+    (the single-host stock sort-shuffle stand-in, BASELINE.json config #1)."""
+    keys = rows[:, 0]
+    edges = np.array([(i * (1 << 32)) // num_partitions
+                      for i in range(1, num_partitions)], dtype=np.uint64)
+    dest = np.searchsorted(edges, keys.astype(np.uint64), side="right")
+    # "shuffle": group rows by destination partition (the data movement)
+    order = np.argsort(dest, kind="stable")
+    grouped = rows[order]
+    counts = np.bincount(dest, minlength=num_partitions)
+    # per-partition local sort
+    out = np.empty_like(grouped)
+    start = 0
+    for c in counts:
+        seg = grouped[start:start + c]
+        out[start:start + c] = seg[np.argsort(seg[:, 0], kind="stable")]
+        start += c
+    return out
+
+
+def run_terasort(mesh: Mesh, cfg: TeraSortConfig, axis_name: str = "shuffle",
+                 impl: str = "auto", seed: int = 0,
+                 rows: Optional[np.ndarray] = None,
+                 ) -> Tuple[np.ndarray, np.ndarray, float]:
+    """Host driver: generate, run one jitted round, return
+    (sorted_rows_by_device, counts, step_seconds). Compile excluded."""
+    n = mesh.shape[axis_name]
+    if rows is None:
+        rows = generate_rows(cfg, n, seed)
+    step = make_terasort_step(mesh, axis_name, cfg, impl)
+    sharding = NamedSharding(mesh, P(axis_name))
+    rows_d = jax.device_put(rows, sharding)
+    # compile + warm
+    out, counts, overflowed = jax.block_until_ready(step(rows_d))
+    t0 = time.perf_counter()
+    out, counts, overflowed = jax.block_until_ready(step(rows_d))
+    dt = time.perf_counter() - t0
+    if np.asarray(overflowed).any():
+        raise OverflowError(
+            "receive buffer overflow: key skew exceeds out_factor headroom "
+            f"(devices {np.nonzero(np.asarray(overflowed).ravel())[0].tolist()}); "
+            "raise TeraSortConfig.out_factor or chunk the round")
+    return np.asarray(out), np.asarray(counts), dt
+
+
+def verify_terasort(sorted_rows: np.ndarray, counts: np.ndarray,
+                    input_rows: np.ndarray, num_devices: int) -> None:
+    """Check the global sort contract against the input multiset."""
+    per_dev = sorted_rows.reshape(num_devices, -1, sorted_rows.shape[-1])
+    got_keys = []
+    prev_max = -1
+    for d in range(num_devices):
+        total = int(counts[d].sum())
+        keys = per_dev[d][:total, 0].astype(np.int64)
+        if len(keys):
+            assert (np.diff(keys) >= 0).all(), f"device {d} not locally sorted"
+            assert keys[0] >= prev_max, f"device {d} overlaps previous range"
+            prev_max = keys[-1]
+        got_keys.append(keys)
+    got = np.concatenate(got_keys)
+    assert len(got) == len(input_rows), "row count mismatch"
+    np.testing.assert_array_equal(np.sort(got),
+                                  np.sort(input_rows[:, 0].astype(np.int64)))

@@ -239,13 +239,20 @@ class ExecutorEndpoint:
         raise TimeoutError(f"membership did not reach {n} "
                            f"(have {len(self.members())})")
 
-    def exec_index(self) -> int:
-        """This executor's stable index in the membership order."""
-        with self._members_lock:
-            for i, m in enumerate(self._members):
-                if m == self.manager_id:
-                    return i
-        raise KeyError("executor not yet announced")
+    def exec_index(self, timeout: float = 0.0) -> int:
+        """This executor's stable index in the membership order. With a
+        timeout, waits for the driver's announce to arrive (publishers may
+        race the hello/announce round trip)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._members_lock:
+                for i, m in enumerate(self._members):
+                    if m == self.manager_id:
+                        return i
+            if time.monotonic() >= deadline:
+                raise KeyError("executor not yet announced")
+            self._members_event.wait(timeout=0.05)
+            self._members_event.clear()
 
     def member_at(self, index: int) -> ShuffleManagerId:
         with self._members_lock:
@@ -306,7 +313,9 @@ class ExecutorEndpoint:
     def publish_map_output(self, shuffle_id: int, map_id: int,
                            table_token: int) -> None:
         """(scala/RdmaShuffleManager.scala:384-418)."""
-        entry = DriverTable.pack_entry(table_token, self.exec_index())
+        entry = DriverTable.pack_entry(
+            table_token,
+            self.exec_index(timeout=self.conf.connect_timeout_ms / 1000))
         conn = self.driver_conn()
         msg = M.PublishMsg(shuffle_id, map_id, entry)
         conn.send(msg)
@@ -342,6 +351,12 @@ class ExecutorEndpoint:
                     f"{expect_published} map outputs published")
             time.sleep(delay)
             delay = min(delay * 2, 0.25)
+
+    def invalidate_shuffle(self, shuffle_id: int) -> None:
+        """Drop the memoized driver table (shuffle unregistered; ids can
+        be reused by the engine)."""
+        with self._table_lock:
+            self._table_cache.pop(shuffle_id, None)
 
     def fetch_output_range(self, peer: ShuffleManagerId, shuffle_id: int,
                            map_id: int, start: int, end: int):

@@ -1,0 +1,188 @@
+"""TpuShuffleManager: the engine-facing plugin hub.
+
+Re-design of ``scala/RdmaShuffleManager.scala`` keeping its API shape —
+``register_shuffle / get_writer / get_reader / unregister_shuffle / stop``
+(:143-310) — so an engine swaps shuffle implementations with one config line
+(README.md:69-71 analogue).
+
+Role split matches the reference: the driver allocates per-shuffle tables
+and runs membership (:38-140, 155-183); executors lazily boot their
+endpoint + hello on first writer/reader (:186-232) — here the boot happens
+in ``__init__`` since there's no engine-imposed laziness to preserve, and a
+single process may host the driver role, an executor role, or both (the
+reference forbids local mode, :154, because in-process RDMA is pointless;
+an in-process multi-executor TPU cluster is, by contrast, the primary
+single-host deployment, so it is supported, not rejected).
+
+The shuffle **handle** carries everything a task needs — ids, sizes, row
+width, partitioner spec — the way the reference's handles piggyback the
+driver table's (address, length, rkey) through task serialization
+(scala/RdmaUtils.scala:145-159).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.ops import partition as partition_ops
+from sparkrdma_tpu.parallel.endpoints import DriverEndpoint, ExecutorEndpoint
+from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
+from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
+from sparkrdma_tpu.shuffle.writer import Partitioner, TpuShuffleWriter
+
+
+@dataclass(frozen=True)
+class PartitionerSpec:
+    """Serializable partitioner description (handles cross process
+    boundaries; callables don't)."""
+
+    kind: str  # "hash" | "range" | "modulo"
+    splitters: Optional[Tuple[int, ...]] = None
+
+    def build(self, num_partitions: int) -> Partitioner:
+        if self.kind == "hash":
+            return lambda keys: np.asarray(
+                partition_ops.hash_partition(
+                    np.asarray(keys, dtype=np.uint32), num_partitions))
+        if self.kind == "range":
+            splitters = np.asarray(self.splitters, dtype=np.uint64)
+            return lambda keys: np.searchsorted(
+                splitters, np.asarray(keys), side="right").astype(np.int64)
+        if self.kind == "modulo":
+            return lambda keys: (np.asarray(keys) % num_partitions).astype(np.int64)
+        raise ValueError(f"unknown partitioner kind {self.kind!r}")
+
+
+@dataclass(frozen=True)
+class ShuffleHandle:
+    """(scala/RdmaUtils.scala:145-159 analogue)."""
+
+    shuffle_id: int
+    num_maps: int
+    num_partitions: int
+    row_payload_bytes: int
+    partitioner: PartitionerSpec
+
+
+class TpuShuffleManager:
+    """One per process; ``is_driver`` and/or executor role."""
+
+    def __init__(self, conf: Optional[TpuShuffleConf] = None,
+                 is_driver: bool = False,
+                 driver_addr: Optional[Tuple[str, int]] = None,
+                 host: str = "127.0.0.1", executor_id: str = "driver",
+                 spill_dir: Optional[str] = None,
+                 num_executors_hint: int = 0):
+        self.conf = conf or TpuShuffleConf()
+        self.is_driver = is_driver
+        self.driver: Optional[DriverEndpoint] = None
+        self.executor: Optional[ExecutorEndpoint] = None
+        self.resolver: Optional[TpuShuffleBlockResolver] = None
+        self._handles: Dict[int, ShuffleHandle] = {}
+        self._lock = threading.Lock()
+
+        if is_driver:
+            self.driver = DriverEndpoint(self.conf, host=host)
+            driver_addr = self.driver.address
+        if driver_addr is None:
+            raise ValueError("executor role needs driver_addr")
+        self.driver_addr = driver_addr
+
+        if executor_id != "driver":
+            spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpushuffle_")
+            self.resolver = TpuShuffleBlockResolver(spill_dir)
+            self.executor = ExecutorEndpoint(host, executor_id, driver_addr,
+                                             data_source=self.resolver,
+                                             conf=self.conf)
+            self.executor.start()
+            if num_executors_hint:
+                self.executor.wait_for_members(num_executors_hint)
+
+    # -- engine SPI ------------------------------------------------------
+
+    def register_shuffle(self, shuffle_id: int, num_maps: int,
+                         num_partitions: int,
+                         partitioner: PartitionerSpec,
+                         row_payload_bytes: int = 0) -> ShuffleHandle:
+        """Driver-side (scala/RdmaShuffleManager.scala:143-183)."""
+        if self.driver is None:
+            raise RuntimeError("register_shuffle is a driver-role call")
+        self.driver.register_shuffle(shuffle_id, num_maps)
+        handle = ShuffleHandle(shuffle_id, num_maps, num_partitions,
+                               row_payload_bytes, partitioner)
+        with self._lock:
+            self._handles[shuffle_id] = handle
+        return handle
+
+    def get_writer(self, handle: ShuffleHandle, map_id: int) -> "_PublishingWriter":
+        """(scala/RdmaShuffleManager.scala:263-291)."""
+        if self.executor is None or self.resolver is None:
+            raise RuntimeError("get_writer is an executor-role call")
+        inner = TpuShuffleWriter(
+            self.resolver, handle.shuffle_id, map_id, handle.num_partitions,
+            handle.partitioner.build(handle.num_partitions),
+            handle.row_payload_bytes)
+        return _PublishingWriter(inner, self.executor)
+
+    def get_reader(self, handle: ShuffleHandle, start_partition: int,
+                   end_partition: int) -> TpuShuffleReader:
+        """(scala/RdmaShuffleManager.scala:234-261)."""
+        if self.executor is None:
+            raise RuntimeError("get_reader is an executor-role call")
+        return TpuShuffleReader(self.executor, self.resolver, self.conf,
+                                handle.shuffle_id, handle.num_maps,
+                                start_partition, end_partition,
+                                handle.row_payload_bytes)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        """(scala/RdmaShuffleManager.scala:293-299)."""
+        if self.driver is not None:
+            self.driver.unregister_shuffle(shuffle_id)
+        if self.executor is not None:
+            self.executor.invalidate_shuffle(shuffle_id)
+        if self.resolver is not None:
+            self.resolver.remove_shuffle(shuffle_id)
+        with self._lock:
+            self._handles.pop(shuffle_id, None)
+
+    def stop(self) -> None:
+        """(scala/RdmaShuffleManager.scala:301-310)."""
+        if self.executor is not None:
+            self.executor.stop()
+        if self.resolver is not None:
+            self.resolver.stop()
+        if self.driver is not None:
+            self.driver.stop()
+
+
+class _PublishingWriter:
+    """Writer wrapper that publishes the map output on successful close
+    (RdmaWrapperShuffleWriter.scala:104-122)."""
+
+    def __init__(self, inner: TpuShuffleWriter, endpoint: ExecutorEndpoint):
+        self._inner = inner
+        self._endpoint = endpoint
+
+    def write_batch(self, keys, payload=None) -> None:
+        self._inner.write_batch(keys, payload)
+
+    def close(self, success: bool = True):
+        result = self._inner.close(success)
+        if result is None:
+            return None
+        token, partition_lengths = result
+        self._endpoint.publish_map_output(self._inner.shuffle_id,
+                                          self._inner.map_id, token)
+        return token, partition_lengths
+
+    @property
+    def metrics(self):
+        return {"bytes_written": self._inner.bytes_written,
+                "records_written": self._inner.records_written}

@@ -1,0 +1,75 @@
+"""Shuffle reader: drain the fetcher into record batches.
+
+Re-design of ``scala/RdmaShuffleReader.scala``: builds the fetcher iterator,
+decodes streams into records, and optionally aggregates / sorts the combined
+output (:43-115 — deserialize, aggregate, ExternalSorter when keyOrdering).
+Compression/encryption stream wrapping (:54-69) has no analogue: rows are
+fixed-width binary already.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel.endpoints import ExecutorEndpoint
+from sparkrdma_tpu.shuffle.fetcher import ReadMetrics, ShuffleFetcher
+from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
+from sparkrdma_tpu.shuffle.writer import decode_rows
+
+Batch = Tuple[np.ndarray, np.ndarray]  # (keys u64[N], payload u8[N, W])
+
+
+class TpuShuffleReader:
+    """One reducer's reader over partitions [start, end)."""
+
+    def __init__(self, endpoint: ExecutorEndpoint,
+                 resolver: Optional[TpuShuffleBlockResolver],
+                 conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
+                 start_partition: int, end_partition: int,
+                 row_payload_bytes: int):
+        self.row_payload_bytes = row_payload_bytes
+        self.fetcher = ShuffleFetcher(endpoint, resolver, conf, shuffle_id,
+                                      num_maps, start_partition, end_partition)
+
+    @property
+    def metrics(self) -> ReadMetrics:
+        return self.fetcher.metrics
+
+    def read(self) -> Iterator[Batch]:
+        """Record batches in arrival order (one per grouped fetch)."""
+        self.fetcher.start()
+        try:
+            for result in self.fetcher:
+                if result.data:
+                    yield decode_rows(result.data, self.row_payload_bytes)
+        finally:
+            # releases budget waiters + peer threads if the consumer stops
+            # early (GeneratorExit) or a fetch failed
+            self.fetcher.close()
+
+    def read_all(self) -> Batch:
+        """Materialize every record of the partition range."""
+        keys_parts, payload_parts = [], []
+        for keys, payload in self.read():
+            keys_parts.append(keys)
+            payload_parts.append(payload)
+        if not keys_parts:
+            return (np.zeros(0, dtype=np.uint64),
+                    np.zeros((0, self.row_payload_bytes), dtype=np.uint8))
+        return np.concatenate(keys_parts), np.concatenate(payload_parts)
+
+    def read_sorted(self) -> Batch:
+        """Full sort by key (the ExternalSorter role,
+        scala/RdmaShuffleReader.scala:100-114)."""
+        keys, payload = self.read_all()
+        order = np.argsort(keys, kind="stable")
+        return keys[order], payload[order]
+
+    def read_aggregated(self, combine: Callable[[np.ndarray, np.ndarray], Batch]
+                        ) -> Batch:
+        """Aggregate with a vectorized combiner (sorted-run reduction)."""
+        keys, payload = self.read_sorted()
+        return combine(keys, payload)

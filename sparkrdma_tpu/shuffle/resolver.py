@@ -1,0 +1,131 @@
+"""Shuffle block resolver: owns staged map-output data on one executor.
+
+Re-design of ``scala/RdmaShuffleBlockResolver.scala`` + the data-ownership
+half of ``writer/wrapper/RdmaWrapperShuffleWriter.scala`` (its
+``RdmaWrapperShuffleData`` owns ``mapId -> RdmaMappedFile``, :36):
+
+* ``commit`` renames the written temp file over the data file and maps it
+  for serving (rename-commit, RdmaWrapperShuffleWriter.scala:58-63;
+  mapping + location-table fill, RdmaMappedFile.java:95-157),
+* remote peers read locations and bytes through the ``ShuffleDataSource``
+  protocol the control plane serves
+  (scala/RdmaShuffleBlockResolver.scala:73-78 serves local partitions;
+  remote reads bypass the resolver in the reference because the NIC serves
+  them — here the executor endpoint calls back into the resolver),
+* ``remove_shuffle`` disposes mappings and deletes files
+  (scala/RdmaShuffleBlockResolver.scala:45-53).
+
+File **tokens** are executor-unique ints naming each committed spill file —
+the role the registered MR's rkey plays in the reference.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.runtime.staging import SpillFile
+from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+
+
+class TpuShuffleBlockResolver:
+    """shuffle_id -> map_id -> committed SpillFile; implements
+    ShuffleDataSource for the executor's control server."""
+
+    def __init__(self, spill_dir: str):
+        self.spill_dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        self._shuffles: Dict[int, Dict[int, SpillFile]] = {}
+        self._by_token: Dict[int, SpillFile] = {}
+        self._lock = threading.Lock()
+        self._tokens = itertools.count(1)
+        self._attempts = itertools.count(1)
+
+    # -- write side ------------------------------------------------------
+
+    def data_tmp_path(self, shuffle_id: int, map_id: int) -> str:
+        # attempt-unique: concurrent speculative attempts of one map task
+        # must not interleave writes in a shared tmp file
+        attempt = next(self._attempts)
+        return os.path.join(self.spill_dir,
+                            f"shuffle_{shuffle_id}_{map_id}.{attempt}.tmp")
+
+    def commit(self, shuffle_id: int, map_id: int, tmp_path: str,
+               partition_lengths: Iterable[int]) -> Tuple[SpillFile, int]:
+        """Rename-commit + map for serving. Returns (spill, file_token)."""
+        final = os.path.join(self.spill_dir,
+                             f"shuffle_{shuffle_id}_{map_id}.data")
+        os.replace(tmp_path, final)
+        token = next(self._tokens)
+        spill = SpillFile(final, list(partition_lengths), file_token=token)
+        with self._lock:
+            # speculative/retried map task: replace and dispose the old
+            # mapping (its file was already clobbered by the rename)
+            old = self._shuffles.setdefault(shuffle_id, {}).get(map_id)
+            self._shuffles[shuffle_id][map_id] = spill
+            self._by_token[token] = spill
+            if old is not None:
+                self._by_token.pop(old.file_token, None)
+        if old is not None:
+            old._delete = False  # the path now belongs to the new spill
+            old.dispose()
+        return spill, token
+
+    # -- ShuffleDataSource (served to remote peers) ----------------------
+
+    def get_output_table(self, shuffle_id: int, map_id: int) -> Optional[MapTaskOutput]:
+        with self._lock:
+            spill = self._shuffles.get(shuffle_id, {}).get(map_id)
+        return spill.map_output if spill is not None else None
+
+    def read_block(self, shuffle_id: int, buf_token: int, offset: int,
+                   length: int) -> Optional[bytes]:
+        with self._lock:
+            spill = self._by_token.get(buf_token)
+        if spill is None or offset + length > spill.size or offset < 0:
+            return None
+        if length == 0:
+            return b""
+        out = np.empty(length, dtype=np.uint8)
+        spill.gather([offset], [length], out)
+        return out.tobytes()
+
+    # -- local reads (short-circuit path) --------------------------------
+
+    def local_blocks(self, shuffle_id: int, map_id: int,
+                     start_partition: int, end_partition: int) -> Optional[bytes]:
+        """Concatenated local partitions [start, end) of one map output
+        (scala/RdmaShuffleFetcherIterator.scala:327-337 short-circuit)."""
+        with self._lock:
+            spill = self._shuffles.get(shuffle_id, {}).get(map_id)
+        if spill is None:
+            return None
+        offs = spill.partition_offsets[start_partition:end_partition]
+        lens = spill.partition_lengths[start_partition:end_partition]
+        out = np.empty(int(lens.sum()), dtype=np.uint8)
+        spill.gather(offs, lens, out)
+        return out.tobytes()
+
+    def map_ids(self, shuffle_id: int):
+        with self._lock:
+            return sorted(self._shuffles.get(shuffle_id, {}).keys())
+
+    # -- lifecycle -------------------------------------------------------
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            spills = self._shuffles.pop(shuffle_id, {})
+            for spill in spills.values():
+                self._by_token.pop(spill.file_token, None)
+        for spill in spills.values():
+            spill.dispose()
+
+    def stop(self) -> None:
+        with self._lock:
+            shuffle_ids = list(self._shuffles.keys())
+        for sid in shuffle_ids:
+            self.remove_shuffle(sid)

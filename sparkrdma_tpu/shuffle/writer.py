@@ -1,0 +1,117 @@
+"""Shuffle writer: partition, sort, spill, commit, publish.
+
+Re-design of ``writer/wrapper/RdmaWrapperShuffleWriter.scala``. The reference
+deliberately reuses the engine's own sort/spill machinery and only intercepts
+the commit (:83-99 wrap, :54-71 commit hook); the standalone TPU framework
+owns that machinery too, as vectorized batch ops:
+
+* ``write_batch`` accumulates record batches (keys + fixed-width payload),
+* ``close`` assigns destination partitions, stable-groups rows by partition
+  (numpy counting-sort — the writer is host-side; the TPU does the exchange,
+  not the spill), writes one partition-contiguous data file, rename-commits
+  it through the resolver (RdmaWrapperShuffleWriter.scala:58-63), and
+  publishes the map task's driver-table entry
+  (RdmaShuffleManager.scala:384-418).
+
+Record model: a batch is ``(keys: u64[N], payload: u8[N, W])`` with W fixed
+per shuffle. Arbitrary-width records are layered on top by serializing into
+fixed rows (models/ do exactly that). The on-disk row format is
+``key(8B LE) | payload(W B)``, partition-contiguous.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
+
+Partitioner = Callable[[np.ndarray], np.ndarray]  # keys -> dest partition ids
+
+
+class TpuShuffleWriter:
+    """One map task's writer (one instance per (shuffle, map))."""
+
+    def __init__(self, resolver: TpuShuffleBlockResolver, shuffle_id: int,
+                 map_id: int, num_partitions: int, partitioner: Partitioner,
+                 row_payload_bytes: int):
+        self.resolver = resolver
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.num_partitions = num_partitions
+        self.partitioner = partitioner
+        self.row_payload_bytes = row_payload_bytes
+        self._keys: List[np.ndarray] = []
+        self._payloads: List[np.ndarray] = []
+        self._closed = False
+        self.bytes_written = 0
+        self.records_written = 0
+
+    @property
+    def row_bytes(self) -> int:
+        return 8 + self.row_payload_bytes
+
+    def write_batch(self, keys: np.ndarray, payload: Optional[np.ndarray] = None) -> None:
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        if payload is None:
+            payload = np.zeros((len(keys), self.row_payload_bytes), dtype=np.uint8)
+        payload = np.ascontiguousarray(payload, dtype=np.uint8)
+        if payload.shape != (len(keys), self.row_payload_bytes):
+            raise ValueError(f"payload must be [{len(keys)}, {self.row_payload_bytes}]")
+        self._keys.append(keys)
+        self._payloads.append(payload)
+        self.records_written += len(keys)
+
+    def close(self, success: bool = True) -> Optional[Tuple[int, np.ndarray]]:
+        """Commit (or abort). Returns (file_token, partition_lengths).
+
+        Mirrors ``stop(success)`` (RdmaWrapperShuffleWriter.scala:104-122):
+        on success the committed file is mapped and the location table is
+        ready for publication; on failure everything is discarded.
+        """
+        if self._closed:
+            raise RuntimeError("writer already closed")
+        self._closed = True
+        if not success:
+            self._keys, self._payloads = [], []
+            return None
+        keys = (np.concatenate(self._keys) if self._keys
+                else np.zeros(0, dtype=np.uint64))
+        payload = (np.concatenate(self._payloads) if self._payloads
+                   else np.zeros((0, self.row_payload_bytes), dtype=np.uint8))
+        self._keys, self._payloads = [], []
+
+        dest = np.asarray(self.partitioner(keys), dtype=np.int64)
+        if len(dest) != len(keys):
+            raise ValueError("partitioner returned wrong-length array")
+        if len(dest) and (dest.min() < 0 or dest.max() >= self.num_partitions):
+            raise ValueError("partitioner returned out-of-range partition id")
+
+        # Stable counting-sort by destination: partition-contiguous rows.
+        order = np.argsort(dest, kind="stable")
+        counts = np.bincount(dest, minlength=self.num_partitions)
+
+        rows = np.empty((len(keys), self.row_bytes), dtype=np.uint8)
+        rows[:, :8] = keys[order, None].view(np.uint8).reshape(len(keys), 8)
+        rows[:, 8:] = payload[order]
+
+        tmp = self.resolver.data_tmp_path(self.shuffle_id, self.map_id)
+        rows.tofile(tmp)
+        partition_lengths = counts * self.row_bytes
+        _, token = self.resolver.commit(self.shuffle_id, self.map_id, tmp,
+                                        partition_lengths)
+        self.bytes_written = int(partition_lengths.sum())
+        return token, partition_lengths
+
+
+def decode_rows(data: bytes, row_payload_bytes: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of the writer's row format: bytes -> (keys, payload)."""
+    row_bytes = 8 + row_payload_bytes
+    if len(data) % row_bytes:
+        raise ValueError(f"byte length {len(data)} not a multiple of row size "
+                         f"{row_bytes}")
+    rows = np.frombuffer(data, dtype=np.uint8).reshape(-1, row_bytes)
+    keys = rows[:, :8].copy().view(np.uint64).reshape(-1)
+    return keys, rows[:, 8:].copy()
