@@ -32,6 +32,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -161,6 +162,103 @@ def resolve_impl(mesh: Mesh, impl: str = "auto") -> str:
         return impl
     platform = next(iter(mesh.devices.flat)).platform
     return "native" if platform == "tpu" else "gather"
+
+
+def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
+                          impl: str = "auto"):
+    """Bounded-round ragged exchange for arbitrary skew.
+
+    One round moves at most ``quota`` rows per (source, destination) pair,
+    so a receiver never nets more than ``D * quota`` rows per round no
+    matter how skewed the traffic — the collective analogue of the
+    reference's bounded in-flight window + grouped fetches
+    (scala/RdmaShuffleFetcherIterator.scala:240-276): total transfer is
+    unbounded, per-round memory is not.
+
+    Returns ``round_fn(grouped, counts, round_idx) -> (received[D*quota,...],
+    recv_counts[D])`` to be driven by a host loop over
+    ``ceil(max_pair_count / quota)`` rounds (the host knows counts — it
+    computed them or fetched the size exchange). ``grouped`` must be
+    destination-grouped rows with per-destination ``counts`` (as produced by
+    ``group_by_destination``).
+    """
+    n = mesh.shape[axis_name]
+    impl_resolved = resolve_impl(mesh, impl)
+    spec = P(axis_name)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(spec, spec, None),
+                       out_specs=(spec, spec))
+    def round_fn(grouped, counts, round_idx):
+        counts = counts.reshape(-1).astype(jnp.int32)
+        seg_starts = _exclusive_cumsum(counts)
+        # This round's slice of each destination segment:
+        # [start + r*quota, start + min((r+1)*quota, count))
+        lo = jnp.minimum(round_idx * quota, counts)
+        hi = jnp.minimum(lo + quota, counts)
+        send_counts = hi - lo
+        # Gather the round's rows into a compact [D*quota] send buffer,
+        # destination-grouped: row j*quota+i <- grouped[seg_starts[j]+lo[j]+i]
+        send_off = _exclusive_cumsum(send_counts)
+        slot = jnp.arange(n * quota, dtype=jnp.int32)
+        dest_of_slot = jnp.minimum(slot // quota, n - 1)
+        within = slot - dest_of_slot * quota
+        src_idx = seg_starts[dest_of_slot] + lo[dest_of_slot] + within
+        valid = within < send_counts[dest_of_slot]
+        src_idx = jnp.where(valid, src_idx, 0)
+        compact_idx = jnp.where(valid,
+                                send_off[dest_of_slot] + within,
+                                n * quota - 1)
+        picked = jnp.take(grouped, src_idx, axis=0)
+        send_buf = jnp.zeros((n * quota,) + grouped.shape[1:], grouped.dtype)
+        # scatter picked rows to their compact position (invalid rows all
+        # collide harmlessly on the last slot, then get overwritten only by
+        # at most one valid row — counts guarantee compact positions unique)
+        send_buf = send_buf.at[compact_idx].set(
+            jnp.where(valid.reshape((-1,) + (1,) * (grouped.ndim - 1)),
+                      picked, 0))
+        received, recv_counts, _ = ragged_exchange_shard(
+            send_buf, send_counts, axis_name, impl=impl_resolved)
+        return received, recv_counts[None]
+
+    return round_fn
+
+
+def chunked_exchange(mesh: Mesh, axis_name: str, grouped: np.ndarray,
+                     counts: np.ndarray, quota: int, impl: str = "auto"):
+    """Host driver for ``make_chunked_exchange``: runs all rounds, returns
+    (received_rows_per_device, total_rounds). Each device's rows are grouped
+    by source device, in the source's original within-destination order
+    (the per-round segments are re-assembled source-major so the contract
+    matches ``ragged_exchange_shard``'s). ``grouped``/``counts`` are global
+    arrays sharded on axis 0."""
+    n = mesh.shape[axis_name]
+    counts_host = np.asarray(counts).reshape(n, n)
+    num_rounds = max(1, int(-(-counts_host.max() // quota)))
+    round_fn = make_chunked_exchange(mesh, axis_name, quota, impl)
+    sharding = NamedSharding(mesh, P(axis_name))
+    grouped_d = jax.device_put(grouped, sharding)
+    counts_d = jax.device_put(counts_host.reshape(-1), sharding)
+    # per destination, per source: list of that source's round segments
+    per_source = [[[] for _ in range(n)] for _ in range(n)]
+    for r in range(num_rounds):
+        out, rc = round_fn(grouped_d, counts_d, r)
+        out = np.asarray(out).reshape(n, quota * n, *grouped.shape[1:])
+        rc = np.asarray(rc)  # [n_dest, n_src] rows received this round
+        for d in range(n):
+            start = 0
+            for j in range(n):
+                c = int(rc[d][j])
+                if c:
+                    per_source[d][j].append(out[d][start:start + c])
+                start += c
+    empty = np.zeros((0,) + grouped.shape[1:], grouped.dtype)
+    received = []
+    for d in range(n):
+        segs = [seg for j in range(n) for seg in per_source[d][j]]
+        received.append(np.concatenate(segs) if segs else empty)
+    return received, num_rounds
 
 
 def make_shuffle_exchange(mesh: Mesh, axis_name: str, impl: str = "auto",

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -57,6 +58,12 @@ class SpillFile:
 
         self._native_handle = None
         self._py_data: Optional[np.ndarray] = None
+        # reader refcount so dispose() can't unmap under an in-flight gather
+        # (serving threads race shuffle cleanup; the reference relies on the
+        # JVM GC + dispose ordering, we make it explicit)
+        self._rc_cv = threading.Condition()
+        self._readers = 0
+        self._disposed = False
         actual = os.path.getsize(path)
         if actual < self.size:
             raise ValueError(f"spill file {path} shorter ({actual}) than "
@@ -69,9 +76,29 @@ class SpillFile:
         if self._native_handle is None and self.size > 0:
             self._py_data = np.fromfile(path, dtype=np.uint8)
 
+    def _enter_read(self) -> None:
+        with self._rc_cv:
+            if self._disposed:
+                raise RuntimeError(f"spill file {self.path} is disposed")
+            self._readers += 1
+
+    def _exit_read(self) -> None:
+        with self._rc_cv:
+            self._readers -= 1
+            if self._readers == 0:
+                self._rc_cv.notify_all()
+
     def gather(self, offsets: Sequence[int], lengths: Sequence[int],
                dst: np.ndarray, nthreads: int = 4) -> int:
         """Pack the given blocks back-to-back into ``dst``; returns bytes."""
+        self._enter_read()
+        try:
+            return self._gather_locked(offsets, lengths, dst, nthreads)
+        finally:
+            self._exit_read()
+
+    def _gather_locked(self, offsets: Sequence[int], lengths: Sequence[int],
+                       dst: np.ndarray, nthreads: int = 4) -> int:
         offs = np.ascontiguousarray(offsets, dtype=np.uint64)
         lens = np.ascontiguousarray(lengths, dtype=np.uint64)
         total = int(lens.sum())
@@ -118,6 +145,16 @@ class SpillFile:
         return self._py_data[off:off + ln].tobytes()
 
     def dispose(self) -> None:
+        with self._rc_cv:
+            if self._disposed:
+                return
+            self._disposed = True
+            # drain in-flight readers before unmapping (bounded wait; a stuck
+            # reader is a bug, not a reason to hold the mapping forever)
+            deadline = 30.0
+            while self._readers > 0 and deadline > 0:
+                self._rc_cv.wait(timeout=0.1)
+                deadline -= 0.1
         if self._native_handle is not None:
             native.LIB.staging_unmap(self._native_handle)
             self._native_handle = None

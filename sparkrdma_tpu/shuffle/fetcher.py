@@ -72,7 +72,8 @@ class FetchResult:
 @dataclass
 class ReadMetrics:
     """Reference: Spark task metrics wiring
-    (scala/RdmaShuffleFetcherIterator.scala:104-106, 330-332, 349-361)."""
+    (scala/RdmaShuffleFetcherIterator.scala:104-106, 330-332, 349-361).
+    Updated from concurrent peer threads — mutate via the record_* methods."""
 
     remote_bytes: int = 0
     local_bytes: int = 0
@@ -80,6 +81,18 @@ class ReadMetrics:
     local_fetches: int = 0
     fetch_wait_s: float = 0.0
     fetch_latencies_s: List[float] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def record_remote(self, nbytes: int, latency_s: float) -> None:
+        with self._lock:
+            self.remote_bytes += nbytes
+            self.remote_fetches += 1
+            self.fetch_latencies_s.append(latency_s)
+
+    def record_local(self, nbytes: int) -> None:
+        with self._lock:
+            self.local_bytes += nbytes
+            self.local_fetches += 1
 
 
 @dataclass
@@ -144,8 +157,7 @@ class ShuffleFetcher:
             if data is None:
                 raise FetchFailedError(self.shuffle_id, m, my_index,
                                        "local map output missing")
-            self.metrics.local_bytes += len(data)
-            self.metrics.local_fetches += 1
+            self.metrics.record_local(len(data))
             self._expected_results += 1
             self._results.put(FetchResult(m, self.start_partition,
                                           self.end_partition, data,
@@ -225,9 +237,7 @@ class ShuffleFetcher:
                     raise FetchFailedError(self.shuffle_id, fetch.map_id,
                                            exec_idx, str(e)) from e
                 dt = time.monotonic() - t0
-                self.metrics.remote_bytes += len(data)
-                self.metrics.remote_fetches += 1
-                self.metrics.fetch_latencies_s.append(dt)
+                self.metrics.record_remote(len(data), dt)
                 self._results.put(FetchResult(
                     fetch.map_id, fetch.start_partition, fetch.end_partition,
                     data))
