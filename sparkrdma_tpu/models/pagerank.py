@@ -52,7 +52,9 @@ def make_pagerank_step(mesh: Mesh, axis_name: str, cfg: PageRankConfig,
         ``[d*V/D, (d+1)*V/D)``);
       ``out_deg: f32[V]`` — out-degrees, sharded identically.
 
-    Returns updated ranks (same sharding).
+    Returns ``(ranks, overflowed[D])``; ``overflowed[d]`` flags a receive
+    buffer too small for the contribution fan-in (results invalid — raise
+    ``out_factor``), mirroring the TeraSort/join steps.
     """
     n = mesh.shape[axis_name]
     impl = resolve_impl(mesh, impl)
@@ -62,7 +64,7 @@ def make_pagerank_step(mesh: Mesh, axis_name: str, cfg: PageRankConfig,
     @jax.jit
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(spec, spec, spec),
-                       out_specs=spec)
+                       out_specs=(spec, spec))
     def step(edges, ranks, out_deg):
         me = jax.lax.axis_index(axis_name)
         src, dst = edges[:, 0], edges[:, 1]
@@ -81,6 +83,7 @@ def make_pagerank_step(mesh: Mesh, axis_name: str, cfg: PageRankConfig,
         received, recv_counts, _ = shuffle_shard(
             rows, dest_dev, axis_name, n, output=output, impl=impl)
         total = recv_counts.sum()
+        overflowed = total > output.shape[0]
         rvalid = jnp.arange(received.shape[0], dtype=jnp.int32) < total
         rdst = jnp.where(rvalid,
                          received[:, 0].astype(jnp.int32) - me * v_local, 0)
@@ -88,7 +91,8 @@ def make_pagerank_step(mesh: Mesh, axis_name: str, cfg: PageRankConfig,
             rvalid,
             jax.lax.bitcast_convert_type(received[:, 1], jnp.float32), 0.0)
         sums = jnp.zeros(v_local, jnp.float32).at[rdst].add(rcontrib)
-        return (1.0 - cfg.damping) / cfg.num_vertices + cfg.damping * sums
+        new_ranks = (1.0 - cfg.damping) / cfg.num_vertices + cfg.damping * sums
+        return new_ranks, overflowed[None]
 
     return step
 
@@ -124,9 +128,15 @@ def run_pagerank(mesh: Mesh, cfg: PageRankConfig, iterations: int,
     edges_d = jax.device_put(edges, shard)
     ranks_d = jax.device_put(ranks, shard)
     deg_d = jax.device_put(out_deg, shard)
+    overflowed = None
     for _ in range(iterations):
-        ranks_d = step(edges_d, ranks_d, deg_d)
-    return np.asarray(jax.block_until_ready(ranks_d))
+        ranks_d, overflowed = step(edges_d, ranks_d, deg_d)
+    ranks_h = np.asarray(jax.block_until_ready(ranks_d))
+    if overflowed is not None and np.asarray(overflowed).any():
+        raise OverflowError(
+            "pagerank receive buffer overflow: contribution fan-in exceeds "
+            "out_factor headroom; raise PageRankConfig.out_factor")
+    return ranks_h
 
 
 def numpy_pagerank(edges: np.ndarray, num_vertices: int, damping: float,

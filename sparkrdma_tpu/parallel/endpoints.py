@@ -108,6 +108,8 @@ class DriverEndpoint:
         snapshot is re-announced so all executors converge.
         """
         with self._members_lock:
+            if manager_id not in self._members:
+                return  # unknown or already tombstoned: nothing to do
             self._members = [TOMBSTONE if m == manager_id else m
                              for m in self._members]
             self._members_epoch += 1
@@ -142,6 +144,7 @@ class DriverEndpoint:
 
     def _broadcast(self, members: List[ShuffleManagerId], epoch: int) -> None:
         announce = AnnounceMsg(members, epoch)
+        lost: List[ShuffleManagerId] = []
         for m in members:
             if m == TOMBSTONE:
                 continue
@@ -150,6 +153,16 @@ class DriverEndpoint:
             except TransportError as e:
                 log.warning("driver: announce to %s:%s failed: %s",
                             m.rpc_host, m.rpc_port, e)
+                lost.append(m)
+        # Failure detection: an unreachable executor is treated as lost and
+        # tombstoned so fetchers fail fast (the reference reacts to
+        # SparkListenerBlockManagerRemoved the same way,
+        # scala/RdmaShuffleManager.scala:155-165). remove_member no-ops on
+        # already-tombstoned slots, so this converges.
+        for m in lost:
+            log.warning("driver: marking unreachable executor %s:%s as lost",
+                        m.rpc_host, m.rpc_port)
+            self.remove_member(m)
 
     def _on_publish(self, msg: M.PublishMsg) -> Optional[RpcMsg]:
         # Publish is one-sided in the reference (RDMA WRITE into the table,

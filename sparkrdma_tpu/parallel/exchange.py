@@ -164,9 +164,11 @@ def resolve_impl(mesh: Mesh, impl: str = "auto") -> str:
     return "native" if platform == "tpu" else "gather"
 
 
+@functools.lru_cache(maxsize=128)
 def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
                           impl: str = "auto"):
-    """Bounded-round ragged exchange for arbitrary skew.
+    """Bounded-round ragged exchange for arbitrary skew. Memoized per
+    (mesh, axis, quota, impl) so iterative callers (ALS) compile once.
 
     One round moves at most ``quota`` rows per (source, destination) pair,
     so a receiver never nets more than ``D * quota`` rows per round no

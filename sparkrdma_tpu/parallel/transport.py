@@ -218,6 +218,15 @@ class ControlServer:
 
     def stop(self) -> None:
         self._stopped.set()
+        # shutdown() before close(): a close() alone does not tear down a
+        # listening socket another thread is blocked accept()ing on — the
+        # kernel keeps it in LISTEN and keeps completing handshakes into the
+        # backlog, so peers never see the endpoint die. shutdown() interrupts
+        # the blocked accept and kills the listen state immediately.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

@@ -136,13 +136,11 @@ class SpillFile:
         """Serve one local partition (RdmaMappedFile.java:231-235)."""
         off = int(self.partition_offsets[partition_id])
         ln = int(self.partition_lengths[partition_id])
-        if self._native_handle is not None:
-            out = np.empty(ln, dtype=np.uint8)
-            self.gather([off], [ln], out)
-            return out.tobytes()
         if ln == 0:
             return b""
-        return self._py_data[off:off + ln].tobytes()
+        out = np.empty(ln, dtype=np.uint8)
+        self.gather([off], [ln], out)  # refcounted on both backends
+        return out.tobytes()
 
     def dispose(self) -> None:
         with self._rc_cv:

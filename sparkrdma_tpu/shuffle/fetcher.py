@@ -112,10 +112,11 @@ class ShuffleFetcher:
                  resolver: Optional[TpuShuffleBlockResolver],
                  conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
                  start_partition: int, end_partition: int,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, reader_stats=None):
         self.endpoint = endpoint
         self.resolver = resolver
         self.conf = conf
+        self.reader_stats = reader_stats  # ShuffleReaderStats | None
         self.shuffle_id = shuffle_id
         self.num_maps = num_maps
         self.start_partition = start_partition
@@ -238,6 +239,8 @@ class ShuffleFetcher:
                                            exec_idx, str(e)) from e
                 dt = time.monotonic() - t0
                 self.metrics.record_remote(len(data), dt)
+                if self.reader_stats is not None:
+                    self.reader_stats.update(exec_idx, dt)
                 self._results.put(FetchResult(
                     fetch.map_id, fetch.start_partition, fetch.end_partition,
                     data))

@@ -33,9 +33,15 @@ import numpy as np
 from sparkrdma_tpu.config import TpuShuffleConf
 from sparkrdma_tpu.ops import partition as partition_ops
 from sparkrdma_tpu.parallel.endpoints import DriverEndpoint, ExecutorEndpoint
+from sparkrdma_tpu.runtime.pool import BufferPool
 from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
 from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
 from sparkrdma_tpu.shuffle.writer import Partitioner, TpuShuffleWriter
+from sparkrdma_tpu.utils.stats import MemStats, ShuffleReaderStats
+
+import logging
+
+log = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -87,6 +93,10 @@ class TpuShuffleManager:
         self.resolver: Optional[TpuShuffleBlockResolver] = None
         self._handles: Dict[int, ShuffleHandle] = {}
         self._lock = threading.Lock()
+        self.pool = BufferPool(self.conf)
+        self.reader_stats = (ShuffleReaderStats(self.conf)
+                             if self.conf.collect_shuffle_reader_stats else None)
+        self._mem_stats = MemStats()
 
         if is_driver:
             self.driver = DriverEndpoint(self.conf, host=host)
@@ -139,7 +149,8 @@ class TpuShuffleManager:
         return TpuShuffleReader(self.executor, self.resolver, self.conf,
                                 handle.shuffle_id, handle.num_maps,
                                 start_partition, end_partition,
-                                handle.row_payload_bytes)
+                                handle.row_payload_bytes,
+                                reader_stats=self.reader_stats)
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         """(scala/RdmaShuffleManager.scala:293-299)."""
@@ -153,11 +164,21 @@ class TpuShuffleManager:
             self._handles.pop(shuffle_id, None)
 
     def stop(self) -> None:
-        """(scala/RdmaShuffleManager.scala:301-310)."""
+        """Stats dump then teardown (scala/RdmaShuffleManager.scala:301-310;
+        histograms at RdmaShuffleReaderStats.scala:55-81; pool stats at
+        RdmaBufferManager.java:217-231)."""
+        if self.reader_stats is not None:
+            self.reader_stats.log_summary(log)
+        # quiesce traffic sources before destroying the pool: outstanding
+        # readers hold views into pool memory
         if self.executor is not None:
             self.executor.stop()
         if self.resolver is not None:
             self.resolver.stop()
+        pool_stats = self.pool.stop()
+        if pool_stats.get("bins"):
+            log.info("buffer pool stats: %s", pool_stats)
+        log.info("host paging over manager lifetime: %s", self._mem_stats.diff())
         if self.driver is not None:
             self.driver.stop()
 

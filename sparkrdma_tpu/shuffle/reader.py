@@ -29,10 +29,11 @@ class TpuShuffleReader:
                  resolver: Optional[TpuShuffleBlockResolver],
                  conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
                  start_partition: int, end_partition: int,
-                 row_payload_bytes: int):
+                 row_payload_bytes: int, reader_stats=None):
         self.row_payload_bytes = row_payload_bytes
         self.fetcher = ShuffleFetcher(endpoint, resolver, conf, shuffle_id,
-                                      num_maps, start_partition, end_partition)
+                                      num_maps, start_partition, end_partition,
+                                      reader_stats=reader_stats)
 
     @property
     def metrics(self) -> ReadMetrics:
@@ -73,3 +74,42 @@ class TpuShuffleReader:
         """Aggregate with a vectorized combiner (sorted-run reduction)."""
         keys, payload = self.read_sorted()
         return combine(keys, payload)
+
+    def read_to_device(self, pool, device=None):
+        """Stage the partition range into one pool buffer, then one
+        host->device transfer. Returns ``(keys: u32[N, 2], payload:
+        u8[N, W])`` device arrays — keys as (lo, hi) uint32 words, since
+        uint64 silently narrows under jit without x64.
+
+        This is the host->HBM on-ramp the staging pool exists for
+        (RdmaMappedFile's mmap+register in the reference becomes: gather
+        fetched bytes into page-aligned host staging, single DMA up).
+        """
+        import jax
+
+        self.fetcher.start()
+        chunks = []
+        total = 0
+        for result in self.fetcher:
+            if result.data:
+                chunks.append(result.data)
+                total += len(result.data)
+        try:
+            row_bytes = 8 + self.row_payload_bytes
+            if total == 0:
+                keys = jax.device_put(np.zeros((0, 2), dtype=np.uint32), device)
+                payload = jax.device_put(
+                    np.zeros((0, self.row_payload_bytes), dtype=np.uint8), device)
+                return keys, payload
+            with pool.get(total) as buf:
+                pos = 0
+                for c in chunks:
+                    buf.view[pos:pos + len(c)] = np.frombuffer(c, dtype=np.uint8)
+                    pos += len(c)
+                rows = buf.view[:total].reshape(-1, row_bytes)
+                keys_host = rows[:, :8].copy().view(np.uint32).reshape(-1, 2)
+                payload_host = rows[:, 8:].copy()
+            return (jax.device_put(keys_host, device),
+                    jax.device_put(payload_host, device))
+        finally:
+            self.fetcher.close()

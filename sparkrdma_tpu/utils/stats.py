@@ -1,0 +1,125 @@
+"""Shuffle observability: fetch-latency histograms + host-memory stats.
+
+Re-design of ``scala/RdmaShuffleReaderStats.scala``:
+
+* per-remote-executor fetch-latency histograms with fixed-width buckets
+  (``fetch_time_bucket_size_ms`` × ``fetch_time_num_buckets``) plus one
+  global histogram, printed at manager stop
+  (RdmaShuffleReaderStats.scala:32-81, enabled by
+  ``collect_shuffle_reader_stats``, scala/RdmaShuffleConf.scala:121-123);
+* the reference's ``OdpStats`` diffs NIC page-fault counters from sysfs
+  before/after (RdmaShuffleReaderStats.scala:83-99). The TPU analogue of
+  "did my memory registration thrash" is host-process paging while staging:
+  ``MemStats`` diffs major/minor page faults + peak RSS from procfs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from sparkrdma_tpu.config import TpuShuffleConf
+
+
+class FetchHistogram:
+    """Fixed-width latency buckets; the last bucket is open-ended."""
+
+    def __init__(self, bucket_ms: int, num_buckets: int):
+        self.bucket_ms = bucket_ms
+        self.buckets = [0] * (num_buckets + 1)
+        self.count = 0
+        self.total_ms = 0.0
+
+    def add(self, latency_s: float) -> None:
+        ms = latency_s * 1e3
+        idx = min(int(ms // self.bucket_ms), len(self.buckets) - 1)
+        self.buckets[idx] += 1
+        self.count += 1
+        self.total_ms += ms
+
+    def summary(self) -> dict:
+        edges = [f"<{(i + 1) * self.bucket_ms}ms" for i in
+                 range(len(self.buckets) - 1)] + [f">={len(self.buckets) - 1}x"]
+        return {
+            "count": self.count,
+            "mean_ms": round(self.total_ms / self.count, 3) if self.count else 0.0,
+            "buckets": dict(zip(edges, self.buckets)),
+        }
+
+
+class ShuffleReaderStats:
+    """Per-remote + global histograms (RdmaShuffleReaderStats.scala:32-81)."""
+
+    def __init__(self, conf: Optional[TpuShuffleConf] = None):
+        conf = conf or TpuShuffleConf()
+        self._bucket_ms = conf.fetch_time_bucket_size_ms
+        self._num_buckets = conf.fetch_time_num_buckets
+        self._per_remote: Dict[int, FetchHistogram] = {}
+        self._global = FetchHistogram(self._bucket_ms, self._num_buckets)
+        self._lock = threading.Lock()
+
+    def update(self, exec_index: int, latency_s: float) -> None:
+        with self._lock:
+            hist = self._per_remote.get(exec_index)
+            if hist is None:
+                hist = FetchHistogram(self._bucket_ms, self._num_buckets)
+                self._per_remote[exec_index] = hist
+            hist.add(latency_s)
+            self._global.add(latency_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "global": self._global.summary(),
+                "per_remote": {str(k): v.summary()
+                               for k, v in sorted(self._per_remote.items())},
+            }
+
+    def log_summary(self, logger) -> None:
+        """Printed at stop (RdmaShuffleReaderStats.scala:55-81)."""
+        snap = self.snapshot()
+        if snap["global"]["count"] == 0:
+            return
+        logger.info("shuffle fetch latency (global): %s", snap["global"])
+        for remote, summary in snap["per_remote"].items():
+            logger.info("shuffle fetch latency (executor %s): %s",
+                        remote, summary)
+
+
+class MemStats:
+    """Host paging counters diffed over a window (OdpStats analogue,
+    RdmaShuffleReaderStats.scala:83-99)."""
+
+    def __init__(self):
+        self._start = self._read()
+
+    @staticmethod
+    def _read() -> dict:
+        try:
+            with open("/proc/self/stat") as f:
+                fields = f.read().split()
+            minflt, majflt = int(fields[9]), int(fields[11])
+        except (OSError, IndexError, ValueError):
+            minflt = majflt = 0
+        peak_kb = 0
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmHWM:"):
+                        peak_kb = int(line.split()[1])
+                        break
+        except (OSError, IndexError, ValueError):
+            pass
+        return {"minor_faults": minflt, "major_faults": majflt,
+                "peak_rss_kb": peak_kb}
+
+    def diff(self) -> dict:
+        now = self._read()
+        return {k: now[k] - self._start[k] if k != "peak_rss_kb" else now[k]
+                for k in now}
+
+
+def process_stats() -> dict:
+    """One-shot convenience: pid + paging + rss snapshot."""
+    return {"pid": os.getpid(), **MemStats._read()}

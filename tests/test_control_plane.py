@@ -210,3 +210,38 @@ def test_partial_table_not_memoized(cluster):
     # complete table is memoized
     again = execs[2].get_driver_table(55, expect_published=3, timeout=5)
     assert again is full
+
+
+def test_unreachable_executor_auto_tombstoned(cluster):
+    """Failure detection: announce delivery failure marks the peer lost
+    (scala/RdmaShuffleManager.scala:155-165 analogue)."""
+    from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+    driver, execs, _ = cluster
+    dead = execs[1]
+    dead_idx = dead.exec_index()
+    dead.stop()  # server gone; driver's cached conn will break
+    # each new membership event triggers a broadcast; the dead peer's
+    # connection fails on first real post-RST traffic, so detection
+    # converges within a couple of events (TCP can't see a silent peer
+    # death until a send bounces)
+    fresh = []
+    deadline = time.monotonic() + 10
+    tombstoned = False
+    while time.monotonic() < deadline and not tombstoned:
+        ex = ExecutorEndpoint("127.0.0.1", f"f{len(fresh)}", driver.address,
+                              conf=CONF)
+        ex.start()
+        fresh.append(ex)
+        for _ in range(20):
+            members = driver.members()
+            if dead_idx < len(members) and members[dead_idx] == TOMBSTONE:
+                tombstoned = True
+                break
+            time.sleep(0.05)
+        if len(fresh) >= 3:
+            break
+    members = driver.members()
+    assert members[dead_idx] == TOMBSTONE
+    assert fresh[0].manager_id in members
+    for ex in fresh:
+        ex.stop()

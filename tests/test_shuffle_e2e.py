@@ -160,3 +160,51 @@ def test_unregister_cleans_up(cluster, tmp_path):
     for node in execs + [driver]:
         node.unregister_shuffle(7)
     assert not os.listdir(spill_dir)
+
+
+def test_read_to_device(cluster):
+    """Pool-staged host->device on-ramp yields the same records."""
+    driver, execs = cluster
+    handle, keys, payloads = _run_shuffle(driver, execs, 8, num_maps=3,
+                                          num_partitions=3)
+    import numpy as np
+    reader = execs[0].get_reader(handle, 0, 3)
+    dk, dp = reader.read_to_device(execs[0].pool)
+    # keys come back as u32 (lo, hi) word pairs
+    got_k = np.asarray(dk).copy().view(np.uint64).reshape(-1)
+    got_p = np.asarray(dp)
+    assert got_k.shape == keys.shape
+
+    def canon(k, p):
+        rows = np.concatenate([k[:, None].view(np.uint8).reshape(len(k), 8), p],
+                              axis=1)
+        return rows[np.lexsort(rows.T[::-1])]
+    np.testing.assert_array_equal(canon(got_k, got_p), canon(keys, payloads))
+
+
+def test_reader_stats_collected(cluster, tmp_path):
+    conf = TpuShuffleConf(collect_shuffle_reader_stats=True,
+                          connect_timeout_ms=5000)
+    driver2 = TpuShuffleManager(conf, is_driver=True)
+    ex = [TpuShuffleManager(conf, driver_addr=driver2.driver_addr,
+                            executor_id=f"s{i}",
+                            spill_dir=str(tmp_path / f"s{i}"))
+          for i in range(2)]
+    for e in ex:
+        e.executor.wait_for_members(2)
+    try:
+        import numpy as np
+        handle = driver2.register_shuffle(1, 2, 2, PartitionerSpec("modulo"))
+        for m in range(2):
+            w = ex[m].get_writer(handle, m)
+            w.write_batch(np.arange(100, dtype=np.uint64))
+            w.close()
+        r = ex[0].get_reader(handle, 0, 2)
+        r.read_all()
+        snap = ex[0].reader_stats.snapshot()
+        assert snap["global"]["count"] >= 1
+        assert len(snap["per_remote"]) >= 1
+    finally:
+        for e in ex:
+            e.stop()
+        driver2.stop()

@@ -1,0 +1,47 @@
+"""Observability tests (reference: scala/RdmaShuffleReaderStats.scala)."""
+
+import logging
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.utils.stats import (
+    FetchHistogram,
+    MemStats,
+    ShuffleReaderStats,
+    process_stats,
+)
+
+
+def test_histogram_bucketing():
+    h = FetchHistogram(bucket_ms=100, num_buckets=3)
+    for ms in (10, 99, 150, 250, 950):
+        h.add(ms / 1e3)
+    s = h.summary()
+    assert s["count"] == 5
+    buckets = list(s["buckets"].values())
+    assert buckets == [2, 1, 1, 1]  # <100, <200, <300, overflow
+    assert s["mean_ms"] == round((10 + 99 + 150 + 250 + 950) / 5, 3)
+
+
+def test_reader_stats_per_remote():
+    stats = ShuffleReaderStats(TpuShuffleConf(fetch_time_bucket_size_ms=50,
+                                              fetch_time_num_buckets=4))
+    stats.update(0, 0.01)
+    stats.update(0, 0.02)
+    stats.update(3, 0.5)
+    snap = stats.snapshot()
+    assert snap["global"]["count"] == 3
+    assert snap["per_remote"]["0"]["count"] == 2
+    assert snap["per_remote"]["3"]["count"] == 1
+    stats.log_summary(logging.getLogger("test"))  # must not raise
+
+
+def test_mem_stats_diff_monotonic():
+    m = MemStats()
+    # touch some memory to cause faults
+    blob = bytearray(4 << 20)
+    blob[::4096] = b"x" * len(blob[::4096])
+    d = m.diff()
+    assert d["minor_faults"] >= 0
+    assert d["peak_rss_kb"] > 0
+    p = process_stats()
+    assert p["pid"] > 0
