@@ -34,8 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkrdma_tpu.ops.partition import range_partition, uniform_splitters
-from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
+from sparkrdma_tpu.ops.partition import uniform_splitters
+from sparkrdma_tpu.parallel.exchange import ragged_exchange_shard, resolve_impl
 
 
 @dataclass(frozen=True)
@@ -65,25 +65,51 @@ def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
     splitters = uniform_splitters(n, jnp.uint32)
     spec = P(axis_name)
 
+    def sort_rows_by_key(rows, keys):
+        """One co-sort of (key, row-index) + ONE row gather.
+
+        The row gather is the expensive op on TPU (~40ns/row fixed cost —
+        measured: a [10.7M, 25] u32 take is 5x the cost of the u32 sort),
+        so the step is built around doing exactly one per exchange side.
+        """
+        iota = jnp.arange(rows.shape[0], dtype=jnp.int32)
+        sorted_keys, order = jax.lax.sort((keys, iota), num_keys=1)
+        sorted_rows = jnp.take(rows, order, axis=0)
+        # the key column already equals sorted_keys for valid rows; only
+        # padding rows (sentinel keys) need the overwrite
+        return sorted_rows.at[:, 0].set(sorted_keys), sorted_keys
+
     @jax.jit
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(spec,), out_specs=(spec, spec, spec))
     def step(rows):
         keys = rows[:, 0]
-        dest = range_partition(keys, splitters)
+        if n == 1:
+            # single-device: no exchange, one sort+gather is the whole job
+            sorted_rows, _ = sort_rows_by_key(rows, keys)
+            counts = jnp.array([[rows.shape[0]]], dtype=jnp.int32)
+            return sorted_rows, counts, jnp.zeros((1,), bool)
+
+        # Local sort by KEY once: range partition is monotonic in key, so
+        # key-sorted rows are destination-grouped for free — this replaces
+        # the separate argsort-by-destination + gather entirely.
+        grouped, sorted_keys = sort_rows_by_key(rows, keys)
+        # per-destination counts: D-1 binary searches on the sorted keys
+        bounds = jnp.searchsorted(sorted_keys, splitters, side="left")
+        bounds = jnp.concatenate([jnp.zeros(1, bounds.dtype), bounds,
+                                  jnp.array([rows.shape[0]], bounds.dtype)])
+        counts = jnp.diff(bounds).astype(jnp.int32)
+
         output = jnp.zeros((rows.shape[0] * cfg.out_factor, rows.shape[1]),
                            dtype=rows.dtype)
-        received, recv_counts, _ = shuffle_shard(
-            rows, dest, axis_name, n, output=output, impl=impl)
-        # local sort by key; padding rows get the max-key sentinel
+        received, recv_counts, _ = ragged_exchange_shard(
+            grouped, counts, axis_name, output=output, impl=impl)
         total = recv_counts.sum()
         overflowed = total > output.shape[0]
         valid = jnp.arange(received.shape[0], dtype=jnp.int32) < total
         sentinel = jnp.uint32(0xFFFFFFFF)
         sort_keys = jnp.where(valid, received[:, 0], sentinel)
-        order = jnp.argsort(sort_keys, stable=True)
-        sorted_rows = jnp.take(received, order, axis=0)
-        sorted_rows = sorted_rows.at[:, 0].set(jnp.sort(sort_keys))
+        sorted_rows, _ = sort_rows_by_key(received, sort_keys)
         return sorted_rows, recv_counts[None], overflowed[None]
 
     return step

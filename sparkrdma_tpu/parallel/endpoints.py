@@ -148,11 +148,26 @@ class DriverEndpoint:
         for m in members:
             if m == TOMBSTONE:
                 continue
-            try:
-                self._clients.get(m.rpc_host, m.rpc_port).send(announce)
-            except TransportError as e:
-                log.warning("driver: announce to %s:%s failed: %s",
-                            m.rpc_host, m.rpc_port, e)
+            # Two attempts: a failed send on a stale cached connection is
+            # not evidence of peer death — retry on a fresh connection and
+            # only declare the peer lost if that also fails (a transient
+            # blip must not permanently tombstone a live executor).
+            delivered = False
+            for attempt in range(2):
+                try:
+                    conn = self._clients.get(m.rpc_host, m.rpc_port)
+                    conn.send(announce)
+                    delivered = True
+                    break
+                except TransportError as e:
+                    log.warning("driver: announce to %s:%s failed "
+                                "(attempt %d): %s", m.rpc_host, m.rpc_port,
+                                attempt + 1, e)
+                    try:
+                        conn.close()  # drop the stale connection
+                    except UnboundLocalError:
+                        pass
+            if not delivered:
                 lost.append(m)
         # Failure detection: an unreachable executor is treated as lost and
         # tombstoned so fetchers fail fast (the reference reacts to

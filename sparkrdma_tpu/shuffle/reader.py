@@ -88,13 +88,13 @@ class TpuShuffleReader:
         import jax
 
         self.fetcher.start()
-        chunks = []
-        total = 0
-        for result in self.fetcher:
-            if result.data:
-                chunks.append(result.data)
-                total += len(result.data)
         try:
+            chunks = []
+            total = 0
+            for result in self.fetcher:
+                if result.data:
+                    chunks.append(result.data)
+                    total += len(result.data)
             row_bytes = 8 + self.row_payload_bytes
             if total == 0:
                 keys = jax.device_put(np.zeros((0, 2), dtype=np.uint32), device)

@@ -39,8 +39,9 @@ class FetchHistogram:
         self.total_ms += ms
 
     def summary(self) -> dict:
-        edges = [f"<{(i + 1) * self.bucket_ms}ms" for i in
-                 range(len(self.buckets) - 1)] + [f">={len(self.buckets) - 1}x"]
+        edges = ([f"<{(i + 1) * self.bucket_ms}ms" for i in
+                  range(len(self.buckets) - 1)]
+                 + [f">={(len(self.buckets) - 1) * self.bucket_ms}ms"])
         return {
             "count": self.count,
             "mean_ms": round(self.total_ms / self.count, 3) if self.count else 0.0,

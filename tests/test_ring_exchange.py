@@ -1,0 +1,79 @@
+"""Pallas ring all-to-all tests: interpret-mode remote DMA on the 8-device
+virtual mesh, checked against a numpy transpose oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkrdma_tpu.ops.ring_exchange import make_ring_all_to_all
+
+D = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:D]), ("shuffle",))
+
+
+def _run(mesh, x):
+    a2a = make_ring_all_to_all(mesh, "shuffle", interpret=True)
+    sharding = NamedSharding(mesh, P("shuffle"))
+    return np.asarray(jax.block_until_ready(a2a(jax.device_put(x, sharding))))
+
+
+def test_ring_a2a_matches_transpose(mesh):
+    """All-to-all of per-destination blocks == block transpose: the payload
+    device i addressed to device j must end up as device j's block from i."""
+    C, W = 16, 8
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 2**31, size=(D, D, C, W), dtype=np.uint32)
+    out = _run(mesh, x)
+    expect = np.swapaxes(x, 0, 1)  # out[j][i] = x[i][j]
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_ring_a2a_identity_patterns(mesh):
+    """Device-identifying payloads land on the right devices intact."""
+    C, W = 4, 4
+    x = np.zeros((D, D, C, W), dtype=np.uint32)
+    for i in range(D):
+        for j in range(D):
+            x[i, j] = i * 100 + j  # "from i to j" stamp
+    out = _run(mesh, x)
+    for j in range(D):
+        for i in range(D):
+            assert (out[j, i] == i * 100 + j).all(), (i, j)
+
+
+def test_ring_single_device():
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("shuffle",))
+    x = np.arange(1 * 1 * 4 * 4, dtype=np.uint32).reshape(1, 1, 4, 4)
+    a2a = make_ring_all_to_all(mesh1, "shuffle", interpret=True)
+    out = np.asarray(a2a(jax.device_put(
+        x, NamedSharding(mesh1, P("shuffle")))))
+    np.testing.assert_array_equal(out, x)
+
+
+def test_chunked_exchange_over_ring_transport(mesh):
+    """The chunked multi-round exchange produces identical results whether it
+    rides the XLA collective or the Pallas ring kernel."""
+    from sparkrdma_tpu.parallel.exchange import chunked_exchange
+    rng = np.random.default_rng(3)
+    per_dev = 40
+    rows = np.zeros((D * per_dev, 2), dtype=np.uint32)
+    counts = np.zeros((D, D), dtype=np.int32)
+    for d in range(D):
+        dest = np.sort(rng.integers(0, D, size=per_dev))
+        seg = np.stack([dest.astype(np.uint32),
+                        rng.integers(0, 2**31, per_dev, dtype=np.uint32)], 1)
+        rows[d * per_dev:(d + 1) * per_dev] = seg
+        counts[d] = np.bincount(dest, minlength=D)
+    via_collective, r1 = chunked_exchange(mesh, "shuffle", rows, counts,
+                                          quota=8, impl="gather")
+    via_ring, r2 = chunked_exchange(mesh, "shuffle", rows, counts,
+                                    quota=8, impl="ring_interpret")
+    assert r1 == r2
+    for d in range(D):
+        np.testing.assert_array_equal(via_ring[d], via_collective[d])
