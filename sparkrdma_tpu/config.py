@@ -99,6 +99,11 @@ _KEYS = [
     _Key("partition_location_fetch_timeout_ms", 120000, "int", 1, 3600_000,
          doc="Timeout awaiting map-output locations (ref partitionLocationFetchTimeout)."),
     # --- observability (reference: stats keys 114-123, 133-141)
+    _Key("wire_compress", False, "bool",
+         doc="Compress DCN block-fetch payloads (zlib) — the analogue of the "
+             "engine-level shuffle block compression the reference inherits."),
+    _Key("wire_compress_min", "8k", "bytes", 0, 1 << 30,
+         doc="Minimum payload size worth compressing."),
     _Key("collect_shuffle_reader_stats", False, "bool",
          doc="Collect per-remote fetch-latency histograms (ref collectShuffleReaderStats)."),
     _Key("fetch_time_bucket_size_ms", 300, "int", 1, 60000,

@@ -236,6 +236,8 @@ class ExecutorEndpoint:
         self._clients = ConnectionCache(self.conf, on_message=self._handle)
         self._table_cache: Dict[int, DriverTable] = {}
         self._table_lock = threading.Lock()
+        self.wire_bytes_in = 0  # compressed-on-the-wire fetch payload total
+        self._wire_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -334,7 +336,18 @@ class ExecutorEndpoint:
             if data is None:
                 return M.FetchBlocksResp(msg.req_id, M.STATUS_UNKNOWN_SHUFFLE, b"")
             parts.append(data)
-        return M.FetchBlocksResp(msg.req_id, M.STATUS_OK, b"".join(parts))
+        payload = b"".join(parts)
+        flags = 0
+        # DCN wire compression — the analogue of the engine-level shuffle
+        # block compression the reference inherits from Spark's serializer
+        # (scala/RdmaShuffleReader.scala:54-69 wraps streams the same way).
+        if (self.conf.wire_compress
+                and len(payload) >= self.conf.wire_compress_min):
+            import zlib
+            compressed = zlib.compress(payload, level=1)
+            if len(compressed) < len(payload):
+                payload, flags = compressed, M.FLAG_ZLIB
+        return M.FetchBlocksResp(msg.req_id, M.STATUS_OK, payload, flags)
 
     # -- client-side fetch calls (used by the fetcher iterator) ----------
 
@@ -404,4 +417,9 @@ class ExecutorEndpoint:
         assert isinstance(resp, M.FetchBlocksResp)
         if resp.status != M.STATUS_OK:
             raise TransportError(f"fetch_blocks status={resp.status}")
+        with self._wire_lock:
+            self.wire_bytes_in += len(resp.data)
+        if resp.flags & M.FLAG_ZLIB:
+            import zlib
+            return zlib.decompress(resp.data)
         return resp.data

@@ -158,20 +158,26 @@ class FetchBlocksReq(RpcMsg):
         return cls(req_id, shuffle_id, blocks)
 
 
+FLAG_ZLIB = 1  # FetchBlocksResp.flags: payload is zlib-compressed
+
+_QII = struct.Struct("<qii")
+
+
 @register(10)
 class FetchBlocksResp(RpcMsg):
-    def __init__(self, req_id: int, status: int, data: bytes):
+    def __init__(self, req_id: int, status: int, data: bytes, flags: int = 0):
         self.req_id = req_id
         self.status = status
         self.data = data
+        self.flags = flags
 
     def payload(self) -> bytes:
-        return _QI.pack(self.req_id, self.status) + self.data
+        return _QII.pack(self.req_id, self.status, self.flags) + self.data
 
     @classmethod
     def from_payload(cls, payload: bytes) -> "FetchBlocksResp":
-        req_id, status = _QI.unpack_from(payload, 0)
-        return cls(req_id, status, payload[_QI.size:])
+        req_id, status, flags = _QII.unpack_from(payload, 0)
+        return cls(req_id, status, payload[_QII.size:], flags)
 
 
 # Status codes shared by responses.

@@ -1,0 +1,108 @@
+"""Multi-host deployment: the exchange over a global (cross-process) mesh.
+
+The reference scales multi-node by giving every executor a verbs endpoint
+and letting the NICs carry the M×R traffic (java/RdmaNode.java;
+README.md:11-31 — 5-7 worker clusters). The TPU-native equivalent is a
+**global ``jax.sharding.Mesh`` spanning hosts**: ``jax.distributed``
+bootstraps the process group, XLA routes collectives over ICI within a
+slice and DCN between hosts, and the same jitted exchange step from
+``parallel.exchange`` runs unchanged — SPMD does not care where shards
+live.
+
+Division of labor (mirrors the reference exactly):
+* **data plane**: the ragged all-to-all over the global mesh (XLA-routed,
+  host CPUs idle — the remote-CPU-bypass invariant);
+* **control plane**: ``parallel.endpoints`` hello/announce + driver tables
+  over TCP (DCN) — in the reference these are the only two RPCs too.
+
+For the driver's multi-chip dry runs and CI, the same code path is
+exercised with multiple *processes of CPU devices* on one machine
+(``tests/test_multihost.py`` spawns a 2-process × 4-device cluster) —
+the process-boundary behavior (global array assembly, cross-process
+collectives) is identical to a real multi-host TPU pod.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def init_multihost(coordinator_address: str, num_processes: int,
+                   process_id: int,
+                   local_device_count: Optional[int] = None,
+                   platform: Optional[str] = None) -> None:
+    """Join the distributed runtime. Call before any jax computation.
+
+    On a real TPU pod each process owns its host's chips and
+    ``local_device_count``/``platform`` stay None; CI passes
+    ``local_device_count=K, platform='cpu'`` to emulate hosts with virtual
+    devices.
+    """
+    import os
+
+    if local_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{local_device_count}").strip()
+    import jax
+
+    if platform is not None:
+        jax.config.update("jax_platforms", platform)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh(axis_name: str = "shuffle"):
+    """One-axis mesh over every device in the cluster."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def shard_local_rows(mesh, axis_name: str, local_rows: np.ndarray,
+                     global_rows: int):
+    """Assemble this process's rows into the global sharded array."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.make_array_from_process_local_data(
+        sharding, local_rows, (global_rows,) + local_rows.shape[1:])
+
+
+def run_multihost_terasort(mesh, axis_name: str, rows_per_device: int,
+                           payload_words: int = 4, seed: int = 0,
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """One TeraSort round over the global mesh; returns this process's
+    local sorted shards + counts (addressable output only — remote shards
+    belong to other processes)."""
+    import jax
+
+    from sparkrdma_tpu.models.terasort import TeraSortConfig, generate_rows, make_terasort_step
+
+    n_global = mesh.devices.size
+    n_local = len(jax.local_devices())
+    process_id = jax.process_index()
+    cfg = TeraSortConfig(rows_per_device=rows_per_device,
+                         payload_words=payload_words, out_factor=2)
+    # each process generates ONLY its slice (O(local) memory/time) with a
+    # process-disjoint deterministic seed
+    local_slice = generate_rows(cfg, n_local,
+                                seed=seed * 100_003 + process_id)
+    rows_global = shard_local_rows(mesh, axis_name, local_slice,
+                                   n_global * rows_per_device)
+    step = make_terasort_step(mesh, axis_name, cfg)
+    out, counts, overflowed = jax.block_until_ready(step(rows_global))
+    local_out = np.concatenate(
+        [np.asarray(s.data) for s in out.addressable_shards])
+    local_counts = np.concatenate(
+        [np.asarray(s.data) for s in counts.addressable_shards])
+    if any(bool(np.asarray(s.data).any()) for s in overflowed.addressable_shards):
+        raise OverflowError("terasort receive overflow on this host")
+    return local_out, local_counts

@@ -1,0 +1,103 @@
+"""Stage retry: recompute lost map outputs on surviving executors.
+
+The reference's whole fault story is "surface ``FetchFailedException`` and
+let the engine recompute the producing stage"
+(scala/RdmaShuffleFetcherIterator.scala:376-381; executor loss observed via
+``SparkListenerBlockManagerRemoved``, scala/RdmaShuffleManager.scala:155-165).
+A standalone framework needs that engine half too: this module provides the
+recompute loop — deterministic map tasks re-run on surviving executors, the
+re-publish overwrites the dead slot's driver-table entry (publishes are
+idempotent positional writes), and reducers retry.
+
+On a TPU mesh the same concern appears as "a failed participant stalls the
+collective"; the recovery mirrors the reference's: drop the dead member
+(tombstone), re-form, re-run the round (SURVEY.md §7 hard part #4).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Sequence, TypeVar
+
+from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
+from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
+
+log = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+# map_fn(writer, map_id): writes the (deterministic) records of map task m.
+MapTask = Callable[[object, int], None]
+# reduce_fn(manager, handle) -> result: builds + drains a reader.
+ReduceTask = Callable[[TpuShuffleManager, ShuffleHandle], T]
+
+
+def run_map_stage(executors: Sequence[TpuShuffleManager],
+                  handle: ShuffleHandle, map_fn: MapTask,
+                  map_ids: Sequence[int] = (),
+                  placement: Dict[int, int] = None) -> Dict[int, int]:
+    """Run map tasks round-robin (or per ``placement``); returns the
+    executor index that ran each map."""
+    live = [i for i, ex in enumerate(executors) if ex.executor is not None]
+    ran: Dict[int, int] = {}
+    ids = list(map_ids) if map_ids else list(range(handle.num_maps))
+    for k, m in enumerate(ids):
+        slot = (placement or {}).get(m, live[k % len(live)])
+        writer = executors[slot].get_writer(handle, m)
+        map_fn(writer, m)
+        writer.close()
+        ran[m] = slot
+    return ran
+
+
+def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
+                          handle: ShuffleHandle, map_fn: MapTask,
+                          reduce_fn: ReduceTask, reducer_index: int,
+                          max_stage_retries: int = 2) -> T:
+    """Reduce; on FetchFailed, recompute the lost maps elsewhere and retry.
+
+    The failed map is identified from the exception; since publishes are
+    positional overwrites, recomputing on any surviving executor atomically
+    repairs the driver table — stragglers fetching concurrently see either
+    the old (dead) or new (live) owner, and the dead one fails them into
+    this same retry path.
+    """
+    attempt = 0
+    while True:
+        try:
+            return reduce_fn(executors[reducer_index], handle)
+        except FetchFailedError as e:
+            attempt += 1
+            if attempt > max_stage_retries:
+                raise
+            # every map currently owned by the failed slot must be
+            # recomputed, not just the one that tripped the fetch
+            dead_slot = e.exec_index
+            table = executors[reducer_index].executor.get_driver_table(
+                handle.shuffle_id, 0, timeout=5)
+            lost_maps: List[int] = []
+            for m in range(handle.num_maps):
+                entry = table.entry(m)
+                if entry is None or entry[1] == dead_slot:
+                    lost_maps.append(m)
+            if not lost_maps and e.map_id >= 0:
+                lost_maps = [e.map_id]
+            log.warning("stage retry %d: recomputing maps %s lost with "
+                        "executor slot %d", attempt, lost_maps, dead_slot)
+            # survivors = executors whose endpoint slot is not the dead one
+            survivors = []
+            for i, ex in enumerate(executors):
+                if ex.executor is None:
+                    continue
+                try:
+                    if ex.executor.exec_index(timeout=1) != dead_slot:
+                        survivors.append(i)
+                except KeyError:
+                    continue
+            if not survivors:
+                raise
+            placement = {m: survivors[k % len(survivors)]
+                         for k, m in enumerate(lost_maps)}
+            run_map_stage(executors, handle, map_fn, lost_maps, placement)
+            # the repaired table must be re-read, not served from cache
+            executors[reducer_index].executor.invalidate_shuffle(handle.shuffle_id)
