@@ -1,0 +1,146 @@
+"""Mesh shuffle service: the bridge from the engine-facing API to the ICI
+data plane.
+
+This closes the loop the reference closes with its NIC: committed map
+outputs (host spill files, ``shuffle/resolver.py``) are staged into device
+HBM through the buffer pool, ONE jitted ragged all-to-all redistributes
+every row to its reduce partition's owner device, and the reduce-side
+group/sort runs on-device. The host's only data-plane job is streaming
+sequential spill bytes up — the per-(map, reduce) scatter the reference
+does with one-sided READs (scala/RdmaShuffleFetcherIterator.scala:119-180)
+happens **on the mesh**, where it is a collective.
+
+Partition → device placement: partition ``p`` is owned by device
+``p % D`` (the same modulo placement the driver-table scheme uses for
+executors).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
+
+
+def _rows_to_u32(keys: np.ndarray, payload: np.ndarray) -> np.ndarray:
+    """Pack (u64 keys, u8 payload) into the device row format:
+    ``u32[N, 2 + ceil(W/4)]`` = key lo, key hi, payload words."""
+    n = len(keys)
+    pw = (payload.shape[1] + 3) // 4
+    rows = np.zeros((n, 2 + pw), dtype=np.uint32)
+    rows[:, :2] = keys.view(np.uint32).reshape(n, 2)
+    if payload.shape[1]:
+        padded = np.zeros((n, pw * 4), dtype=np.uint8)
+        padded[:, :payload.shape[1]] = payload
+        rows[:, 2:] = padded.view(np.uint32).reshape(n, pw)
+    return rows
+
+
+def _u32_to_rows(rows: np.ndarray, payload_bytes: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    if len(rows) == 0:
+        return (np.zeros(0, dtype=np.uint64),
+                np.zeros((0, payload_bytes), dtype=np.uint8))
+    keys = rows[:, :2].copy().view(np.uint64).reshape(-1)
+    payload = rows[:, 2:].copy().view(np.uint8).reshape(
+        len(rows), -1)[:, :payload_bytes]
+    return keys, payload
+
+
+def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
+                    handle: ShuffleHandle, mesh, axis_name: str = "shuffle",
+                    impl: str = "auto", sort_by_key: bool = True,
+                    out_factor: int = 2,
+                    ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Reduce every partition of ``handle`` on the mesh.
+
+    ``managers``: the executor managers whose resolvers hold the committed
+    map outputs (single-host deployment: one process, many executor roles,
+    one mesh — remote spills would arrive via the DCN fetch path first).
+
+    ``out_factor``: receive headroom per device relative to the balanced
+    share (``total/D``); skew beyond it raises OverflowError — chunk with
+    ``parallel.exchange.chunked_exchange`` for unbounded skew.
+
+    Returns, per device ``d``: ``(keys u64[*], payload u8[*, W],
+    partition_ids i64[*])`` for the partitions ``{p : p % D == d}``, rows
+    key-sorted within the device when ``sort_by_key``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
+    from sparkrdma_tpu.shuffle.writer import decode_rows
+
+    n_dev = mesh.shape[axis_name]
+    impl = resolve_impl(mesh, impl)
+    partitioner = handle.partitioner.build(handle.num_partitions)
+
+    # 1. stage: stream every local spill sequentially (no host scatter),
+    # through the resolver's locked serving API (safe vs. concurrent
+    # re-commit/unregister disposal)
+    all_keys, all_payloads = [], []
+    for mgr in managers:
+        if mgr.resolver is None:
+            continue
+        for m in mgr.resolver.map_ids(handle.shuffle_id):
+            raw = mgr.resolver.local_blocks(handle.shuffle_id, m, 0,
+                                            handle.num_partitions)
+            if raw is None:
+                continue  # disposed between map_ids() and the read
+            k, p = decode_rows(raw, handle.row_payload_bytes)
+            all_keys.append(k)
+            all_payloads.append(p)
+    keys = (np.concatenate(all_keys) if all_keys
+            else np.zeros(0, dtype=np.uint64))
+    payload = (np.concatenate(all_payloads) if all_payloads
+               else np.zeros((0, handle.row_payload_bytes), dtype=np.uint8))
+
+    rows = _rows_to_u32(keys, payload)
+    dest_part = np.asarray(partitioner(keys), dtype=np.int32)
+
+    # pad to a device-divisible static capacity with headroom for skew
+    cap = max(1, -(-len(rows) // n_dev))
+    total_cap = cap * n_dev
+    rows_p = np.zeros((total_cap, rows.shape[1]), dtype=np.uint32)
+    rows_p[:len(rows)] = rows
+    dest_p = np.full(total_cap, -1, dtype=np.int32)
+    dest_p[:len(rows)] = dest_part % n_dev  # partition owner device
+
+    width = rows.shape[1]
+    spec = P(axis_name)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
+                       out_specs=(spec, spec, spec))
+    def reduce_step(data, dest):
+        output = jnp.zeros((data.shape[0] * out_factor, width), jnp.uint32)
+        received, recv_counts, _ = shuffle_shard(
+            data, dest, axis_name, n_dev, output=output, impl=impl)
+        total = recv_counts.sum()
+        overflowed = total > output.shape[0]
+        return received, recv_counts[None], overflowed[None]
+
+    sharding = NamedSharding(mesh, spec)
+    received, counts, overflowed = jax.block_until_ready(reduce_step(
+        jax.device_put(rows_p, sharding), jax.device_put(dest_p, sharding)))
+    if np.asarray(overflowed).any():
+        raise OverflowError("mesh reduce receive overflow")
+
+    # 3. unpack per device (host-side view of the device results)
+    received = np.asarray(received).reshape(n_dev, -1, width)
+    counts = np.asarray(counts)
+    results = []
+    for d in range(n_dev):
+        total = int(counts[d].sum())
+        k, p = _u32_to_rows(received[d][:total], handle.row_payload_bytes)
+        parts = np.asarray(partitioner(k), dtype=np.int64)
+        if sort_by_key:
+            order = np.argsort(k, kind="stable")
+            k, p, parts = k[order], p[order], parts[order]
+        results.append((k, p, parts))
+    return results

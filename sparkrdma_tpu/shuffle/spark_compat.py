@@ -1,0 +1,132 @@
+"""Reference-shaped API aliases.
+
+The reference's whole deployment story is "change one config line and the
+engine's existing calls keep working" (README.md:69-71:
+``spark.shuffle.manager org.apache.spark.shuffle.rdma.RdmaShuffleManager``).
+This module exposes the identical method surface —
+``registerShuffle / getWriter / getReader / unregisterShuffle /
+shuffleBlockResolver / stop`` (scala/RdmaShuffleManager.scala:143-310),
+writer ``write / stop`` (writer/wrapper/RdmaWrapperShuffleWriter.scala:
+102-122), reader ``read`` (scala/RdmaShuffleReader.scala:43) — over the
+native snake_case API, so code written against the reference's shapes ports
+mechanically.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.shuffle.manager import (
+    PartitionerSpec,
+    ShuffleHandle,
+    TpuShuffleManager,
+)
+
+
+class ShuffleDependency:
+    """The slice of Spark's ShuffleDependency the reference consumes:
+    partition count + partitioner (scala/RdmaShuffleManager.scala:143-183)."""
+
+    def __init__(self, num_partitions: int,
+                 partitioner: Optional[PartitionerSpec] = None,
+                 row_payload_bytes: int = 0):
+        self.num_partitions = num_partitions
+        self.partitioner = partitioner or PartitionerSpec("hash")
+        self.row_payload_bytes = row_payload_bytes
+
+
+class SparkCompatShuffleManager:
+    """camelCase facade over :class:`TpuShuffleManager`."""
+
+    def __init__(self, conf: Optional[TpuShuffleConf] = None,
+                 isDriver: bool = False, driverAddr=None,
+                 executorId: str = "driver", **kw):
+        self._m = TpuShuffleManager(conf, is_driver=isDriver,
+                                    driver_addr=driverAddr,
+                                    executor_id=executorId, **kw)
+
+    # -- ShuffleManager SPI (scala/RdmaShuffleManager.scala:143-310) ------
+
+    def registerShuffle(self, shuffleId: int, numMaps: int,
+                        dependency: ShuffleDependency) -> ShuffleHandle:
+        return self._m.register_shuffle(shuffleId, numMaps,
+                                        dependency.num_partitions,
+                                        dependency.partitioner,
+                                        dependency.row_payload_bytes)
+
+    def getWriter(self, handle: ShuffleHandle, mapId: int,
+                  context=None) -> "CompatWriter":
+        return CompatWriter(self._m.get_writer(handle, mapId))
+
+    def getReader(self, handle: ShuffleHandle, startPartition: int,
+                  endPartition: int, context=None) -> "CompatReader":
+        return CompatReader(self._m.get_reader(handle, startPartition,
+                                               endPartition))
+
+    def unregisterShuffle(self, shuffleId: int) -> bool:
+        self._m.unregister_shuffle(shuffleId)
+        return True
+
+    @property
+    def shuffleBlockResolver(self):
+        return self._m.resolver
+
+    def stop(self) -> None:
+        self._m.stop()
+
+    # escape hatch to the native API
+    @property
+    def native(self) -> TpuShuffleManager:
+        return self._m
+
+    @property
+    def driverAddr(self):
+        return self._m.driver_addr
+
+
+class CompatWriter:
+    """``write(records)`` + ``stop(success)``
+    (writer/wrapper/RdmaWrapperShuffleWriter.scala:102-122)."""
+
+    def __init__(self, inner):
+        self._w = inner
+
+    def write(self, records: Iterable[Tuple[int, np.ndarray]]) -> None:
+        """records: iterable of (key, payload-row) pairs, or
+        (keys-array, payload-matrix) batches."""
+        if (isinstance(records, tuple) and len(records) == 2
+                and isinstance(records[0], np.ndarray)):
+            self._w.write_batch(*records)
+            return
+        keys, payloads = [], []
+        for k, v in records:
+            keys.append(k)
+            payloads.append(v)
+        if keys:
+            self._w.write_batch(np.asarray(keys, dtype=np.uint64),
+                                np.asarray(payloads, dtype=np.uint8))
+
+    def stop(self, success: bool = True):
+        return self._w.close(success)
+
+
+class CompatReader:
+    """``read()`` -> record iterator (scala/RdmaShuffleReader.scala:43)."""
+
+    def __init__(self, inner):
+        self._r = inner
+
+    def read(self) -> Iterator[Tuple[int, np.ndarray]]:
+        for keys, payload in self._r.read():
+            for i in range(len(keys)):
+                yield int(keys[i]), payload[i]
+
+    def readBatches(self):
+        return self._r.read()
+
+    @property
+    def metrics(self):
+        return self._r.metrics
