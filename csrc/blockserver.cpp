@@ -1,0 +1,360 @@
+// Native block server: the executor's data-serving path in C++.
+//
+// In the reference the serving executor's CPU is NOT in the data path — the
+// NIC serves registered memory directly (one-sided READ,
+// scala/RdmaShuffleFetcherIterator.scala:171-180 against mmap'd files
+// registered in java/RdmaMappedFile.java). On the DCN fallback path this
+// framework serves blocks over TCP; this server removes Python from that
+// path: an epoll loop in one native thread serves FetchBlocks requests
+// straight out of mmap'd spill files (page cache -> socket), with the
+// Python control plane only registering (token -> file) mappings.
+//
+// Wire protocol: byte-compatible with sparkrdma_tpu.parallel.rpc_msg /
+// messages — frames of [total:4][type:4][payload], request type 9
+// (FetchBlocksReq: req_id q, shuffle_id i, count I, blocks (I,Q,I)*),
+// response type 10 (FetchBlocksResp: req_id q, status i, flags i, data).
+// Responses always use flags=0 (no compression on the native path).
+//
+// Exposed as a C ABI for ctypes.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kReqType = 9;
+constexpr uint32_t kRespType = 10;
+constexpr int32_t kStatusOk = 0;
+constexpr int32_t kStatusUnknown = 1;
+constexpr int32_t kStatusBadRange = 3;
+constexpr size_t kMaxFrame = 1u << 30;
+// Hard cap on one response's payload: far above the client's grouped-fetch
+// ceiling (shuffle_read_block_size), far below uint32 frame-length wrap and
+// the client Reassembler's 1 GiB max_frame. Oversized requests get
+// kStatusBadRange instead of a frame the client can't parse (or, past
+// 4 GiB, a wrapped out_total that would heap-overflow the out buffer).
+constexpr uint64_t kMaxRespPayload = 256ull << 20;
+
+struct MappedFile {
+  void* base;
+  uint64_t size;
+};
+
+struct Conn {
+  int fd;
+  std::vector<uint8_t> in;   // accumulated unparsed bytes
+  std::vector<uint8_t> out;  // pending unwritten response bytes
+  size_t out_off = 0;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;
+  uint16_t port = 0;
+  std::thread loop;
+  std::atomic<bool> stop{false};
+  std::mutex files_mu;
+  std::unordered_map<uint32_t, MappedFile> files;
+  std::unordered_map<int, Conn*> conns;
+  std::atomic<uint64_t> bytes_served{0};
+  std::atomic<uint64_t> requests_served{0};
+};
+
+void set_nonblock(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+}
+
+void close_conn(Server* s, Conn* c) {
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  s->conns.erase(c->fd);
+  delete c;
+}
+
+void arm(Server* s, Conn* c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->out.size() > c->out_off ? EPOLLOUT : 0u);
+  ev.data.ptr = c;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// Parse + serve every complete frame in c->in; append responses to c->out.
+bool process_frames(Server* s, Conn* c) {
+  size_t pos = 0;
+  while (c->in.size() - pos >= 8) {
+    uint32_t total, type;
+    memcpy(&total, c->in.data() + pos, 4);
+    memcpy(&type, c->in.data() + pos + 4, 4);
+    if (total < 8 || total > kMaxFrame) return false;  // protocol error
+    if (c->in.size() - pos < total) break;             // incomplete
+    const uint8_t* p = c->in.data() + pos + 8;
+    size_t plen = total - 8;
+    if (type == kReqType && plen >= 16) {
+      int64_t req_id;
+      uint32_t count;
+      memcpy(&req_id, p, 8);
+      // p+8..12: shuffle_id (unused server-side: tokens are global)
+      memcpy(&count, p + 12, 4);
+      const uint8_t* blocks = p + 16;
+      int32_t status = kStatusOk;
+      uint64_t resp_len = 0;
+      if (plen != 16 + (size_t)count * 16) {
+        status = kStatusBadRange;
+        count = 0;
+      }
+      std::lock_guard<std::mutex> lk(s->files_mu);
+      // validate + size pass
+      for (uint32_t i = 0; i < count && status == kStatusOk; ++i) {
+        uint32_t token, length;
+        uint64_t offset;
+        memcpy(&token, blocks + i * 16, 4);
+        memcpy(&offset, blocks + i * 16 + 4, 8);
+        memcpy(&length, blocks + i * 16 + 12, 4);
+        auto it = s->files.find(token);
+        if (it == s->files.end()) {
+          status = kStatusUnknown;
+        } else if (offset > it->second.size ||
+                   length > it->second.size - offset) {
+          status = kStatusBadRange;
+        } else {
+          resp_len += length;
+        }
+      }
+      if (resp_len > kMaxRespPayload && status == kStatusOk)
+        status = kStatusBadRange;
+      if (status != kStatusOk) resp_len = 0;
+      // frame: [total][type][req_id q][status i][flags i][data]
+      uint32_t out_total = (uint32_t)(8 + 16 + resp_len);
+      size_t base = c->out.size();
+      c->out.resize(base + out_total);
+      uint8_t* o = c->out.data() + base;
+      memcpy(o, &out_total, 4);
+      memcpy(o + 4, &kRespType, 4);
+      memcpy(o + 8, &req_id, 8);
+      memcpy(o + 16, &status, 4);
+      uint32_t flags = 0;
+      memcpy(o + 20, &flags, 4);
+      uint8_t* data = o + 24;
+      if (status == kStatusOk) {
+        for (uint32_t i = 0; i < count; ++i) {
+          uint32_t token, length;
+          uint64_t offset;
+          memcpy(&token, blocks + i * 16, 4);
+          memcpy(&offset, blocks + i * 16 + 4, 8);
+          memcpy(&length, blocks + i * 16 + 12, 4);
+          const MappedFile& f = s->files.at(token);
+          memcpy(data, (const char*)f.base + offset, length);
+          data += length;
+        }
+        s->bytes_served += resp_len;
+        s->requests_served += 1;
+      }
+    }
+    // frames of other types (or runts) are ignored: this port serves blocks
+    pos += total;
+  }
+  if (pos) c->in.erase(c->in.begin(), c->in.begin() + pos);
+  return true;
+}
+
+void io_loop(Server* s) {
+  epoll_event events[64];
+  while (!s->stop.load()) {
+    int n = epoll_wait(s->epoll_fd, events, 64, 200);
+    for (int i = 0; i < n; ++i) {
+      if (events[i].data.ptr == nullptr) {  // wake eventfd
+        uint64_t tmp;
+        (void)!read(s->wake_fd, &tmp, 8);
+        continue;
+      }
+      if (events[i].data.ptr == (void*)s) {  // listen socket
+        while (true) {
+          int fd = accept(s->listen_fd, nullptr, nullptr);
+          if (fd < 0) break;
+          set_nonblock(fd);
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn* c = new Conn{fd, {}, {}, 0};
+          s->conns[fd] = c;
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.ptr = c;
+          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+        }
+        continue;
+      }
+      Conn* c = (Conn*)events[i].data.ptr;
+      bool dead = false;
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
+      if (!dead && (events[i].events & EPOLLIN)) {
+        char buf[1 << 16];
+        while (true) {
+          ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
+          if (r > 0) {
+            c->in.insert(c->in.end(), buf, buf + r);
+          } else if (r == 0) {
+            dead = true;
+            break;
+          } else {
+            if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true;
+            break;
+          }
+        }
+        if (!dead && !process_frames(s, c)) dead = true;
+      }
+      if (!dead && c->out.size() > c->out_off) {
+        while (c->out.size() > c->out_off) {
+          ssize_t w = send(c->fd, c->out.data() + c->out_off,
+                           c->out.size() - c->out_off, MSG_NOSIGNAL);
+          if (w > 0) {
+            c->out_off += (size_t)w;
+          } else {
+            if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true;
+            break;
+          }
+        }
+        if (c->out_off == c->out.size()) {
+          c->out.clear();
+          c->out_off = 0;
+        }
+      }
+      if (dead) {
+        close_conn(s, c);
+      } else {
+        arm(s, c);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bs_create(uint16_t port) {
+  Server* s = new Server();
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(s->listen_fd, 128) != 0) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  set_nonblock(s->listen_fd);
+
+  s->epoll_fd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = (void*)s;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  epoll_event wev{};
+  wev.events = EPOLLIN;
+  wev.data.ptr = nullptr;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &wev);
+  s->loop = std::thread(io_loop, s);
+  return s;
+}
+
+uint16_t bs_port(void* handle) { return ((Server*)handle)->port; }
+
+// mmap `path` and serve it under `token`. Returns 0 on success.
+int bs_register_file(void* handle, uint32_t token, const char* path) {
+  Server* s = (Server*)handle;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  void* base = nullptr;
+  if (st.st_size > 0) {
+    base = mmap(nullptr, (size_t)st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (base == MAP_FAILED) {
+      close(fd);
+      return -1;
+    }
+  }
+  close(fd);
+  std::lock_guard<std::mutex> lk(s->files_mu);
+  auto it = s->files.find(token);
+  if (it != s->files.end() && it->second.base)
+    munmap(it->second.base, it->second.size);
+  s->files[token] = MappedFile{base, (uint64_t)st.st_size};
+  return 0;
+}
+
+int bs_unregister_file(void* handle, uint32_t token) {
+  Server* s = (Server*)handle;
+  std::lock_guard<std::mutex> lk(s->files_mu);
+  auto it = s->files.find(token);
+  if (it == s->files.end()) return -1;
+  if (it->second.base) munmap(it->second.base, it->second.size);
+  s->files.erase(it);
+  return 0;
+}
+
+uint64_t bs_bytes_served(void* handle) {
+  return ((Server*)handle)->bytes_served.load();
+}
+
+uint64_t bs_requests_served(void* handle) {
+  return ((Server*)handle)->requests_served.load();
+}
+
+void bs_stop(void* handle) {
+  Server* s = (Server*)handle;
+  s->stop.store(true);
+  uint64_t one = 1;
+  (void)!write(s->wake_fd, &one, 8);
+  if (s->loop.joinable()) s->loop.join();
+  for (auto& [fd, c] : s->conns) {
+    close(c->fd);
+    delete c;
+  }
+  s->conns.clear();
+  {
+    std::lock_guard<std::mutex> lk(s->files_mu);
+    for (auto& [tok, f] : s->files)
+      if (f.base) munmap(f.base, f.size);
+    s->files.clear();
+  }
+  close(s->listen_fd);
+  close(s->epoll_fd);
+  close(s->wake_fd);
+  delete s;
+}
+
+}  // extern "C"

@@ -219,7 +219,7 @@ class ExecutorEndpoint:
                  driver_addr: Tuple[str, int],
                  data_source: Optional[ShuffleDataSource] = None,
                  conf: Optional[TpuShuffleConf] = None,
-                 engine_port: int = 0):
+                 engine_port: int = 0, block_port: int = 0):
         self.conf = conf or TpuShuffleConf()
         self.data_source = data_source
         self.server = ControlServer(manager_id_host, self.conf.executor_port,
@@ -227,7 +227,7 @@ class ExecutorEndpoint:
                                     name=f"exec-{executor}")
         self.manager_id = ShuffleManagerId(
             _ExecutorId(executor, manager_id_host, engine_port),
-            self.server.host, self.server.port)
+            self.server.host, self.server.port, block_port)
         self._driver_addr = driver_addr
         self._members: List[ShuffleManagerId] = []
         self._announce_epoch = -1
@@ -411,7 +411,14 @@ class ExecutorEndpoint:
 
     def fetch_blocks(self, peer: ShuffleManagerId, shuffle_id: int,
                      blocks) -> bytes:
-        conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        # prefer the peer's native block server when advertised: same wire
+        # protocol, no Python on the serving side. The native server doesn't
+        # compress, so when wire compression is requested (bandwidth-starved
+        # DCN) stay on the control path which does.
+        port = (peer.block_port
+                if peer.block_port and not self.conf.wire_compress
+                else peer.rpc_port)
+        conn = self._clients.get(peer.rpc_host, port)
         resp = conn.request(M.FetchBlocksReq(conn.next_req_id(), shuffle_id,
                                              list(blocks)))
         assert isinstance(resp, M.FetchBlocksResp)

@@ -1,0 +1,81 @@
+"""Python wrapper over the native block server (``csrc/blockserver.cpp``).
+
+The executor's data-serving path without Python in it: an epoll thread in
+the shared library serves FetchBlocks frames straight from mmap'd spill
+files. The control plane only registers/unregisters (token -> path)
+mappings here; peers discover the port through ``ShuffleManagerId.
+block_port`` and fetch over a plain pipelined connection (same wire
+protocol as the Python path, so the fetcher is transport-agnostic).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from sparkrdma_tpu.runtime import native
+
+log = logging.getLogger(__name__)
+
+
+class BlockServer:
+    """Owns one native server instance; thread-safe."""
+
+    def __init__(self, port: int = 0):
+        if not native.available():
+            raise RuntimeError("native runtime not built (make -C csrc)")
+        self._h = native.LIB.bs_create(port)
+        if not self._h:
+            raise OSError(f"block server failed to bind port {port}")
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    @property
+    def port(self) -> int:
+        with self._lock:
+            if self._stopped:
+                return 0
+            return int(native.LIB.bs_port(self._h))
+
+    def register_file(self, token: int, path: str) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            rc = native.LIB.bs_register_file(self._h, token, path.encode())
+            if rc != 0:
+                raise OSError(f"block server could not map {path}")
+
+    def unregister_file(self, token: int) -> None:
+        with self._lock:
+            if not self._stopped:
+                native.LIB.bs_unregister_file(self._h, token)
+
+    def stats(self) -> dict:
+        with self._lock:
+            if self._stopped:
+                return {"bytes_served": 0, "requests_served": 0}
+            return {
+                "bytes_served": int(native.LIB.bs_bytes_served(self._h)),
+                "requests_served": int(native.LIB.bs_requests_served(self._h)),
+            }
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            native.LIB.bs_stop(self._h)
+            self._h = None
+
+
+def maybe_create(conf) -> Optional[BlockServer]:
+    """A server when the native runtime is built and enabled; else None."""
+    if conf.use_cpp_runtime and native.available():
+        try:
+            return BlockServer()
+        except OSError as e:
+            log.warning("native block server unavailable, serving via the "
+                        "control path instead: %s", e)
+            return None
+    return None

@@ -21,8 +21,14 @@ _LIB_PATH = os.path.join(os.path.dirname(__file__), "libtpushuffle.so")
 def _load() -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+        return _bind(lib)
+    except (OSError, AttributeError):
+        # missing OR stale .so (built before a symbol was added): degrade to
+        # pure Python rather than failing package import
         return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     u64, i64, vp, cp = (ctypes.c_uint64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_char_p)
     lib.arena_create.argtypes = [u64, u64, ctypes.c_int]
     lib.arena_create.restype = vp
@@ -56,6 +62,21 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.mem_gather.argtypes = [cp, ctypes.POINTER(u64), ctypes.POINTER(u64),
                                u64, cp, ctypes.c_int]
     lib.mem_gather.restype = i64
+    u16 = ctypes.c_uint16
+    lib.bs_create.argtypes = [u16]
+    lib.bs_create.restype = vp
+    lib.bs_port.argtypes = [vp]
+    lib.bs_port.restype = u16
+    lib.bs_register_file.argtypes = [vp, ctypes.c_uint32, cp]
+    lib.bs_register_file.restype = ctypes.c_int
+    lib.bs_unregister_file.argtypes = [vp, ctypes.c_uint32]
+    lib.bs_unregister_file.restype = ctypes.c_int
+    lib.bs_bytes_served.argtypes = [vp]
+    lib.bs_bytes_served.restype = u64
+    lib.bs_requests_served.argtypes = [vp]
+    lib.bs_requests_served.restype = u64
+    lib.bs_stop.argtypes = [vp]
+    lib.bs_stop.restype = None
     return lib
 
 

@@ -105,12 +105,17 @@ class TpuShuffleManager:
             raise ValueError("executor role needs driver_addr")
         self.driver_addr = driver_addr
 
+        self.block_server = None
         if executor_id != "driver":
+            from sparkrdma_tpu.runtime.blockserver import maybe_create
+            self.block_server = maybe_create(self.conf)
             spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpushuffle_")
-            self.resolver = TpuShuffleBlockResolver(spill_dir)
-            self.executor = ExecutorEndpoint(host, executor_id, driver_addr,
-                                             data_source=self.resolver,
-                                             conf=self.conf)
+            self.resolver = TpuShuffleBlockResolver(
+                spill_dir, block_server=self.block_server)
+            self.executor = ExecutorEndpoint(
+                host, executor_id, driver_addr, data_source=self.resolver,
+                conf=self.conf,
+                block_port=self.block_server.port if self.block_server else 0)
             self.executor.start()
             if num_executors_hint:
                 self.executor.wait_for_members(num_executors_hint)
@@ -175,6 +180,9 @@ class TpuShuffleManager:
             self.executor.stop()
         if self.resolver is not None:
             self.resolver.stop()
+        if self.block_server is not None:
+            log.info("native block server stats: %s", self.block_server.stats())
+            self.block_server.stop()
         pool_stats = self.pool.stop()
         if pool_stats.get("bins"):
             log.info("buffer pool stats: %s", pool_stats)

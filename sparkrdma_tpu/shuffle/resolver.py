@@ -36,7 +36,7 @@ class TpuShuffleBlockResolver:
     """shuffle_id -> map_id -> committed SpillFile; implements
     ShuffleDataSource for the executor's control server."""
 
-    def __init__(self, spill_dir: str):
+    def __init__(self, spill_dir: str, block_server=None):
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
         self._shuffles: Dict[int, Dict[int, SpillFile]] = {}
@@ -44,6 +44,9 @@ class TpuShuffleBlockResolver:
         self._lock = threading.Lock()
         self._tokens = itertools.count(1)
         self._attempts = itertools.count(1)
+        # native epoll server (runtime/blockserver.py): committed files are
+        # registered there so peers fetch bytes without Python in the path
+        self.block_server = block_server
 
     # -- write side ------------------------------------------------------
 
@@ -62,6 +65,8 @@ class TpuShuffleBlockResolver:
         os.replace(tmp_path, final)
         token = next(self._tokens)
         spill = SpillFile(final, list(partition_lengths), file_token=token)
+        if self.block_server is not None:
+            self.block_server.register_file(token, final)
         with self._lock:
             # speculative/retried map task: replace and dispose the old
             # mapping (its file was already clobbered by the rename)
@@ -71,6 +76,8 @@ class TpuShuffleBlockResolver:
             if old is not None:
                 self._by_token.pop(old.file_token, None)
         if old is not None:
+            if self.block_server is not None:
+                self.block_server.unregister_file(old.file_token)
             old._delete = False  # the path now belongs to the new spill
             old.dispose()
         return spill, token
@@ -122,6 +129,8 @@ class TpuShuffleBlockResolver:
             for spill in spills.values():
                 self._by_token.pop(spill.file_token, None)
         for spill in spills.values():
+            if self.block_server is not None:
+                self.block_server.unregister_file(spill.file_token)
             spill.dispose()
 
     def stop(self) -> None:

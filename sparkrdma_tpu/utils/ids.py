@@ -56,15 +56,18 @@ class ExecutorId:
 @dataclass(frozen=True)
 class ShuffleManagerId:
     """Control-plane endpoint identity (the reference's RdmaShuffleManagerId,
-    scala/RdmaUtils.scala:88-134): where a peer's control server listens, plus
-    its engine identity."""
+    scala/RdmaUtils.scala:88-134): where a peer's control server listens, its
+    engine identity, and (when the native runtime is built) the C++ block
+    server port peers fetch data bytes from."""
 
     executor_id: ExecutorId
     rpc_host: str
     rpc_port: int
+    block_port: int = 0  # 0 = serve blocks over the control connection
 
     def serialize(self) -> bytes:
-        return self.executor_id.serialize() + _pack_str(self.rpc_host) + _U32.pack(self.rpc_port)
+        return (self.executor_id.serialize() + _pack_str(self.rpc_host)
+                + _U32.pack(self.rpc_port) + _U32.pack(self.block_port))
 
     @staticmethod
     def deserialize(buf: bytes, off: int = 0) -> Tuple["ShuffleManagerId", int]:
@@ -72,7 +75,9 @@ class ShuffleManagerId:
         mv = memoryview(buf)
         rpc_host, off = _unpack_str(mv, off)
         (rpc_port,) = _U32.unpack_from(mv, off)
-        return _intern(ShuffleManagerId(executor_id, rpc_host, rpc_port)), off + 4
+        (block_port,) = _U32.unpack_from(mv, off + 4)
+        return (_intern(ShuffleManagerId(executor_id, rpc_host, rpc_port,
+                                         block_port)), off + 8)
 
 
 @dataclass(frozen=True)
