@@ -51,6 +51,9 @@ constexpr size_t kMaxFrame = 1u << 30;
 // kStatusBadRange instead of a frame the client can't parse (or, past
 // 4 GiB, a wrapped out_total that would heap-overflow the out buffer).
 constexpr uint64_t kMaxRespPayload = 256ull << 20;
+// Stop parsing new requests while this much response data is still
+// unwritten: bounds per-connection memory under pipelined clients.
+constexpr size_t kOutHighWater = 256u << 20;
 
 struct MappedFile {
   void* base;
@@ -100,6 +103,7 @@ void arm(Server* s, Conn* c) {
 bool process_frames(Server* s, Conn* c) {
   size_t pos = 0;
   while (c->in.size() - pos >= 8) {
+    if (c->out.size() - c->out_off > kOutHighWater) break;  // backpressure
     uint32_t total, type;
     memcpy(&total, c->in.data() + pos, 4);
     memcpy(&type, c->in.data() + pos + 4, 4);
@@ -107,7 +111,11 @@ bool process_frames(Server* s, Conn* c) {
     if (c->in.size() - pos < total) break;             // incomplete
     const uint8_t* p = c->in.data() + pos + 8;
     size_t plen = total - 8;
-    if (type == kReqType && plen >= 16) {
+    // this port speaks exactly one request type; anything else is a
+    // protocol violation — drop the connection so the client fails fast
+    // (a TransportError) instead of timing out on a silently-ignored frame
+    if (type != kReqType || plen < 16) return false;
+    {
       int64_t req_id;
       uint32_t count;
       memcpy(&req_id, p, 8);
@@ -168,7 +176,6 @@ bool process_frames(Server* s, Conn* c) {
         s->requests_served += 1;
       }
     }
-    // frames of other types (or runts) are ignored: this port serves blocks
     pos += total;
   }
   if (pos) c->in.erase(c->in.begin(), c->in.begin() + pos);
@@ -234,6 +241,9 @@ void io_loop(Server* s) {
         if (c->out_off == c->out.size()) {
           c->out.clear();
           c->out_off = 0;
+          // backlog drained: serve any requests parked by the high-water
+          // mark while we were blocked on the socket
+          if (!c->in.empty() && !process_frames(s, c)) dead = true;
         }
       }
       if (dead) {
@@ -275,6 +285,13 @@ void* bs_create(uint16_t port) {
 
   s->epoll_fd = epoll_create1(0);
   s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->epoll_fd < 0 || s->wake_fd < 0) {
+    if (s->epoll_fd >= 0) close(s->epoll_fd);
+    if (s->wake_fd >= 0) close(s->wake_fd);
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.ptr = (void*)s;

@@ -415,13 +415,22 @@ class ExecutorEndpoint:
         # protocol, no Python on the serving side. The native server doesn't
         # compress, so when wire compression is requested (bandwidth-starved
         # DCN) stay on the control path which does.
+        blocks = list(blocks)
         port = (peer.block_port
                 if peer.block_port and not self.conf.wire_compress
                 else peer.rpc_port)
         conn = self._clients.get(peer.rpc_host, port)
         resp = conn.request(M.FetchBlocksReq(conn.next_req_id(), shuffle_id,
-                                             list(blocks)))
+                                             blocks))
         assert isinstance(resp, M.FetchBlocksResp)
+        if resp.status != M.STATUS_OK and port != peer.rpc_port:
+            # the native server enforces a stricter response-size cap than
+            # the Python path; retry once through the control connection
+            # before declaring the fetch failed
+            conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+            resp = conn.request(M.FetchBlocksReq(conn.next_req_id(),
+                                                 shuffle_id, blocks))
+            assert isinstance(resp, M.FetchBlocksResp)
         if resp.status != M.STATUS_OK:
             raise TransportError(f"fetch_blocks status={resp.status}")
         with self._wire_lock:

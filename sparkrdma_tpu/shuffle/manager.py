@@ -157,6 +157,18 @@ class TpuShuffleManager:
                                 handle.row_payload_bytes,
                                 reader_stats=self.reader_stats)
 
+    def recover_and_republish(self) -> dict:
+        """Elastic rejoin: recover committed spills from disk and
+        re-publish them under this executor's (new) slot. The positional
+        publish overwrite atomically repairs each driver-table entry."""
+        if self.resolver is None or self.executor is None:
+            raise RuntimeError("executor-role call")
+        recovered = self.resolver.recover()
+        for shuffle_id, entries in recovered.items():
+            for m, token in entries:
+                self.executor.publish_map_output(shuffle_id, m, token)
+        return recovered
+
     def unregister_shuffle(self, shuffle_id: int) -> None:
         """(scala/RdmaShuffleManager.scala:293-299)."""
         if self.driver is not None:

@@ -1,5 +1,13 @@
 """Shuffle block resolver: owns staged map-output data on one executor.
 
+Commit writes a sidecar ``.index`` file (little-endian u64 partition
+lengths) next to the data file — the same durability contract Spark's
+``IndexShuffleBlockResolver`` provides in the reference's stack (the plugin
+intercepts ``writeIndexFileAndCommit``, scala/RdmaShuffleBlockResolver.scala:
+59-65, precisely because those index files exist). ``recover()`` rebuilds
+the in-memory state from those files after an executor restart, enabling
+elastic rejoin without recomputing committed maps.
+
 Re-design of ``scala/RdmaShuffleBlockResolver.scala`` + the data-ownership
 half of ``writer/wrapper/RdmaWrapperShuffleWriter.scala`` (its
 ``RdmaWrapperShuffleData`` owns ``mapId -> RdmaMappedFile``, :36):
@@ -62,9 +70,19 @@ class TpuShuffleBlockResolver:
         """Rename-commit + map for serving. Returns (spill, file_token)."""
         final = os.path.join(self.spill_dir,
                              f"shuffle_{shuffle_id}_{map_id}.data")
+        lengths_arr = np.asarray(list(partition_lengths), dtype=np.uint64)
+        # Crash-safe ordering, including RE-commits of the same map: drop
+        # the old index, rename the data, then atomically publish the new
+        # index. Every crash window leaves data WITHOUT an index, which
+        # recover() treats as lost (recompute) — never a mismatched pair.
+        index = final + ".index"
+        if os.path.exists(index):
+            os.unlink(index)
         os.replace(tmp_path, final)
+        lengths_arr.tofile(index + ".tmp")
+        os.replace(index + ".tmp", index)
         token = next(self._tokens)
-        spill = SpillFile(final, list(partition_lengths), file_token=token)
+        spill = SpillFile(final, lengths_arr.tolist(), file_token=token)
         if self.block_server is not None:
             self.block_server.register_file(token, final)
         with self._lock:
@@ -131,7 +149,53 @@ class TpuShuffleBlockResolver:
         for spill in spills.values():
             if self.block_server is not None:
                 self.block_server.unregister_file(spill.file_token)
+            index = spill.path + ".index"
             spill.dispose()
+            if os.path.exists(index):
+                os.unlink(index)
+
+    def recover(self) -> Dict[int, list]:
+        """Rebuild state from committed (data, index) pairs on disk.
+
+        Returns {shuffle_id: [(map_id, file_token), ...]} of recovered
+        outputs so the caller can re-publish them (elastic rejoin: the
+        restarted executor gets a fresh slot, re-publishes, and reducers
+        route to it). Orphaned ``.tmp`` spill attempts from the crashed
+        process are deleted.
+        """
+        import re as _re
+        recovered: Dict[int, list] = {}
+        for name in sorted(os.listdir(self.spill_dir)):
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.spill_dir, name))
+                except OSError:
+                    pass
+                continue
+            m = _re.fullmatch(r"shuffle_(\d+)_(\d+)\.data", name)
+            if not m:
+                continue
+            data_path = os.path.join(self.spill_dir, name)
+            index_path = data_path + ".index"
+            if not os.path.exists(index_path):
+                continue  # never fully committed
+            lengths = np.fromfile(index_path, dtype=np.uint64)
+            if len(lengths) == 0:
+                continue
+            try:
+                shuffle_id, map_id = int(m.group(1)), int(m.group(2))
+                token = next(self._tokens)
+                spill = SpillFile(data_path, lengths.tolist(),
+                                  file_token=token)
+            except ValueError:
+                continue  # truncated data file: treat as lost
+            if self.block_server is not None:
+                self.block_server.register_file(token, data_path)
+            with self._lock:
+                self._shuffles.setdefault(shuffle_id, {})[map_id] = spill
+                self._by_token[token] = spill
+            recovered.setdefault(shuffle_id, []).append((map_id, token))
+        return recovered
 
     def stop(self) -> None:
         with self._lock:
