@@ -107,7 +107,10 @@ def main() -> None:
             r_d, _of = pstep(e_d, r_d, d_d)
         jax.block_until_ready(r_d)
         pr_dt = (time.perf_counter() - t0) / 5
-        detail["pagerank_edges_per_s"] = round(len(edges) / pr_dt, 0)
+        if np.asarray(_of).any():
+            detail["pagerank_error"] = "receive overflow (raise out_factor)"
+        else:
+            detail["pagerank_edges_per_s"] = round(len(edges) / pr_dt, 0)
     except Exception as e:  # noqa: BLE001
         detail["pagerank_error"] = f"{type(e).__name__}: {e}"[:120]
 
@@ -128,7 +131,10 @@ def main() -> None:
             c, s_, _of = jstep(l_d, r_d2)
             jax.block_until_ready((c, s_))
         j_dt = (time.perf_counter() - t0) / 3
-        detail["join_rows_per_s"] = round((len(left) + len(right)) / j_dt, 0)
+        if np.asarray(_of).any():
+            detail["join_error"] = "receive overflow (raise out_factor)"
+        else:
+            detail["join_rows_per_s"] = round((len(left) + len(right)) / j_dt, 0)
     except Exception as e:  # noqa: BLE001
         detail["join_error"] = f"{type(e).__name__}: {e}"[:120]
 

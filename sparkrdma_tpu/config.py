@@ -104,6 +104,8 @@ _KEYS = [
              "engine-level shuffle block compression the reference inherits."),
     _Key("wire_compress_min", "8k", "bytes", 0, 1 << 30,
          doc="Minimum payload size worth compressing."),
+    _Key("trace_file", "", "str",
+         doc="Write a chrome://tracing JSON of shuffle spans here at stop."),
     _Key("collect_shuffle_reader_stats", False, "bool",
          doc="Collect per-remote fetch-latency histograms (ref collectShuffleReaderStats)."),
     _Key("fetch_time_bucket_size_ms", 300, "int", 1, 60000,

@@ -423,10 +423,12 @@ class ExecutorEndpoint:
         resp = conn.request(M.FetchBlocksReq(conn.next_req_id(), shuffle_id,
                                              blocks))
         assert isinstance(resp, M.FetchBlocksResp)
-        if resp.status != M.STATUS_OK and port != peer.rpc_port:
-            # the native server enforces a stricter response-size cap than
-            # the Python path; retry once through the control connection
-            # before declaring the fetch failed
+        if resp.status == M.STATUS_BAD_RANGE and port != peer.rpc_port:
+            # only the size-cap case is worth retrying: the native server
+            # enforces a stricter response-size cap than the Python path.
+            # Other statuses (unknown token/shuffle) would fail identically
+            # on the control connection — retrying would just double the
+            # failure-path load during an executor-loss storm
             conn = self._clients.get(peer.rpc_host, peer.rpc_port)
             resp = conn.request(M.FetchBlocksReq(conn.next_req_id(),
                                                  shuffle_id, blocks))

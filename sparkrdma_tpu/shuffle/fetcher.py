@@ -112,11 +112,13 @@ class ShuffleFetcher:
                  resolver: Optional[TpuShuffleBlockResolver],
                  conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
                  start_partition: int, end_partition: int,
-                 seed: Optional[int] = None, reader_stats=None):
+                 seed: Optional[int] = None, reader_stats=None, tracer=None):
+        from sparkrdma_tpu.utils import trace as trace_mod
         self.endpoint = endpoint
         self.resolver = resolver
         self.conf = conf
         self.reader_stats = reader_stats  # ShuffleReaderStats | None
+        self.tracer = tracer or trace_mod.NULL
         self.shuffle_id = shuffle_id
         self.num_maps = num_maps
         self.start_partition = start_partition
@@ -136,7 +138,10 @@ class ShuffleFetcher:
     # -- setup: plan + launch (initialize/startAsyncRemoteFetches) -------
 
     def start(self) -> "ShuffleFetcher":
-        table = self.endpoint.get_driver_table(self.shuffle_id, self.num_maps)
+        with self.tracer.span("fetch.driver_table", "fetch",
+                              shuffle=self.shuffle_id):
+            table = self.endpoint.get_driver_table(self.shuffle_id,
+                                                   self.num_maps)
         my_index = self._my_index()
         local_maps: List[int] = []
         by_peer: Dict[int, List[int]] = {}
@@ -201,9 +206,11 @@ class ShuffleFetcher:
             pending: List[_PendingFetch] = []
             for m in maps:
                 # STEP 2: block locations (:293-315).
-                locs = self.endpoint.fetch_output_range(
-                    peer, self.shuffle_id, m,
-                    self.start_partition, self.end_partition)
+                with self.tracer.span("fetch.locations", "fetch",
+                                      map=m, peer=exec_idx):
+                    locs = self.endpoint.fetch_output_range(
+                        peer, self.shuffle_id, m,
+                        self.start_partition, self.end_partition)
                 # STEP 3 grouping: consecutive partitions, ≤ read block size
                 # (:240-263). Zero-length blocks ride along for free.
                 group: List = []
@@ -231,8 +238,11 @@ class ShuffleFetcher:
                 self._acquire_in_flight(fetch.total_bytes)
                 t0 = time.monotonic()
                 try:
-                    data = self.endpoint.fetch_blocks(
-                        peer, self.shuffle_id, fetch.blocks)
+                    with self.tracer.span("fetch.blocks", "fetch",
+                                          map=fetch.map_id, peer=exec_idx,
+                                          bytes=fetch.total_bytes):
+                        data = self.endpoint.fetch_blocks(
+                            peer, self.shuffle_id, fetch.blocks)
                 except (TransportError, AssertionError) as e:
                     self._release_in_flight(fetch.total_bytes)
                     raise FetchFailedError(self.shuffle_id, fetch.map_id,

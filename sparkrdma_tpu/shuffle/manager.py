@@ -38,6 +38,7 @@ from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
 from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
 from sparkrdma_tpu.shuffle.writer import Partitioner, TpuShuffleWriter
 from sparkrdma_tpu.utils.stats import MemStats, ShuffleReaderStats
+from sparkrdma_tpu.utils import trace as trace_mod
 
 import logging
 
@@ -96,6 +97,8 @@ class TpuShuffleManager:
         self.pool = BufferPool(self.conf)
         self.reader_stats = (ShuffleReaderStats(self.conf)
                              if self.conf.collect_shuffle_reader_stats else None)
+        self.tracer = trace_mod.get(self.conf)
+        self._role_name = executor_id  # "driver" for the driver role
         self._mem_stats = MemStats()
 
         if is_driver:
@@ -144,7 +147,7 @@ class TpuShuffleManager:
             self.resolver, handle.shuffle_id, map_id, handle.num_partitions,
             handle.partitioner.build(handle.num_partitions),
             handle.row_payload_bytes)
-        return _PublishingWriter(inner, self.executor)
+        return _PublishingWriter(inner, self.executor, tracer=self.tracer)
 
     def get_reader(self, handle: ShuffleHandle, start_partition: int,
                    end_partition: int) -> TpuShuffleReader:
@@ -155,7 +158,8 @@ class TpuShuffleManager:
                                 handle.shuffle_id, handle.num_maps,
                                 start_partition, end_partition,
                                 handle.row_payload_bytes,
-                                reader_stats=self.reader_stats)
+                                reader_stats=self.reader_stats,
+                                tracer=self.tracer)
 
     def recover_and_republish(self) -> dict:
         """Elastic rejoin: recover committed spills from disk and
@@ -186,6 +190,12 @@ class TpuShuffleManager:
         RdmaBufferManager.java:217-231)."""
         if self.reader_stats is not None:
             self.reader_stats.log_summary(log)
+        if self.tracer.enabled and self.conf.trace_file:
+            # one file per role so a cluster of managers sharing one conf
+            # doesn't overwrite each other's dumps
+            path = f"{self.conf.trace_file}.{self._role_name}.json"
+            n = self.tracer.dump(path)
+            log.info("wrote %d trace events to %s", n, path)
         # quiesce traffic sources before destroying the pool: outstanding
         # readers hold views into pool memory
         if self.executor is not None:
@@ -207,20 +217,28 @@ class _PublishingWriter:
     """Writer wrapper that publishes the map output on successful close
     (RdmaWrapperShuffleWriter.scala:104-122)."""
 
-    def __init__(self, inner: TpuShuffleWriter, endpoint: ExecutorEndpoint):
+    def __init__(self, inner: TpuShuffleWriter, endpoint: ExecutorEndpoint,
+                 tracer=None):
         self._inner = inner
         self._endpoint = endpoint
+        self._tracer = tracer or trace_mod.NULL
 
     def write_batch(self, keys, payload=None) -> None:
         self._inner.write_batch(keys, payload)
 
     def close(self, success: bool = True):
-        result = self._inner.close(success)
+        with self._tracer.span("writer.commit", "write",
+                               shuffle=self._inner.shuffle_id,
+                               map=self._inner.map_id):
+            result = self._inner.close(success)
         if result is None:
             return None
         token, partition_lengths = result
-        self._endpoint.publish_map_output(self._inner.shuffle_id,
-                                          self._inner.map_id, token)
+        with self._tracer.span("writer.publish", "write",
+                               shuffle=self._inner.shuffle_id,
+                               map=self._inner.map_id):
+            self._endpoint.publish_map_output(self._inner.shuffle_id,
+                                              self._inner.map_id, token)
         return token, partition_lengths
 
     @property

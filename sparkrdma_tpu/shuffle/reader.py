@@ -29,11 +29,11 @@ class TpuShuffleReader:
                  resolver: Optional[TpuShuffleBlockResolver],
                  conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
                  start_partition: int, end_partition: int,
-                 row_payload_bytes: int, reader_stats=None):
+                 row_payload_bytes: int, reader_stats=None, tracer=None):
         self.row_payload_bytes = row_payload_bytes
         self.fetcher = ShuffleFetcher(endpoint, resolver, conf, shuffle_id,
                                       num_maps, start_partition, end_partition,
-                                      reader_stats=reader_stats)
+                                      reader_stats=reader_stats, tracer=tracer)
 
     @property
     def metrics(self) -> ReadMetrics:

@@ -52,6 +52,9 @@ class TpuShuffleBlockResolver:
         self._lock = threading.Lock()
         self._tokens = itertools.count(1)
         self._attempts = itertools.count(1)
+        self._commit_lock = threading.Lock()  # serializes the on-disk
+        # unlink-index/rename-data/write-index sequence: concurrent attempts
+        # of one map must not interleave into a mismatched durable pair
         # native epoll server (runtime/blockserver.py): committed files are
         # registered there so peers fetch bytes without Python in the path
         self.block_server = block_server
@@ -75,12 +78,15 @@ class TpuShuffleBlockResolver:
         # the old index, rename the data, then atomically publish the new
         # index. Every crash window leaves data WITHOUT an index, which
         # recover() treats as lost (recompute) — never a mismatched pair.
+        # The lock keeps concurrent attempts of one map from interleaving
+        # the three steps (which could durably pair A's index with B's data).
         index = final + ".index"
-        if os.path.exists(index):
-            os.unlink(index)
-        os.replace(tmp_path, final)
-        lengths_arr.tofile(index + ".tmp")
-        os.replace(index + ".tmp", index)
+        with self._commit_lock:
+            if os.path.exists(index):
+                os.unlink(index)
+            os.replace(tmp_path, final)
+            lengths_arr.tofile(index + ".tmp")
+            os.replace(index + ".tmp", index)
         token = next(self._tokens)
         spill = SpillFile(final, lengths_arr.tolist(), file_token=token)
         if self.block_server is not None:
