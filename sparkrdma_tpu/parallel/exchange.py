@@ -128,9 +128,15 @@ def _gather_exchange(data: jnp.ndarray, mat: jnp.ndarray, my: jnp.ndarray,
     key = jnp.where(keep, order, jnp.int32(num_dev * capacity)).reshape(-1)
     perm = jnp.argsort(key, stable=True)
     flat = rows_all.reshape((num_dev * capacity,) + rows_all.shape[2:])
-    packed = jnp.take(flat, perm[:output.shape[0]], axis=0)
+    # output capacity may exceed D*capacity (generous receive headroom);
+    # pad the permutation with index 0 — those slots are masked off below
+    # (total received rows can never exceed D*capacity)
+    out_cap = output.shape[0]
+    k = min(out_cap, num_dev * capacity)
+    sel = jnp.zeros(out_cap, dtype=perm.dtype).at[:k].set(perm[:k])
+    packed = jnp.take(flat, sel, axis=0)
     total = jnp.sum(mat[:, my])
-    mask = jnp.arange(output.shape[0]) < total
+    mask = jnp.arange(out_cap) < total
     mask = mask.reshape((-1,) + (1,) * (output.ndim - 1))
     return jnp.where(mask, packed, output)
 

@@ -169,6 +169,20 @@ class ShuffleFetcher:
                                           self.end_partition, data,
                                           is_local=True))
 
+        # A freshly-joined reducer can hold driver-table entries referencing
+        # executor slots its membership list hasn't caught up to yet (the
+        # announce is async); wait for the list to cover the highest slot we
+        # need before resolving peers.
+        if by_peer:
+            try:
+                self.endpoint.wait_for_members(
+                    max(by_peer) + 1,
+                    timeout=self.conf.connect_timeout_ms / 1000)
+            except TimeoutError as e:
+                raise FetchFailedError(self.shuffle_id, -1, max(by_peer),
+                                       f"membership never covered slot: {e}"
+                                       ) from e
+
         # One fetch thread per peer: location reads then grouped data reads.
         # The per-peer thread bounds per-channel outstanding work the way the
         # reference divides sendQueueDepth across cores (:82-83).

@@ -1,0 +1,99 @@
+"""Whole-framework integration across REAL process boundaries: the driver
+runs in this process; two executor processes write, publish, and serve;
+a reducer in a fourth process fetches across all of them. This is the
+deployment shape of the reference's multi-node clusters (README.md:11-31)
+at single-machine scale — every byte crosses a process boundary through
+the control plane or the native block server."""
+
+import os
+import subprocess
+import sys
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one shared definition of the shuffle geometry, prepended to both scripts
+_COMMON = f'''
+import sys, numpy as np
+sys.path.insert(0, {REPO_ROOT!r})
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.shuffle.manager import (
+    TpuShuffleManager, ShuffleHandle, PartitionerSpec)
+HANDLE = ShuffleHandle(1, 4, 4, 8, PartitionerSpec("modulo"))
+'''
+
+_WRITER = _COMMON + r'''
+driver_host, driver_port, exec_id, spill_dir, maps = (
+    sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    [int(x) for x in sys.argv[5].split(",")])
+conf = TpuShuffleConf(connect_timeout_ms=5000)
+mgr = TpuShuffleManager(conf, driver_addr=(driver_host, driver_port),
+                        executor_id=exec_id, spill_dir=spill_dir)
+for m in maps:
+    rng = np.random.default_rng(100 + m)
+    w = mgr.get_writer(HANDLE, m)
+    w.write_batch(rng.integers(0, 5000, 1000).astype(np.uint64),
+                  rng.integers(0, 255, (1000, 8)).astype(np.uint8))
+    w.close()
+print("WRITER_DONE", exec_id, flush=True)
+import time
+time.sleep(float(sys.argv[6]))  # stay alive to serve reducers
+mgr.stop()
+'''
+
+_REDUCER = _COMMON + r'''
+driver_host, driver_port = sys.argv[1], int(sys.argv[2])
+conf = TpuShuffleConf(connect_timeout_ms=5000)
+mgr = TpuShuffleManager(conf, driver_addr=(driver_host, driver_port),
+                        executor_id="reducer", spill_dir=sys.argv[3])
+reader = mgr.get_reader(HANDLE, 0, 4)
+keys, payload = reader.read_all()
+expect = np.sort(np.concatenate(
+    [np.random.default_rng(100 + m).integers(0, 5000, 1000) for m in range(4)]
+).astype(np.uint64))
+assert np.array_equal(np.sort(keys), expect), "cross-process data mismatch"
+m = reader.metrics
+assert m.remote_bytes > 0 and m.local_bytes == 0  # everything is remote here
+print("REDUCER_OK rows=%d remote_bytes=%d" % (len(keys), m.remote_bytes),
+      flush=True)
+mgr.stop()
+'''
+
+
+def test_cross_process_shuffle(tmp_path):
+    conf = TpuShuffleConf(connect_timeout_ms=5000)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    driver.register_shuffle(1, 4, 4, PartitionerSpec("modulo"),
+                            row_payload_bytes=8)
+    host, port = driver.driver_addr
+    env = dict(os.environ)
+    writers = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WRITER, host, str(port), f"w{i}",
+             str(tmp_path / f"w{i}"), ",".join(str(m) for m in maps), "25"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for i, maps in enumerate([[0, 1], [2, 3]])
+    ]
+    try:
+        # wait for both writers to commit+publish (driver table fills up)
+        import time
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if driver.driver._tables[1].num_published == 4:
+                break
+            time.sleep(0.2)
+        assert driver.driver._tables[1].num_published == 4, "publishes missing"
+
+        reducer = subprocess.run(
+            [sys.executable, "-c", _REDUCER, host, str(port),
+             str(tmp_path / "r")],
+            capture_output=True, timeout=90, env=env)
+        out = reducer.stdout.decode()
+        assert "REDUCER_OK rows=4000" in out, \
+            f"reducer failed:\n{out[-2000:]}\n{reducer.stderr.decode()[-500:]}"
+    finally:
+        for w in writers:
+            w.kill()
+        driver.stop()

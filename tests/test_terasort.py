@@ -66,3 +66,39 @@ def test_graft_entry_contract():
     assert out.shape[0] == args[0].shape[0]
     assert not bool(np.asarray(overflowed).any())
     mod.dryrun_multichip(8)
+
+
+def test_streamed_terasort_multi_round(mesh):
+    """Dataset 3.5x one round's capacity: bounded rounds, exact global sort."""
+    from sparkrdma_tpu.models.terasort import run_terasort_streamed
+    cfg = TeraSortConfig(rows_per_device=512, payload_words=2, out_factor=2)
+    rng = np.random.default_rng(0)
+    n_rows = int(3.5 * D * cfg.rows_per_device)  # non-divisible tail round
+    rows = rng.integers(0, 2**32, size=(n_rows, 3), dtype=np.uint32)
+    merged, rounds = run_terasort_streamed(mesh, cfg, rows)
+    assert rounds == 4
+    got = np.concatenate(merged)
+    assert len(got) == n_rows
+    prev_max = -1
+    for d in range(D):
+        keys = merged[d][:, 0].astype(np.int64)
+        if len(keys):
+            assert (np.diff(keys) >= 0).all()
+            assert keys[0] >= prev_max
+            prev_max = keys[-1]
+    np.testing.assert_array_equal(np.sort(got[:, 0]), np.sort(rows[:, 0]))
+
+
+def test_streamed_terasort_sentinel_keys_survive(mesh):
+    """Real 0xFFFFFFFF keys must not be confused with tail padding."""
+    from sparkrdma_tpu.models.terasort import run_terasort_streamed
+    cfg = TeraSortConfig(rows_per_device=64, payload_words=1, out_factor=2)
+    n_rows = D * 64 + 13  # forces a padded tail round
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 2**32, size=(n_rows, 2), dtype=np.uint32)
+    rows[::100, 0] = 0xFFFFFFFF  # sprinkle genuine max keys
+    n_max = int((rows[:, 0] == 0xFFFFFFFF).sum())
+    merged, _ = run_terasort_streamed(mesh, cfg, rows)
+    got = np.concatenate(merged)
+    assert len(got) == n_rows
+    assert int((got[:, 0] == 0xFFFFFFFF).sum()) == n_max
