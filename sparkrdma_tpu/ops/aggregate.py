@@ -1,0 +1,85 @@
+"""Device-side aggregation ops for the reduce side of a shuffle.
+
+The reference delegates reduce-side combining to the engine (Spark's
+Aggregator/ExternalSorter, consumed at scala/RdmaShuffleReader.scala:83-114).
+A standalone framework provides them as jittable ops over the exchange's
+packed output: segment reductions keyed by arbitrary u32 keys, built on
+sort + scatter-add so everything stays static-shape and fusable.
+
+All take ``(keys, values, valid)`` padded buffers (the exchange's natural
+output form) and a static ``max_unique`` capacity, returning dense
+``(unique_keys, aggregates, count)`` with padding at the end — the
+device-side equivalents of reduceByKey / countByKey / maxByKey.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _compact_unique(keys: jnp.ndarray, valid: jnp.ndarray,
+                    max_unique: int):
+    """Sorted keys -> (segment ids per row, unique keys buffer, n_unique).
+
+    Rows must be pre-sorted by key with invalid rows at the end (the
+    reduce-side layout ``sort_segments`` produces).
+    """
+    first = jnp.concatenate([jnp.ones(1, bool),
+                             keys[1:] != keys[:-1]]) & valid
+    seg = jnp.cumsum(first) - 1  # segment id per row
+    n_unique = first.sum()
+    uniq = jnp.full(max_unique, jnp.iinfo(keys.dtype).max, keys.dtype)
+    uniq = uniq.at[jnp.where(first, seg, max_unique - 1)].set(
+        jnp.where(first, keys, uniq[max_unique - 1]), mode="drop")
+    return seg, uniq, n_unique
+
+
+def segment_reduce_by_key(keys: jnp.ndarray, values: jnp.ndarray,
+                          valid: jnp.ndarray, max_unique: int,
+                          op: str = "sum",
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """reduceByKey over a padded, key-sorted buffer.
+
+    Returns ``(unique_keys[max_unique], agg[max_unique], n_unique)``;
+    entries past ``n_unique`` are padding (key = dtype max, agg = identity).
+    ``op``: "sum" | "max" | "min" | "count".
+
+    ``n_unique`` counts ALL distinct keys present, so a result with
+    ``n_unique > max_unique`` signals capacity truncation (excess segments
+    collapse into the last slot) — callers must check and re-run with a
+    larger capacity rather than trust the buffers.
+    """
+    seg, uniq, n_unique = _compact_unique(keys, valid, max_unique)
+    seg_safe = jnp.where(valid, jnp.minimum(seg, max_unique - 1), max_unique - 1)
+    if op == "count":
+        contrib = valid.astype(jnp.int32)
+        out = jnp.zeros(max_unique, jnp.int32)
+        agg = out.at[seg_safe].add(jnp.where(valid, contrib, 0), mode="drop")
+    elif op == "sum":
+        contrib = jnp.where(valid, values, 0)
+        agg = jnp.zeros(max_unique, values.dtype).at[seg_safe].add(
+            contrib, mode="drop")
+    elif op == "max":
+        ident = jnp.iinfo(values.dtype).min if jnp.issubdtype(
+            values.dtype, jnp.integer) else -jnp.inf
+        contrib = jnp.where(valid, values, ident)
+        agg = jnp.full(max_unique, ident, values.dtype).at[seg_safe].max(
+            contrib, mode="drop")
+    elif op == "min":
+        ident = jnp.iinfo(values.dtype).max if jnp.issubdtype(
+            values.dtype, jnp.integer) else jnp.inf
+        contrib = jnp.where(valid, values, ident)
+        agg = jnp.full(max_unique, ident, values.dtype).at[seg_safe].min(
+            contrib, mode="drop")
+    else:
+        raise ValueError(f"unknown op {op!r}")
+    return uniq, agg, n_unique
+
+
+def count_by_key(keys: jnp.ndarray, valid: jnp.ndarray, max_unique: int):
+    """countByKey (keys pre-sorted, padded)."""
+    return segment_reduce_by_key(keys, jnp.zeros_like(keys), valid,
+                                 max_unique, op="count")

@@ -1,0 +1,60 @@
+"""Device-side reduce-by-key ops vs numpy oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.ops.aggregate import count_by_key, segment_reduce_by_key
+
+
+def _padded_sorted(rng, n_valid, cap, key_space=20):
+    keys = np.sort(rng.integers(0, key_space, n_valid)).astype(np.uint32)
+    vals = rng.integers(1, 100, n_valid).astype(np.int32)
+    pk = np.full(cap, np.iinfo(np.uint32).max, np.uint32)
+    pv = np.zeros(cap, np.int32)
+    pk[:n_valid] = keys
+    pv[:n_valid] = vals
+    valid = np.arange(cap) < n_valid
+    return pk, pv, valid, keys, vals
+
+
+@pytest.mark.parametrize("op,np_op", [("sum", np.sum), ("max", np.max),
+                                      ("min", np.min)])
+def test_reduce_by_key_matches_numpy(op, np_op):
+    rng = np.random.default_rng(0)
+    pk, pv, valid, keys, vals = _padded_sorted(rng, 150, 256)
+    uniq, agg, n = segment_reduce_by_key(jnp.array(pk), jnp.array(pv),
+                                         jnp.array(valid), 64, op=op)
+    n = int(n)
+    got = dict(zip(np.asarray(uniq)[:n].tolist(), np.asarray(agg)[:n].tolist()))
+    expect = {int(k): int(np_op(vals[keys == k])) for k in np.unique(keys)}
+    assert got == expect
+
+
+def test_count_by_key():
+    rng = np.random.default_rng(1)
+    pk, pv, valid, keys, _ = _padded_sorted(rng, 90, 128, key_space=7)
+    uniq, cnt, n = count_by_key(jnp.array(pk), jnp.array(valid), 16)
+    n = int(n)
+    got = dict(zip(np.asarray(uniq)[:n].tolist(), np.asarray(cnt)[:n].tolist()))
+    expect = {int(k): int((keys == k).sum()) for k in np.unique(keys)}
+    assert got == expect
+
+
+def test_all_padding():
+    pk = np.full(32, np.iinfo(np.uint32).max, np.uint32)
+    valid = np.zeros(32, bool)
+    uniq, agg, n = segment_reduce_by_key(jnp.array(pk),
+                                         jnp.zeros(32, jnp.int32),
+                                         jnp.array(valid), 8)
+    assert int(n) == 0
+    assert int(agg.sum()) == 0
+
+
+def test_single_key():
+    pk = np.full(16, 5, np.uint32)
+    pv = np.ones(16, np.int32)
+    valid = np.ones(16, bool)
+    uniq, agg, n = segment_reduce_by_key(jnp.array(pk), jnp.array(pv),
+                                         jnp.array(valid), 4, op="sum")
+    assert int(n) == 1 and int(uniq[0]) == 5 and int(agg[0]) == 16

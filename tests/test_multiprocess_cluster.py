@@ -39,7 +39,7 @@ for m in maps:
     w.close()
 print("WRITER_DONE", exec_id, flush=True)
 import time
-time.sleep(float(sys.argv[6]))  # stay alive to serve reducers
+time.sleep(float(sys.argv[6]))  # serve until the test's finally kills us
 mgr.stop()
 '''
 
@@ -72,7 +72,7 @@ def test_cross_process_shuffle(tmp_path):
     writers = [
         subprocess.Popen(
             [sys.executable, "-c", _WRITER, host, str(port), f"w{i}",
-             str(tmp_path / f"w{i}"), ",".join(str(m) for m in maps), "25"],
+             str(tmp_path / f"w{i}"), ",".join(str(m) for m in maps), "600"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
         for i, maps in enumerate([[0, 1], [2, 3]])
     ]
