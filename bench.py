@@ -13,15 +13,76 @@ single-host stock sort-shuffle stand-in the reference was compared against
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 
+def _run_with_watchdog() -> int:
+    """Run the real bench in a subprocess with a hard timeout.
+
+    The TPU tunnel can wedge in ways that hang the first device op forever
+    (observed: a prior OOM leaves even trivial jit calls blocking). A hung
+    bench would stall the whole evaluation pipeline; on timeout we emit the
+    one JSON line from a CPU-mesh fallback run, clearly marked, so the
+    record says 'hardware unavailable' instead of nothing.
+    """
+    env = dict(os.environ)
+    env["BENCH_INNER"] = "1"
+    timeout_s = int(env.get("BENCH_TIMEOUT_S", "540"))
+    failure = "unknown"
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, capture_output=True, timeout=timeout_s)
+        line = next((ln for ln in proc.stdout.decode().splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            print(line)
+            return 0
+        # a crash is a CODE problem, not hardware unavailability — keep the
+        # evidence distinguishable from a tunnel hang
+        failure = (f"exit={proc.returncode}: "
+                   + proc.stderr.decode(errors="replace")[-400:])
+    except subprocess.TimeoutExpired:
+        failure = f"timeout after {timeout_s}s (tunnel hang)" 
+    # hardware path hung or failed: small CPU-mesh fallback, marked as such
+    env["BENCH_INNER"] = "1"
+    env["BENCH_FORCE_CPU"] = "1"
+    env.setdefault("BENCH_SIZE_MB", "64")
+    env["BENCH_REPS"] = "2"
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, capture_output=True, timeout=timeout_s)
+        line = next((ln for ln in proc.stdout.decode().splitlines()
+                     if ln.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            result = json.loads(line)
+            result["detail"]["platform"] = "cpu-fallback"
+            result["detail"]["tpu_failure"] = failure
+            print(json.dumps(result))
+            return 0
+        failure += (" | cpu: exit=%d: %s"
+                    % (proc.returncode,
+                       proc.stderr.decode(errors="replace")[-200:]))
+    except subprocess.TimeoutExpired:
+        failure += " | cpu: timeout"
+    print(json.dumps({"metric": "terasort_shuffle_throughput_per_chip",
+                      "value": 0.0, "unit": "GB/s/chip", "vs_baseline": 0.0,
+                      "detail": {"error": failure[-600:]}}))
+    return 1
+
+
 def main() -> None:
     size_mb = int(os.environ.get("BENCH_SIZE_MB", "1024"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     import jax
     from jax.sharding import Mesh
@@ -149,4 +210,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("BENCH_INNER") == "1":
+        sys.exit(main())
+    sys.exit(_run_with_watchdog())

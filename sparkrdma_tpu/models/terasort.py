@@ -210,7 +210,7 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
     # at most ~rows_per_device real rows (uniform keys) + ~rows_per_device
     # pads, which fits the out_factor>=2 receive budget; genuine key skew is
     # caught by the overflow flag like any other round.
-    if cfg.out_factor < 2 and len(rows) % per_round:
+    if n > 1 and cfg.out_factor < 2 and len(rows) % per_round:
         raise ValueError("streamed terasort with a partial tail round needs "
                          "out_factor >= 2 (pad headroom)")
 

@@ -32,8 +32,10 @@ def _compact_unique(keys: jnp.ndarray, valid: jnp.ndarray,
     seg = jnp.cumsum(first) - 1  # segment id per row
     n_unique = first.sum()
     uniq = jnp.full(max_unique, jnp.iinfo(keys.dtype).max, keys.dtype)
-    uniq = uniq.at[jnp.where(first, seg, max_unique - 1)].set(
-        jnp.where(first, keys, uniq[max_unique - 1]), mode="drop")
+    # non-first rows target index max_unique: out of bounds, dropped — they
+    # must NOT collide with the last real slot (scatter order with duplicate
+    # indices is undefined, which would clobber the max_unique-th key)
+    uniq = uniq.at[jnp.where(first, seg, max_unique)].set(keys, mode="drop")
     return seg, uniq, n_unique
 
 

@@ -58,3 +58,16 @@ def test_single_key():
     uniq, agg, n = segment_reduce_by_key(jnp.array(pk), jnp.array(pv),
                                          jnp.array(valid), 4, op="sum")
     assert int(n) == 1 and int(uniq[0]) == 5 and int(agg[0]) == 16
+
+
+def test_exact_capacity_last_key_survives():
+    """n_unique == max_unique exactly: the last unique key must not be
+    clobbered by padding-filler scatter collisions."""
+    pk = np.array([1, 2, 2, 3, 7, 7, 7], dtype=np.uint32)
+    pv = np.ones(7, np.int32)
+    valid = np.ones(7, bool)
+    uniq, agg, n = segment_reduce_by_key(jnp.array(pk), jnp.array(pv),
+                                         jnp.array(valid), 4, op="sum")
+    assert int(n) == 4
+    assert np.asarray(uniq).tolist() == [1, 2, 3, 7]
+    assert np.asarray(agg).tolist() == [1, 2, 1, 3]
