@@ -37,3 +37,15 @@ Subpackages
 __version__ = "0.1.0"
 
 from sparkrdma_tpu.config import TpuShuffleConf  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy top-level conveniences: the engine-facing API without forcing
+    # jax/socket imports at package-import time.
+    if name in ("TpuShuffleManager", "PartitionerSpec", "ShuffleHandle"):
+        from sparkrdma_tpu.shuffle import manager
+        return getattr(manager, name)
+    if name == "SparkCompatShuffleManager":
+        from sparkrdma_tpu.shuffle.spark_compat import SparkCompatShuffleManager
+        return SparkCompatShuffleManager
+    raise AttributeError(f"module 'sparkrdma_tpu' has no attribute {name!r}")

@@ -31,7 +31,6 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from sparkrdma_tpu.config import TpuShuffleConf
-from sparkrdma_tpu.ops import partition as partition_ops
 from sparkrdma_tpu.parallel.endpoints import DriverEndpoint, ExecutorEndpoint
 from sparkrdma_tpu.runtime.pool import BufferPool
 from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
@@ -55,9 +54,17 @@ class PartitionerSpec:
 
     def build(self, num_partitions: int) -> Partitioner:
         if self.kind == "hash":
-            return lambda keys: np.asarray(
-                partition_ops.hash_partition(
-                    np.asarray(keys, dtype=np.uint32), num_partitions))
+            # host-side numpy mirror of ops.partition.hash_partition (same
+            # murmur finalizer, bit-identical) — the writer partitions on
+            # the host, and routing through jnp would dispatch to the
+            # default accelerator for no benefit
+            def hash_part(keys):
+                k = np.asarray(keys, dtype=np.uint64) & 0xFFFFFFFF
+                k = ((k ^ (k >> 16)) * 0x85EBCA6B) & 0xFFFFFFFF
+                k = ((k ^ (k >> 13)) * 0xC2B2AE35) & 0xFFFFFFFF
+                k = k ^ (k >> 16)
+                return (k % num_partitions).astype(np.int64)
+            return hash_part
         if self.kind == "range":
             splitters = np.asarray(self.splitters, dtype=np.uint64)
             return lambda keys: np.searchsorted(

@@ -267,3 +267,33 @@ def test_native_block_server_serves_fetches(cluster):
     assert served >= 2000 * 16
     reqs = sum(e.block_server.stats()["requests_served"] for e in execs)
     assert reqs > 0
+
+
+def test_cli_selftest_and_config():
+    """python -m sparkrdma_tpu surfaces work without touching accelerators."""
+    import subprocess, sys, json, os
+    env = dict(os.environ)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-m", "sparkrdma_tpu", "selftest"],
+                       capture_output=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr.decode()[-500:]
+    line = [l for l in r.stdout.decode().splitlines() if l.startswith("{")]
+    assert line and json.loads(line[0])["selftest"] == "ok", r.stdout.decode()[-500:]
+    r2 = subprocess.run([sys.executable, "-m", "sparkrdma_tpu", "config"],
+                        capture_output=True, timeout=60, env=env)
+    assert r2.returncode == 0 and b"shuffle_read_block_size" in r2.stdout
+    r3 = subprocess.run([sys.executable, "-m", "sparkrdma_tpu", "nope"],
+                        capture_output=True, timeout=60, env=env)
+    assert r3.returncode == 2
+
+
+def test_hash_partitioner_host_device_identical():
+    """The writer's numpy hash must match the device op bit-for-bit (rows
+    partitioned on the host are fetched by device-side consumers that
+    recompute the same partition ids)."""
+    from sparkrdma_tpu.ops.partition import hash_partition
+    keys = np.random.default_rng(3).integers(0, 2**64, 50_000, dtype=np.uint64)
+    host = PartitionerSpec("hash").build(16)(keys)
+    dev = np.asarray(hash_partition(keys.astype(np.uint32), 16))
+    np.testing.assert_array_equal(host, dev)
