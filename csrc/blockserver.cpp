@@ -5,9 +5,12 @@
 // scala/RdmaShuffleFetcherIterator.scala:171-180 against mmap'd files
 // registered in java/RdmaMappedFile.java). On the DCN fallback path this
 // framework serves blocks over TCP; this server removes Python from that
-// path: an epoll loop in one native thread serves FetchBlocks requests
-// straight out of mmap'd spill files (page cache -> socket), with the
-// Python control plane only registering (token -> file) mappings.
+// path: connections are sharded round-robin across N epoll worker threads
+// (the reference round-robins channels across its cpuList and pins the
+// completion thread, java/RdmaNode.java:222-279 + java/RdmaThread.java:46-48)
+// serving FetchBlocks requests straight out of mmap'd spill files
+// (page cache -> socket), with the Python control plane only registering
+// (token -> file) mappings.
 //
 // Wire protocol: byte-compatible with sparkrdma_tpu.parallel.rpc_msg /
 // messages — frames of [total:4][type:4][payload], request type 9
@@ -21,6 +24,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -30,6 +34,8 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <pthread.h>
+#include <sched.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/mman.h>
@@ -44,16 +50,23 @@ constexpr uint32_t kRespType = 10;
 constexpr int32_t kStatusOk = 0;
 constexpr int32_t kStatusUnknown = 1;
 constexpr int32_t kStatusBadRange = 3;
-constexpr size_t kMaxFrame = 1u << 30;
+// Request frames on this port are tiny ([16 fixed + 16/block]); anything
+// larger than 1 MiB (~65k blocks) is a protocol violation, and capping the
+// inbound frame well below kInHighWater guarantees a parked connection can
+// always finish buffering the frame it is mid-way through.
+constexpr size_t kMaxReqFrame = 1u << 20;
 // Hard cap on one response's payload: far above the client's grouped-fetch
 // ceiling (shuffle_read_block_size), far below uint32 frame-length wrap and
 // the client Reassembler's 1 GiB max_frame. Oversized requests get
 // kStatusBadRange instead of a frame the client can't parse (or, past
 // 4 GiB, a wrapped out_total that would heap-overflow the out buffer).
 constexpr uint64_t kMaxRespPayload = 256ull << 20;
-// Stop parsing new requests while this much response data is still
-// unwritten: bounds per-connection memory under pipelined clients.
+// Backpressure high-water marks: while the unwritten response backlog (or
+// unparsed input) exceeds these, the connection stops parsing AND stops
+// recv()ing (EPOLLIN interest is dropped), bounding per-connection memory
+// under pipelined clients instead of buffering toward kMaxFrame.
 constexpr size_t kOutHighWater = 256u << 20;
+constexpr size_t kInHighWater = 4u << 20;
 
 struct MappedFile {
   void* base;
@@ -67,16 +80,31 @@ struct Conn {
   size_t out_off = 0;
 };
 
-struct Server {
-  int listen_fd = -1;
+struct Server;
+
+// One epoll loop; owns the connections assigned to it. Never touched by
+// other threads except through (pending_mu, pending, wake_fd).
+struct Worker {
+  Server* server = nullptr;
   int epoll_fd = -1;
   int wake_fd = -1;
+  std::thread th;
+  std::unordered_map<int, Conn*> conns;
+  std::mutex pending_mu;
+  std::vector<int> pending;  // accepted fds awaiting registration here
+};
+
+struct Server {
+  int listen_fd = -1;
+  int accept_epoll_fd = -1;
+  int accept_wake_fd = -1;
   uint16_t port = 0;
-  std::thread loop;
+  std::thread accept_th;
+  std::deque<Worker> workers;
+  std::atomic<uint32_t> next_worker{0};
   std::atomic<bool> stop{false};
   std::mutex files_mu;
   std::unordered_map<uint32_t, MappedFile> files;
-  std::unordered_map<int, Conn*> conns;
   std::atomic<uint64_t> bytes_served{0};
   std::atomic<uint64_t> requests_served{0};
 };
@@ -85,18 +113,20 @@ void set_nonblock(int fd) {
   fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
 }
 
-void close_conn(Server* s, Conn* c) {
-  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+void close_conn(Worker* w, Conn* c) {
+  epoll_ctl(w->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
-  s->conns.erase(c->fd);
+  w->conns.erase(c->fd);
   delete c;
 }
 
-void arm(Server* s, Conn* c) {
+void arm(Worker* w, Conn* c) {
+  size_t backlog = c->out.size() - c->out_off;
+  bool want_in = c->in.size() < kInHighWater && backlog < kOutHighWater;
   epoll_event ev{};
-  ev.events = EPOLLIN | (c->out.size() > c->out_off ? EPOLLOUT : 0u);
+  ev.events = (want_in ? EPOLLIN : 0u) | (backlog ? EPOLLOUT : 0u);
   ev.data.ptr = c;
-  epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+  epoll_ctl(w->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
 // Parse + serve every complete frame in c->in; append responses to c->out.
@@ -107,8 +137,8 @@ bool process_frames(Server* s, Conn* c) {
     uint32_t total, type;
     memcpy(&total, c->in.data() + pos, 4);
     memcpy(&type, c->in.data() + pos + 4, 4);
-    if (total < 8 || total > kMaxFrame) return false;  // protocol error
-    if (c->in.size() - pos < total) break;             // incomplete
+    if (total < 8 || total > kMaxReqFrame) return false;  // protocol error
+    if (c->in.size() - pos < total) break;                // incomplete
     const uint8_t* p = c->in.data() + pos + 8;
     size_t plen = total - 8;
     // this port speaks exactly one request type; anything else is a
@@ -182,29 +212,27 @@ bool process_frames(Server* s, Conn* c) {
   return true;
 }
 
-void io_loop(Server* s) {
+void worker_loop(Worker* w) {
+  Server* s = w->server;
   epoll_event events[64];
   while (!s->stop.load()) {
-    int n = epoll_wait(s->epoll_fd, events, 64, 200);
+    int n = epoll_wait(w->epoll_fd, events, 64, 200);
     for (int i = 0; i < n; ++i) {
       if (events[i].data.ptr == nullptr) {  // wake eventfd
         uint64_t tmp;
-        (void)!read(s->wake_fd, &tmp, 8);
-        continue;
-      }
-      if (events[i].data.ptr == (void*)s) {  // listen socket
-        while (true) {
-          int fd = accept(s->listen_fd, nullptr, nullptr);
-          if (fd < 0) break;
-          set_nonblock(fd);
-          int one = 1;
-          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        (void)!read(w->wake_fd, &tmp, 8);
+        std::vector<int> fds;
+        {
+          std::lock_guard<std::mutex> lk(w->pending_mu);
+          fds.swap(w->pending);
+        }
+        for (int fd : fds) {
           Conn* c = new Conn{fd, {}, {}, 0};
-          s->conns[fd] = c;
+          w->conns[fd] = c;
           epoll_event ev{};
           ev.events = EPOLLIN;
           ev.data.ptr = c;
-          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+          epoll_ctl(w->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
         }
         continue;
       }
@@ -213,7 +241,7 @@ void io_loop(Server* s) {
       if (events[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
       if (!dead && (events[i].events & EPOLLIN)) {
         char buf[1 << 16];
-        while (true) {
+        while (c->in.size() < kInHighWater) {
           ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
           if (r > 0) {
             c->in.insert(c->in.end(), buf, buf + r);
@@ -229,10 +257,10 @@ void io_loop(Server* s) {
       }
       if (!dead && c->out.size() > c->out_off) {
         while (c->out.size() > c->out_off) {
-          ssize_t w = send(c->fd, c->out.data() + c->out_off,
-                           c->out.size() - c->out_off, MSG_NOSIGNAL);
-          if (w > 0) {
-            c->out_off += (size_t)w;
+          ssize_t w2 = send(c->fd, c->out.data() + c->out_off,
+                            c->out.size() - c->out_off, MSG_NOSIGNAL);
+          if (w2 > 0) {
+            c->out_off += (size_t)w2;
           } else {
             if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true;
             break;
@@ -247,19 +275,69 @@ void io_loop(Server* s) {
         }
       }
       if (dead) {
-        close_conn(s, c);
+        close_conn(w, c);
       } else {
-        arm(s, c);
+        arm(w, c);
       }
     }
   }
+}
+
+void accept_loop(Server* s) {
+  epoll_event events[8];
+  while (!s->stop.load()) {
+    int n = epoll_wait(s->accept_epoll_fd, events, 8, 200);
+    for (int i = 0; i < n; ++i) {
+      if (events[i].data.ptr == nullptr) {  // wake eventfd
+        uint64_t tmp;
+        (void)!read(s->accept_wake_fd, &tmp, 8);
+        continue;
+      }
+      while (true) {
+        int fd = accept(s->listen_fd, nullptr, nullptr);
+        if (fd < 0) break;
+        set_nonblock(fd);
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // round-robin the connection onto a worker (the reference assigns
+        // each channel the next cpu vector, java/RdmaNode.java:222-279)
+        Worker& w = s->workers[s->next_worker++ % s->workers.size()];
+        {
+          std::lock_guard<std::mutex> lk(w.pending_mu);
+          w.pending.push_back(fd);
+        }
+        uint64_t one64 = 1;
+        (void)!write(w.wake_fd, &one64, 8);
+      }
+    }
+  }
+}
+
+void destroy(Server* s) {
+  for (Worker& w : s->workers) {
+    if (w.epoll_fd >= 0) close(w.epoll_fd);
+    if (w.wake_fd >= 0) close(w.wake_fd);
+  }
+  if (s->accept_epoll_fd >= 0) close(s->accept_epoll_fd);
+  if (s->accept_wake_fd >= 0) close(s->accept_wake_fd);
+  if (s->listen_fd >= 0) close(s->listen_fd);
+  delete s;
 }
 
 }  // namespace
 
 extern "C" {
 
-void* bs_create(uint16_t port) {
+// host: dotted-quad bind address; empty/null binds loopback. The data port
+// serves registered spill bytes unauthenticated, so it binds exactly as
+// wide as asked — multi-host deployments pass the control-plane host and
+// must firewall the port, same trust model as the reference's verbs
+// listener (java/RdmaNode.java:74-88).
+// num_threads: epoll worker count (>=1).
+// cpus/num_cpus: optional CPU pin list; worker i pins to cpus[i % num_cpus]
+// (the reference pins completion threads, java/RdmaThread.java:46-48).
+void* bs_create(const char* host, uint16_t port, int num_threads,
+                const int* cpus, int num_cpus) {
   Server* s = new Server();
   s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
@@ -270,7 +348,13 @@ void* bs_create(uint16_t port) {
   setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (host && host[0] &&
+      inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
   addr.sin_port = htons(port);
   if (bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
       listen(s->listen_fd, 128) != 0) {
@@ -283,24 +367,50 @@ void* bs_create(uint16_t port) {
   s->port = ntohs(addr.sin_port);
   set_nonblock(s->listen_fd);
 
-  s->epoll_fd = epoll_create1(0);
-  s->wake_fd = eventfd(0, EFD_NONBLOCK);
-  if (s->epoll_fd < 0 || s->wake_fd < 0) {
-    if (s->epoll_fd >= 0) close(s->epoll_fd);
-    if (s->wake_fd >= 0) close(s->wake_fd);
-    close(s->listen_fd);
-    delete s;
+  s->accept_epoll_fd = epoll_create1(0);
+  s->accept_wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->accept_epoll_fd < 0 || s->accept_wake_fd < 0) {
+    destroy(s);
     return nullptr;
   }
-  epoll_event ev{};
-  ev.events = EPOLLIN;
-  ev.data.ptr = (void*)s;
-  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  epoll_event lev{};
+  lev.events = EPOLLIN;
+  lev.data.ptr = (void*)s;
+  epoll_ctl(s->accept_epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &lev);
   epoll_event wev{};
   wev.events = EPOLLIN;
   wev.data.ptr = nullptr;
-  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &wev);
-  s->loop = std::thread(io_loop, s);
+  epoll_ctl(s->accept_epoll_fd, EPOLL_CTL_ADD, s->accept_wake_fd, &wev);
+
+  if (num_threads < 1) num_threads = 1;
+  s->workers.resize((size_t)num_threads);
+  for (Worker& w : s->workers) {
+    w.server = s;
+    w.epoll_fd = epoll_create1(0);
+    w.wake_fd = eventfd(0, EFD_NONBLOCK);
+    if (w.epoll_fd < 0 || w.wake_fd < 0) {
+      destroy(s);
+      return nullptr;
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = nullptr;
+    epoll_ctl(w.epoll_fd, EPOLL_CTL_ADD, w.wake_fd, &ev);
+  }
+  for (size_t i = 0; i < s->workers.size(); ++i) {
+    Worker& w = s->workers[i];
+    w.th = std::thread(worker_loop, &w);
+    if (cpus && num_cpus > 0) {
+      int cpu = cpus[i % (size_t)num_cpus];
+      if (cpu >= 0 && cpu < CPU_SETSIZE) {  // reject garbage ids: CPU_SET
+        cpu_set_t set;                      // with a bad index is UB
+        CPU_ZERO(&set);
+        CPU_SET(cpu, &set);
+        pthread_setaffinity_np(w.th.native_handle(), sizeof(set), &set);
+      }
+    }
+  }
+  s->accept_th = std::thread(accept_loop, s);
   return s;
 }
 
@@ -355,23 +465,28 @@ void bs_stop(void* handle) {
   Server* s = (Server*)handle;
   s->stop.store(true);
   uint64_t one = 1;
-  (void)!write(s->wake_fd, &one, 8);
-  if (s->loop.joinable()) s->loop.join();
-  for (auto& [fd, c] : s->conns) {
-    close(c->fd);
-    delete c;
+  (void)!write(s->accept_wake_fd, &one, 8);
+  for (Worker& w : s->workers) (void)!write(w.wake_fd, &one, 8);
+  if (s->accept_th.joinable()) s->accept_th.join();
+  for (Worker& w : s->workers) {
+    if (w.th.joinable()) w.th.join();
+    for (auto& [fd, c] : w.conns) {
+      close(c->fd);
+      delete c;
+    }
+    w.conns.clear();
+    // accepted but never registered (stop raced the wake)
+    std::lock_guard<std::mutex> lk(w.pending_mu);
+    for (int fd : w.pending) close(fd);
+    w.pending.clear();
   }
-  s->conns.clear();
   {
     std::lock_guard<std::mutex> lk(s->files_mu);
     for (auto& [tok, f] : s->files)
       if (f.base) munmap(f.base, f.size);
     s->files.clear();
   }
-  close(s->listen_fd);
-  close(s->epoll_fd);
-  close(s->wake_fd);
-  delete s;
+  destroy(s);
 }
 
 }  // extern "C"

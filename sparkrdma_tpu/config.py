@@ -118,6 +118,12 @@ _KEYS = [
          doc="TPU-only: host threads for spill-file gather into staging buffers."),
     _Key("use_cpp_runtime", True, "bool",
          doc="TPU-only: use the C++ arena/staging shim when built; else pure-Python."),
+    _Key("block_server_threads", 1, "int", 1, 256,
+         doc="Native block server epoll worker count; connections shard "
+             "round-robin (ref java/RdmaNode.java:222-279 cpu vector)."),
+    _Key("block_server_cpus", "", "str",
+         doc="Comma-separated cores to pin block-server workers to; empty = "
+             "no pinning (ref cpuList + java/RdmaThread.java:46-48)."),
 ]
 
 _KEY_MAP: Dict[str, _Key] = {k.name: k for k in _KEYS}

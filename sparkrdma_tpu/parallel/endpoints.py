@@ -78,6 +78,16 @@ class DriverEndpoint:
         self._tables: Dict[int, DriverTable] = {}
         self._tables_lock = threading.Lock()
         self._clients = ConnectionCache(self.conf)
+        # One broadcaster thread + a coalescing slot instead of a thread per
+        # membership event: N executors joining produce O(N) sends of the
+        # newest snapshot, not O(N^2) (the reference pre-connects async and
+        # caches for the same reason, java/RdmaNode.java:283-353).
+        self._announce_cond = threading.Condition()
+        self._announce_pending: Optional[Tuple[List[ShuffleManagerId], int]] = None
+        self._announce_stop = False
+        self._broadcaster = threading.Thread(
+            target=self._broadcast_loop, daemon=True, name="driver-announce")
+        self._broadcaster.start()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -114,8 +124,7 @@ class DriverEndpoint:
                              for m in self._members]
             self._members_epoch += 1
             snapshot, epoch = list(self._members), self._members_epoch
-        threading.Thread(target=self._broadcast, args=(snapshot, epoch),
-                         daemon=True, name="driver-announce").start()
+        self._queue_announce(snapshot, epoch)
 
     # -- message handling ------------------------------------------------
 
@@ -139,8 +148,35 @@ class DriverEndpoint:
             snapshot, epoch = list(self._members), self._members_epoch
         # Broadcast the full ordered membership to everyone, async — the
         # driver connects out to each executor's control server.
-        threading.Thread(target=self._broadcast, args=(snapshot, epoch),
-                         daemon=True, name="driver-announce").start()
+        self._queue_announce(snapshot, epoch)
+
+    def _queue_announce(self, snapshot: List[ShuffleManagerId],
+                        epoch: int) -> None:
+        """Hand the broadcaster the newest snapshot; older queued ones are
+        superseded (every snapshot is the full membership, so skipping
+        intermediates loses nothing — executors order by epoch anyway)."""
+        with self._announce_cond:
+            if (self._announce_pending is None
+                    or epoch > self._announce_pending[1]):
+                self._announce_pending = (snapshot, epoch)
+            self._announce_cond.notify()
+
+    def _broadcast_loop(self) -> None:
+        while True:
+            with self._announce_cond:
+                while self._announce_pending is None and not self._announce_stop:
+                    self._announce_cond.wait()
+                if self._announce_stop:
+                    return
+                snapshot, epoch = self._announce_pending
+                self._announce_pending = None
+            try:
+                self._broadcast(snapshot, epoch)
+            except Exception:  # noqa: BLE001 — a bad snapshot must cost one
+                # broadcast, not the whole announce plane (the single
+                # long-lived thread would otherwise die silently)
+                log.exception("driver: announce broadcast (epoch %d) failed",
+                              epoch)
 
     def _broadcast(self, members: List[ShuffleManagerId], epoch: int) -> None:
         announce = AnnounceMsg(members, epoch)
@@ -148,12 +184,17 @@ class DriverEndpoint:
         for m in members:
             if m == TOMBSTONE:
                 continue
+            if self._announce_stop:
+                # stop() raced us: bail before minting fresh connections the
+                # just-run close_all() would never see
+                return
             # Two attempts: a failed send on a stale cached connection is
             # not evidence of peer death — retry on a fresh connection and
             # only declare the peer lost if that also fails (a transient
             # blip must not permanently tombstone a live executor).
             delivered = False
             for attempt in range(2):
+                conn = None
                 try:
                     conn = self._clients.get(m.rpc_host, m.rpc_port)
                     conn.send(announce)
@@ -163,10 +204,8 @@ class DriverEndpoint:
                     log.warning("driver: announce to %s:%s failed "
                                 "(attempt %d): %s", m.rpc_host, m.rpc_port,
                                 attempt + 1, e)
-                    try:
+                    if conn is not None:
                         conn.close()  # drop the stale connection
-                    except UnboundLocalError:
-                        pass
             if not delivered:
                 lost.append(m)
         # Failure detection: an unreachable executor is treated as lost and
@@ -208,6 +247,10 @@ class DriverEndpoint:
         return M.FetchTableResp(msg.req_id, table.num_published, table.to_bytes())
 
     def stop(self) -> None:
+        with self._announce_cond:
+            self._announce_stop = True
+            self._announce_cond.notify()
+        self._broadcaster.join(timeout=self.conf.teardown_timeout_ms / 1000)
         self._clients.close_all()
         self.server.stop()
 
@@ -325,11 +368,27 @@ class ExecutorEndpoint:
         return M.FetchOutputResp(msg.req_id, M.STATUS_OK,
                                  table.get_range(msg.start_partition, msg.end_partition))
 
+    # Response-payload caps, mirroring the native server's kMaxRespPayload:
+    # reject before reading so an oversized request can't build a frame the
+    # client Reassembler drops (>1 GiB tears down the shared pipelined
+    # connection) or that wraps the u32 frame length past 4 GiB. Multi-block
+    # groups are client-capped at shuffle_read_block_size so 256 MiB is
+    # generous; a *single* block (the fetcher's oversized-fetch escape,
+    # shuffle/fetcher.py:291) may legitimately be bigger and is allowed up
+    # to a Reassembler-safe bound.
+    _MAX_RESP_PAYLOAD = 256 << 20
+    _MAX_SINGLE_BLOCK = (1 << 30) - (1 << 20)
+
     def _on_fetch_blocks(self, msg: M.FetchBlocksReq) -> RpcMsg:
         """Serve a scatter data read (DCN fallback of the one-sided READ,
         scala/RdmaShuffleFetcherIterator.scala:119-180)."""
         if self.data_source is None:
             return M.FetchBlocksResp(msg.req_id, M.STATUS_ERROR, b"")
+        total = sum(length for _, _, length in msg.blocks)
+        cap = (self._MAX_SINGLE_BLOCK if len(msg.blocks) == 1
+               else self._MAX_RESP_PAYLOAD)
+        if total > cap:
+            return M.FetchBlocksResp(msg.req_id, M.STATUS_BAD_RANGE, b"")
         parts = []
         for token, offset, length in msg.blocks:
             data = self.data_source.read_block(msg.shuffle_id, token, offset, length)

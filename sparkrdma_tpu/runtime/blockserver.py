@@ -10,9 +10,11 @@ protocol as the Python path, so the fetcher is transport-agnostic).
 
 from __future__ import annotations
 
+import ctypes
 import logging
+import socket
 import threading
-from typing import Optional
+from typing import Optional, Sequence
 
 from sparkrdma_tpu.runtime import native
 
@@ -20,14 +22,28 @@ log = logging.getLogger(__name__)
 
 
 class BlockServer:
-    """Owns one native server instance; thread-safe."""
+    """Owns one native server instance; thread-safe.
 
-    def __init__(self, port: int = 0):
+    ``host`` bounds the network exposure of the (unauthenticated) data
+    port: it defaults to loopback and should be set to the control-plane
+    host for multi-host deployments, which must firewall the port — the
+    reference's verbs listener binds its one host the same way
+    (java/RdmaNode.java:74-88). Connections are sharded round-robin over
+    ``threads`` epoll workers, optionally pinned to ``cpus``
+    (java/RdmaNode.java:222-279, java/RdmaThread.java:46-48 analogue).
+    """
+
+    def __init__(self, port: int = 0, host: str = "",
+                 threads: int = 1, cpus: Sequence[int] = ()):
         if not native.available():
             raise RuntimeError("native runtime not built (make -C csrc)")
-        self._h = native.LIB.bs_create(port)
+        addr = socket.gethostbyname(host) if host else ""
+        cpu_arr = (ctypes.c_int * len(cpus))(*cpus) if cpus else None
+        self._h = native.LIB.bs_create(addr.encode(), port, max(1, threads),
+                                       cpu_arr, len(cpus))
         if not self._h:
-            raise OSError(f"block server failed to bind port {port}")
+            raise OSError(f"block server failed to bind {addr or 'loopback'}"
+                          f":{port}")
         self._lock = threading.Lock()
         self._stopped = False
 
@@ -69,12 +85,25 @@ class BlockServer:
             self._h = None
 
 
-def maybe_create(conf) -> Optional[BlockServer]:
-    """A server when the native runtime is built and enabled; else None."""
+def maybe_create(conf, host: str = "") -> Optional[BlockServer]:
+    """A server when the native runtime is built and enabled; else None.
+
+    ``host`` is the control-plane bind host: the data port never listens
+    wider than the control plane does.
+    """
     if conf.use_cpp_runtime and native.available():
+        cpus = []
+        for part in str(conf.block_server_cpus).split(","):
+            part = part.strip()
+            if part.isdigit():
+                cpus.append(int(part))
+            elif part:
+                log.warning("block_server_cpus: ignoring unparseable token "
+                            "%r (expected a comma-separated core list)", part)
         try:
-            return BlockServer()
-        except OSError as e:
+            return BlockServer(host=host, threads=conf.block_server_threads,
+                               cpus=cpus)
+        except (OSError, socket.gaierror) as e:
             log.warning("native block server unavailable, serving via the "
                         "control path instead: %s", e)
             return None

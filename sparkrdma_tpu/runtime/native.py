@@ -63,7 +63,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                u64, cp, ctypes.c_int]
     lib.mem_gather.restype = i64
     u16 = ctypes.c_uint16
-    lib.bs_create.argtypes = [u16]
+    lib.bs_create.argtypes = [cp, u16, ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_int), ctypes.c_int]
     lib.bs_create.restype = vp
     lib.bs_port.argtypes = [vp]
     lib.bs_port.restype = u16

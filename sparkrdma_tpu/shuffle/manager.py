@@ -118,7 +118,7 @@ class TpuShuffleManager:
         self.block_server = None
         if executor_id != "driver":
             from sparkrdma_tpu.runtime.blockserver import maybe_create
-            self.block_server = maybe_create(self.conf)
+            self.block_server = maybe_create(self.conf, host=host)
             spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpushuffle_")
             self.resolver = TpuShuffleBlockResolver(
                 spill_dir, block_server=self.block_server)

@@ -245,3 +245,36 @@ def test_unreachable_executor_auto_tombstoned(cluster):
     assert fresh[0].manager_id in members
     for ex in fresh:
         ex.stop()
+
+
+def test_32_executor_bootstrap():
+    """Control-plane scale: one coalescing broadcaster, not a thread per
+    hello — 32 executors converge and publish/fetch still works
+    (the reference pre-connects+caches for the same storm,
+    java/RdmaNode.java:283-353)."""
+    n = 32
+    driver = DriverEndpoint(CONF)
+    execs = []
+    try:
+        for i in range(n):
+            ex = ExecutorEndpoint("127.0.0.1", f"x{i}", driver.address,
+                                  conf=CONF)
+            execs.append(ex)
+            ex.start()
+        for ex in execs:
+            ex.wait_for_members(n, timeout=30)
+        # announce order is identical everywhere
+        order = [m.executor_id.executor for m in execs[0].members()]
+        assert sorted(order) == sorted(f"x{i}" for i in range(n))
+        assert all([m.executor_id.executor for m in ex.members()] == order
+                   for ex in execs)
+        # a publish/fetch round through the full membership
+        driver.register_shuffle(9, num_maps=n)
+        for ex in execs:
+            ex.publish_map_output(9, ex.exec_index(timeout=5), table_token=1)
+        table = execs[-1].get_driver_table(9, expect_published=n, timeout=20)
+        assert table.num_published == n
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
