@@ -41,7 +41,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _ring_kernel(axis_name: str, num_devices: int, use_barrier: bool,
-                 blocks_ref, out_ref, transit, send_sem, recv_sem, bar_sem):
+                 blocks_ref, out_ref, transit, send_sem, recv_sem):
     """blocks_ref/out_ref: [D, C, W] u32. transit: [2, D, C, W] scratch."""
     my = jax.lax.axis_index(axis_name)
     right = jax.lax.rem(my + 1, num_devices)
@@ -75,9 +75,13 @@ def _ring_kernel(axis_name: str, num_devices: int, use_barrier: bool,
         # the right neighbor's slot (s+1)%2 — the SAME slot parity its own
         # step-s send reads from. Without the barrier a fast device could
         # overwrite a slow neighbor's in-flight send buffer (WAR race).
-        # (The interpreter's emulation is lock-step and lacks remote
-        # semaphore signaling, so the barrier is compiled-mode only.)
+        # Mosaic requires cross-device signaling to go through the system
+        # barrier semaphore keyed by collective_id (a scratch REGULAR
+        # semaphore is rejected at compile time). The interpreter's
+        # emulation is lock-step and lacks remote semaphore signaling, so
+        # the barrier is compiled-mode only.
         if use_barrier:
+            bar_sem = pltpu.get_barrier_semaphore()
             pltpu.semaphore_signal(bar_sem, inc=1, device_id=left)
             pltpu.semaphore_signal(bar_sem, inc=1, device_id=right)
             pltpu.semaphore_wait(bar_sem, 2)
@@ -112,9 +116,12 @@ def ring_all_to_all_shard(blocks: jnp.ndarray, axis_name: str,
             pltpu.VMEM((2,) + tuple(blocks.shape), blocks.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=7),
+        # collective_id names the system barrier semaphore the kernel's
+        # neighbor barrier uses; interpret mode has no barrier (and Mosaic
+        # rejects the id when no barrier semaphore is referenced)
+        compiler_params=(None if interpret
+                         else pltpu.CompilerParams(collective_id=7)),
         interpret=interpret,
     )(blocks)
 

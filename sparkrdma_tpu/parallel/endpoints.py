@@ -371,11 +371,12 @@ class ExecutorEndpoint:
     # Response-payload caps, mirroring the native server's kMaxRespPayload:
     # reject before reading so an oversized request can't build a frame the
     # client Reassembler drops (>1 GiB tears down the shared pipelined
-    # connection) or that wraps the u32 frame length past 4 GiB. Multi-block
-    # groups are client-capped at shuffle_read_block_size so 256 MiB is
-    # generous; a *single* block (the fetcher's oversized-fetch escape,
-    # shuffle/fetcher.py:291) may legitimately be bigger and is allowed up
-    # to a Reassembler-safe bound.
+    # connection) or that wraps the u32 frame length past 4 GiB. Multi-
+    # block groups are client-capped at shuffle_read_block_size, so the cap
+    # tracks that config (floor 256 MiB, matching the native server); a
+    # group with at most one non-empty block (the fetcher's oversized-fetch
+    # escape, shuffle/fetcher.py:291 — possibly with zero-length riders) is
+    # allowed up to a Reassembler-safe bound.
     _MAX_RESP_PAYLOAD = 256 << 20
     _MAX_SINGLE_BLOCK = (1 << 30) - (1 << 20)
 
@@ -385,9 +386,11 @@ class ExecutorEndpoint:
         if self.data_source is None:
             return M.FetchBlocksResp(msg.req_id, M.STATUS_ERROR, b"")
         total = sum(length for _, _, length in msg.blocks)
-        cap = (self._MAX_SINGLE_BLOCK if len(msg.blocks) == 1
-               else self._MAX_RESP_PAYLOAD)
-        if total > cap:
+        nonempty = sum(1 for _, _, length in msg.blocks if length)
+        cap = (self._MAX_SINGLE_BLOCK if nonempty <= 1
+               else max(self._MAX_RESP_PAYLOAD,
+                        self.conf.shuffle_read_block_size))
+        if total > min(cap, self._MAX_SINGLE_BLOCK):
             return M.FetchBlocksResp(msg.req_id, M.STATUS_BAD_RANGE, b"")
         parts = []
         for token, offset, length in msg.blocks:

@@ -236,12 +236,22 @@ def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
             # Hand-scheduled ICI transport (ops/ring_exchange.py): send rows
             # stay in natural [D, quota] block layout — no compaction needed
             # on the send side; the ring's fixed block shape IS the quota.
+            # Mosaic remote-DMA slices need the lane (last) dim 128-aligned,
+            # so each per-destination block travels as flat words reshaped
+            # to [*, 128] lanes (padded by <128 words when quota*row_words
+            # isn't a lane multiple) and is unflattened on arrival.
             from sparkrdma_tpu.ops.ring_exchange import ring_all_to_all_shard
             blocks = jnp.where(vmask, picked, 0).reshape(
                 (n, quota) + grouped.shape[1:])
-            got = ring_all_to_all_shard(
-                blocks, axis_name, n,
+            words = int(np.prod(blocks.shape[1:]))
+            lanes = -(-words // 128) * 128
+            flat = blocks.reshape(n, words)
+            if lanes != words:
+                flat = jnp.pad(flat, ((0, 0), (0, lanes - words)))
+            got_flat = ring_all_to_all_shard(
+                flat.reshape(n, lanes // 128, 128), axis_name, n,
                 interpret=(impl_resolved == "ring_interpret"))
+            got = got_flat.reshape(n, lanes)[:, :words].reshape(blocks.shape)
             mat = lax.all_gather(send_counts, axis_name, axis=0, tiled=False)
             my = lax.axis_index(axis_name)
             recv_counts = mat[:, my]

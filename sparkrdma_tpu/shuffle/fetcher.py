@@ -226,14 +226,20 @@ class ShuffleFetcher:
                         peer, self.shuffle_id, m,
                         self.start_partition, self.end_partition)
                 # STEP 3 grouping: consecutive partitions, ≤ read block size
-                # (:240-263). Zero-length blocks ride along for free.
+                # (:240-263). Zero-length blocks ride along byte-free but
+                # still count toward a block-count bound so a wide, mostly-
+                # empty partition range can't build a request frame past the
+                # native server's 1 MiB inbound cap (csrc/blockserver.cpp
+                # kMaxReqFrame; 8192 blocks ~= 128 KiB of frame).
                 group: List = []
                 group_start = self.start_partition
                 group_bytes = 0
                 limit = self.conf.shuffle_read_block_size
+                max_blocks = 8192
                 for i, loc in enumerate(locs):
                     p = self.start_partition + i
-                    if group and group_bytes + loc.length > limit:
+                    if group and (group_bytes + loc.length > limit
+                                  or len(group) >= max_blocks):
                         pending.append(_PendingFetch(
                             exec_idx, m, group_start, p, group, group_bytes))
                         group, group_start, group_bytes = [], p, 0

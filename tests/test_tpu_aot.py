@@ -1,0 +1,159 @@
+"""AOT-compile the TPU data plane against a real v5e topology.
+
+The centerpiece transport — ``lax.ragged_all_to_all`` over ICI
+(parallel/exchange.py) — cannot execute on the CPU validation mesh
+(XLA:CPU lacks the opcode) and single-chip hardware runs bypass the
+exchange entirely. These tests close that gap as far as software can
+without a multi-chip slice: the full XLA:TPU + Mosaic compiler stack runs
+here against an ahead-of-time ``v5e:2x4`` topology, validating opcode
+support, SPMD partitioning, layouts, and the Pallas ring kernel's
+compiled-mode path (including the WAR-race neighbor barrier that
+interpret mode cannot emulate, ops/ring_exchange.py:79). Execution parity
+with the gather oracle is asserted wherever the running backend honors
+the opcode (skipped until one does — the reference's analogous most-
+tested path is its verbs engine, java/RdmaChannel.java).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "shuffle"
+
+
+@functools.lru_cache(maxsize=1)
+def _tpu_mesh():
+    from jax.experimental import topologies
+
+    try:
+        topo = topologies.get_topology_desc("v5e:2x4")
+    except Exception as e:  # noqa: BLE001 — no libtpu compiler in this env
+        return None, str(e)
+    return Mesh(np.array(topo.devices).reshape(8), (AXIS,)), ""
+
+
+@pytest.fixture
+def tpu_mesh():
+    mesh, err = _tpu_mesh()
+    if mesh is None:
+        pytest.skip(f"TPU AOT topology unavailable: {err[:120]}")
+    return mesh
+
+
+def _lower_compile(jitted, *args):
+    lowered = jitted.lower(*args)
+    text = lowered.as_text()
+    compiled = lowered.compile()
+    assert compiled is not None
+    return text, compiled
+
+
+def test_native_exchange_compiles_with_ragged_opcode(tpu_mesh):
+    """The full 8-device native exchange AOT-compiles for v5e and actually
+    lowers to the ragged-all-to-all opcode (not a silent decomposition)."""
+    from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
+
+    exchange = make_shuffle_exchange(tpu_mesh, AXIS, impl="native",
+                                     out_factor=2)
+    sh = NamedSharding(tpu_mesh, P(AXIS))
+    data = jax.ShapeDtypeStruct((8 * 128, 8), jnp.uint32, sharding=sh)
+    dest = jax.ShapeDtypeStruct((8 * 128,), jnp.int32, sharding=sh)
+    text, _ = _lower_compile(exchange, data, dest)
+    assert "ragged_all_to_all" in text, "native path decomposed away"
+
+
+def test_terasort_step_compiles_for_tpu(tpu_mesh):
+    """The flagship multi-chip step (partition + native ragged exchange +
+    sort) passes the real XLA:TPU compiler at v5e layouts."""
+    from sparkrdma_tpu.models.terasort import TeraSortConfig, make_terasort_step
+
+    cfg = TeraSortConfig(rows_per_device=256, payload_words=24, out_factor=2)
+    step = make_terasort_step(tpu_mesh, AXIS, cfg)  # auto -> native on tpu
+    rows = jax.ShapeDtypeStruct((8 * cfg.rows_per_device, 25), jnp.uint32,
+                                sharding=NamedSharding(tpu_mesh, P(AXIS)))
+    text, _ = _lower_compile(step, rows)
+    assert "ragged_all_to_all" in text
+
+
+def test_ring_kernel_mosaic_compiles(tpu_mesh):
+    """The hand-scheduled Pallas ring (remote DMAs + neighbor barrier)
+    passes Mosaic in compiled mode — the barrier code interpret mode can't
+    reach gets compiler-validated here."""
+    from sparkrdma_tpu.ops.ring_exchange import make_ring_all_to_all
+
+    a2a = make_ring_all_to_all(tpu_mesh, AXIS, interpret=False)
+    x = jax.ShapeDtypeStruct((8, 8, 8, 128), jnp.uint32,
+                             sharding=NamedSharding(tpu_mesh, P(AXIS)))
+    _lower_compile(a2a, x)
+
+
+def test_chunked_ring_round_compiles(tpu_mesh):
+    """The production wrapper of the ring (chunked exchange, impl='ring')
+    compiles end-to-end for v5e."""
+    from sparkrdma_tpu.parallel.exchange import make_chunked_exchange
+
+    round_fn = make_chunked_exchange(tpu_mesh, AXIS, quota=128, impl="ring")
+    sh = NamedSharding(tpu_mesh, P(AXIS))
+    grouped = jax.ShapeDtypeStruct((8 * 1024, 8), jnp.uint32, sharding=sh)
+    counts = jax.ShapeDtypeStruct((8 * 8,), jnp.int32, sharding=sh)
+    _lower_compile(round_fn, grouped, counts, 0)
+
+
+def test_2d_mesh_exchange_compiles(tpu_mesh):
+    """dp x shuffle composition (the embedding a host engine uses) compiles
+    for v5e — collectives ride the inner mesh axis only."""
+    from sparkrdma_tpu.parallel.exchange import shuffle_shard
+
+    devs = np.array(tpu_mesh.devices).reshape(2, 4)
+    mesh2 = Mesh(devs, ("dp", AXIS))
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh2,
+                       in_specs=(P("dp", AXIS),) * 2,
+                       out_specs=P("dp", AXIS))
+    def exchange2d(data, dest):
+        received, _, _ = shuffle_shard(data[0], dest[0], AXIS, 4,
+                                       impl="native")
+        return received[None]
+
+    sh = NamedSharding(mesh2, P("dp", AXIS))
+    data = jax.ShapeDtypeStruct((2, 4 * 64), jnp.int32, sharding=sh)
+    dest = jax.ShapeDtypeStruct((2, 4 * 64), jnp.int32, sharding=sh)
+    text, _ = _lower_compile(exchange2d, data, dest)
+    assert "ragged_all_to_all" in text
+
+
+def test_native_parity_where_backend_executes():
+    """Bit-identity of impl='native' vs the gather oracle, on any running
+    backend that honors the opcode (today: real multi-chip TPU; XLA:CPU
+    raises UNIMPLEMENTED and the test skips — the AOT tests above still
+    compiler-validate the path)."""
+    from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs >=4 devices")
+    n = 4
+    mesh = Mesh(np.array(devs[:n]), (AXIS,))
+    sh = NamedSharding(mesh, P(AXIS))
+    rng = np.random.default_rng(3)
+    cap = 64
+    data = rng.integers(0, 2**31, size=(n * cap, 8), dtype=np.int32)
+    dest = rng.integers(0, n, size=(n * cap,)).astype(np.int32)
+    data_d, dest_d = (jax.device_put(x, sh) for x in (data, dest))
+
+    native = make_shuffle_exchange(mesh, AXIS, impl="native", out_factor=2)
+    try:
+        got = jax.block_until_ready(native(data_d, dest_d))
+    except Exception as e:  # noqa: BLE001
+        if "not supported" in str(e) or "UNIMPLEMENTED" in str(e):
+            pytest.skip(f"backend lacks ragged-all-to-all: {str(e)[:100]}")
+        raise
+    oracle = make_shuffle_exchange(mesh, AXIS, impl="gather", out_factor=2)
+    want = jax.block_until_ready(oracle(data_d, dest_d))
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
