@@ -1,0 +1,250 @@
+"""Minimal DAG/stage engine: the host that proves the drop-in SPI.
+
+The reference ships no engine — Apache Spark's DAGScheduler is the caller:
+it plans stages around ``ShuffleDependency`` boundaries and drives the
+plugin through exactly ``registerShuffle`` -> ``getWriter`` per map task ->
+``getReader`` per reduce task -> ``unregisterShuffle``
+(scala/RdmaShuffleManager.scala:143-310), retrying a whole producing stage
+when a reducer surfaces ``FetchFailedException``
+(scala/RdmaShuffleFetcherIterator.scala:376-381). A standalone framework
+needs that half in-tree: this module is a ~300-LoC DAGScheduler analogue
+that schedules multi-stage jobs across executor managers through the
+camelCase compat SPI (`shuffle/spark_compat.py`) — the same sequence Spark
+would issue — with stage retry built in (recompute lost maps on survivors,
+repair the driver table via idempotent positional publishes, invalidate
+reader caches, re-attempt).
+
+Plan model (RDD-lite):
+
+* ``MapStage`` — ``num_tasks`` deterministic map tasks, each writing
+  key/payload batches through a ``CompatWriter`` into this stage's shuffle
+  (its ``ShuffleDependency`` fixes partition count + partitioner). May read
+  parent shuffles (task t reads partition t of each parent — Spark's
+  co-partitioning contract).
+* ``ResultStage`` — terminal tasks returning values; task t reads
+  partition t of each parent shuffle.
+
+Tasks must be deterministic (recompute yields identical records) — the
+exact property Spark relies on for lineage recomputation.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
+from sparkrdma_tpu.shuffle.spark_compat import (
+    CompatReader,
+    CompatWriter,
+    ShuffleDependency,
+    SparkCompatShuffleManager,
+)
+
+log = logging.getLogger(__name__)
+
+_stage_ids = itertools.count()
+
+# map task: fn(ctx, writer, task_id) -> None  (writes its records)
+MapTaskFn = Callable[["TaskContext", CompatWriter, int], None]
+# result task: fn(ctx, task_id) -> value
+ResultTaskFn = Callable[["TaskContext", int], object]
+
+
+@dataclass
+class MapStage:
+    """A stage that materializes one shuffle (ShuffleMapStage analogue)."""
+
+    num_tasks: int
+    dep: ShuffleDependency
+    task_fn: MapTaskFn
+    parents: List["MapStage"] = field(default_factory=list)
+    stage_id: int = field(default_factory=lambda: next(_stage_ids))
+
+    def __post_init__(self):
+        _check_copartition(self)
+
+
+@dataclass
+class ResultStage:
+    """Terminal stage returning one value per task (ResultStage analogue)."""
+
+    num_tasks: int
+    task_fn: ResultTaskFn
+    parents: List[MapStage] = field(default_factory=list)
+    stage_id: int = field(default_factory=lambda: next(_stage_ids))
+
+    def __post_init__(self):
+        _check_copartition(self)
+
+
+def _check_copartition(stage) -> None:
+    for p in stage.parents:
+        if p.dep.num_partitions != stage.num_tasks:
+            raise ValueError(
+                f"stage {stage.stage_id}: task count {stage.num_tasks} must "
+                f"equal parent stage {p.stage_id}'s partition count "
+                f"{p.dep.num_partitions} (task t reads partition t)")
+
+
+class TaskContext:
+    """What a running task sees: readers over its parents' shuffles."""
+
+    def __init__(self, engine: "DAGEngine", mgr: SparkCompatShuffleManager,
+                 stage, task_id: int):
+        self._engine = engine
+        self.manager = mgr
+        self._stage = stage
+        self.task_id = task_id
+
+    def read(self, parent_index: int = 0) -> CompatReader:
+        """Reader over partition ``task_id`` of the parent's shuffle —
+        the getReader(handle, t, t+1) call Spark issues per reduce task."""
+        parent = self._stage.parents[parent_index]
+        handle = self._engine._handles[parent.stage_id]
+        return self.manager.getReader(handle, self.task_id, self.task_id + 1)
+
+
+class DAGEngine:
+    """Schedules stage DAGs over a cluster of compat shuffle managers.
+
+    ``driver`` is the driver-role manager; ``executors`` the executor-role
+    managers. Tasks round-robin over live executors; a FetchFailed from any
+    task triggers recompute of the lost maps of the failed shuffle on
+    survivors (positional republish repairs the driver table atomically),
+    then the task retries — ``max_stage_retries`` bounds attempts per task.
+    """
+
+    def __init__(self, driver: SparkCompatShuffleManager,
+                 executors: Sequence[SparkCompatShuffleManager],
+                 max_stage_retries: int = 2):
+        self.driver = driver
+        self.executors = list(executors)
+        self.max_stage_retries = max_stage_retries
+        self._handles: Dict[int, object] = {}      # stage_id -> ShuffleHandle
+        self._stages: Dict[int, MapStage] = {}     # stage_id -> stage
+        self._owners: Dict[int, Dict[int, int]] = {}  # stage_id -> map->slot
+        self._next_shuffle_id = itertools.count(1)
+
+    # -- public ----------------------------------------------------------
+
+    def run(self, final: ResultStage) -> List[object]:
+        """Execute the DAG rooted at ``final``; returns its tasks' values."""
+        order = self._topo_order(final)
+        registered: List[MapStage] = []
+        try:
+            for stage in order:
+                registered.append(stage)  # before running: a mid-stage
+                # failure must still unregister the freshly-made shuffle
+                self._run_map_stage(stage)
+            return [self._run_task(final, t) for t in range(final.num_tasks)]
+        finally:
+            for stage in registered:
+                handle = self._handles.pop(stage.stage_id, None)
+                self._stages.pop(stage.stage_id, None)
+                self._owners.pop(stage.stage_id, None)
+                if handle is not None:
+                    self.driver.unregisterShuffle(handle.shuffle_id)
+                    for mgr in self._live():
+                        mgr.native.executor.invalidate_shuffle(
+                            handle.shuffle_id)
+
+    # -- scheduling ------------------------------------------------------
+
+    def _topo_order(self, final) -> List[MapStage]:
+        seen: Dict[int, MapStage] = {}
+        order: List[MapStage] = []
+
+        def visit(stage):
+            for p in stage.parents:
+                if p.stage_id not in seen:
+                    seen[p.stage_id] = p
+                    visit(p)
+                    order.append(p)
+        visit(final)
+        return order
+
+    def _live(self) -> List[SparkCompatShuffleManager]:
+        return [m for m in self.executors
+                if m.native.executor is not None
+                and not m.native.executor.server.stopped]
+
+    def _slot_of(self, mgr: SparkCompatShuffleManager) -> int:
+        return mgr.native.executor.exec_index(timeout=1)
+
+    def _run_map_stage(self, stage: MapStage) -> None:
+        shuffle_id = next(self._next_shuffle_id)
+        handle = self.driver.registerShuffle(shuffle_id, stage.num_tasks,
+                                             stage.dep)
+        self._handles[stage.stage_id] = handle
+        self._stages[stage.stage_id] = stage
+        self._owners[stage.stage_id] = {}
+        for t in range(stage.num_tasks):
+            self._run_task(stage, t)
+
+    def _run_task(self, stage, task_id: int,
+                  mgr: Optional[SparkCompatShuffleManager] = None):
+        """One task with FetchFailed-driven stage retry."""
+        for attempt in range(self.max_stage_retries + 1):
+            mgr = mgr if mgr is not None and attempt == 0 else None
+            target = mgr or self._pick_live(task_id)
+            try:
+                return self._attempt_task(stage, task_id, target)
+            except FetchFailedError as e:
+                if attempt >= self.max_stage_retries:
+                    raise
+                log.warning("stage %d task %d: %s; retrying (%d)",
+                            stage.stage_id, task_id, e, attempt + 1)
+                self._recover_shuffle(e)
+
+    def _pick_live(self, task_id: int) -> SparkCompatShuffleManager:
+        live = self._live()
+        if not live:
+            raise RuntimeError("no live executors")
+        return live[task_id % len(live)]
+
+    def _attempt_task(self, stage, task_id: int,
+                      mgr: SparkCompatShuffleManager):
+        ctx = TaskContext(self, mgr, stage, task_id)
+        if isinstance(stage, MapStage):
+            handle = self._handles[stage.stage_id]
+            writer = mgr.getWriter(handle, task_id)
+            try:
+                stage.task_fn(ctx, writer, task_id)
+            except BaseException:
+                writer.stop(False)
+                raise
+            writer.stop(True)
+            self._owners[stage.stage_id][task_id] = self._slot_of(mgr)
+            return None
+        return stage.task_fn(ctx, task_id)
+
+    # -- recovery (scala/RdmaShuffleFetcherIterator.scala:376-381) -------
+
+    def _recover_shuffle(self, failure: FetchFailedError) -> None:
+        """Recompute every map of the failed shuffle owned by the dead slot
+        on surviving executors; positional republish repairs the table."""
+        stage = next((s for s in self._stages.values()
+                      if self._handles[s.stage_id].shuffle_id
+                      == failure.shuffle_id), None)
+        if stage is None:
+            raise failure  # not one of ours (already unregistered?)
+        owners = self._owners[stage.stage_id]
+        dead = failure.exec_index
+        lost = [m for m, slot in owners.items() if slot == dead]
+        if not lost and failure.map_id >= 0:
+            lost = [failure.map_id]
+        live = [m for m in self._live()
+                if self._slot_of(m) != dead]
+        if not live:
+            raise RuntimeError("no surviving executors to recompute on")
+        log.warning("recovering shuffle %d: recomputing maps %s lost with "
+                    "slot %d", failure.shuffle_id, lost, dead)
+        for k, m in enumerate(lost):
+            # recompute tasks read their parents through _run_task too, so
+            # a grandparent loss recovers recursively within its own budget
+            self._run_task(stage, m, mgr=live[k % len(live)])
+        for mgr in self._live():
+            mgr.native.executor.invalidate_shuffle(failure.shuffle_id)

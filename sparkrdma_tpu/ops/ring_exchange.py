@@ -41,10 +41,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _ring_kernel(axis_name: str, num_devices: int, use_barrier: bool,
-                 blocks_ref, out_ref, transit, send_sem, recv_sem):
+                 blocks_ref, out_ref, transit, send_sem, recv_sem, bar_dir):
     """blocks_ref/out_ref: [D, C, W] u32. transit: [2, D, C, W] scratch."""
     my = jax.lax.axis_index(axis_name)
     right = jax.lax.rem(my + 1, num_devices)
+    left = jax.lax.rem(my - 1 + num_devices, num_devices)
+
+    if use_barrier:
+        # Entry rendezvous on the system barrier semaphore: scratch VMEM
+        # addresses are only valid once every participant has entered the
+        # kernel; each device signals each neighbor exactly once, so the
+        # wait(2) cannot be satisfied by one fast neighbor double-signaling.
+        bar = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bar, inc=1, device_id=left)
+        pltpu.semaphore_signal(bar, inc=1, device_id=right)
+        pltpu.semaphore_wait(bar, 2)
 
     # T[k] = my block destined k hops to the right = blocks[(my + k) % D].
     def init_body(k, _):
@@ -55,8 +66,6 @@ def _ring_kernel(axis_name: str, num_devices: int, use_barrier: bool,
 
     # my own block never travels
     out_ref[my] = transit[0, 0]
-
-    left = jax.lax.rem(my - 1 + num_devices, num_devices)
 
     def step_body(s, _):
         cur = jax.lax.rem(s - 1, 2)
@@ -75,16 +84,19 @@ def _ring_kernel(axis_name: str, num_devices: int, use_barrier: bool,
         # the right neighbor's slot (s+1)%2 — the SAME slot parity its own
         # step-s send reads from. Without the barrier a fast device could
         # overwrite a slow neighbor's in-flight send buffer (WAR race).
-        # Mosaic requires cross-device signaling to go through the system
-        # barrier semaphore keyed by collective_id (a scratch REGULAR
-        # semaphore is rejected at compile time). The interpreter's
-        # emulation is lock-step and lacks remote semaphore signaling, so
-        # the barrier is compiled-mode only.
+        # The two directions use SEPARATE counting semaphores (bar_dir[0]:
+        # left neighbor arrived, bar_dir[1]: right arrived): a single
+        # semaphore with wait(2) could be satisfied by a fast left
+        # neighbor's step-s AND step-s+1 signals with the right neighbor
+        # still mid-DMA — exactly the WAR race the barrier must prevent.
+        # Counting absorbs one-step run-ahead per direction. (The
+        # interpreter's emulation is lock-step and lacks remote semaphore
+        # signaling, so the barrier is compiled-mode only.)
         if use_barrier:
-            bar_sem = pltpu.get_barrier_semaphore()
-            pltpu.semaphore_signal(bar_sem, inc=1, device_id=left)
-            pltpu.semaphore_signal(bar_sem, inc=1, device_id=right)
-            pltpu.semaphore_wait(bar_sem, 2)
+            pltpu.semaphore_signal(bar_dir.at[1], inc=1, device_id=left)
+            pltpu.semaphore_signal(bar_dir.at[0], inc=1, device_id=right)
+            pltpu.semaphore_wait(bar_dir.at[0], 1)
+            pltpu.semaphore_wait(bar_dir.at[1], 1)
         # the block in slot 0 just completed its journey: it originated
         # s hops to my left
         origin = jax.lax.rem(my - s + num_devices, num_devices)
@@ -116,9 +128,10 @@ def ring_all_to_all_shard(blocks: jnp.ndarray, axis_name: str,
             pltpu.VMEM((2,) + tuple(blocks.shape), blocks.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),  # per-direction step barrier
         ],
-        # collective_id names the system barrier semaphore the kernel's
-        # neighbor barrier uses; interpret mode has no barrier (and Mosaic
+        # collective_id names the system barrier semaphore used by the
+        # entry rendezvous; interpret mode has no barrier (and Mosaic
         # rejects the id when no barrier semaphore is referenced)
         compiler_params=(None if interpret
                          else pltpu.CompilerParams(collective_id=7)),

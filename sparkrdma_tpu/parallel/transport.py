@@ -216,6 +216,11 @@ class ControlServer:
             with self._conns_lock:
                 self._conns.append(conn)
 
+    @property
+    def stopped(self) -> bool:
+        """Liveness signal for schedulers (engine task placement)."""
+        return self._stopped.is_set()
+
     def stop(self) -> None:
         self._stopped.set()
         # shutdown() before close(): a close() alone does not tear down a

@@ -1,0 +1,186 @@
+"""DAG engine end-to-end: multi-stage jobs through the exact compat SPI
+sequence Spark issues (register -> getWriter/map -> getReader/reduce ->
+unregister, scala/RdmaShuffleManager.scala:143-310), including stage retry
+on executor loss — the engine half the reference delegates to Spark."""
+
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.engine import DAGEngine, MapStage, ResultStage
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec
+from sparkrdma_tpu.shuffle.spark_compat import (
+    ShuffleDependency,
+    SparkCompatShuffleManager,
+)
+
+CONF = TpuShuffleConf(connect_timeout_ms=1000, max_connection_attempts=2)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    driver = SparkCompatShuffleManager(CONF, isDriver=True)
+    execs = [SparkCompatShuffleManager(
+        CONF, driverAddr=driver.driverAddr, executorId=str(i),
+        spill_dir=str(tmp_path / f"e{i}")) for i in range(3)]
+    for ex in execs:
+        ex.native.executor.wait_for_members(3)
+    yield driver, execs
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def _u32_payload(values) -> np.ndarray:
+    return np.ascontiguousarray(values, dtype="<u4").view(np.uint8).reshape(-1, 4)
+
+
+def _payload_u32(payload: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(payload).view("<u4").ravel()
+
+
+def _table(seed: int, rows: int, key_space: int):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, key_space, size=rows).astype(np.uint64)
+    vals = rng.integers(0, 1000, size=rows).astype(np.uint32)
+    return keys, vals
+
+
+def test_two_table_join(cluster):
+    """Equi-join via two shuffles + one result stage (multi-parent read)."""
+    driver, execs = cluster
+    P, maps, rows, key_space = 4, 3, 400, 64
+
+    def writer_fn(base_seed):
+        def fn(ctx, writer, task_id):
+            keys, vals = _table(base_seed + task_id, rows, key_space)
+            writer.write((keys, _u32_payload(vals)))
+        return fn
+
+    dep = ShuffleDependency(P, PartitionerSpec("modulo"), row_payload_bytes=4)
+    left = MapStage(maps, dep, writer_fn(100))
+    right = MapStage(maps, ShuffleDependency(P, PartitionerSpec("modulo"),
+                                             row_payload_bytes=4),
+                     writer_fn(200))
+
+    def join_fn(ctx, task_id):
+        lsum: dict = {}
+        for keys, payload in ctx.read(0).readBatches():
+            for k, v in zip(keys, _payload_u32(payload)):
+                lsum.setdefault(int(k), []).append(int(v))
+        total = 0
+        for keys, payload in ctx.read(1).readBatches():
+            for k, v in zip(keys, _payload_u32(payload)):
+                for lv in lsum.get(int(k), ()):
+                    total += lv * int(v)
+        return total
+
+    engine = DAGEngine(driver, execs)
+    got = sum(engine.run(ResultStage(P, join_fn, parents=[left, right])))
+
+    # numpy oracle over the same deterministic tables
+    lk = np.concatenate([_table(100 + m, rows, key_space)[0] for m in range(maps)])
+    lv = np.concatenate([_table(100 + m, rows, key_space)[1] for m in range(maps)])
+    rk = np.concatenate([_table(200 + m, rows, key_space)[0] for m in range(maps)])
+    rv = np.concatenate([_table(200 + m, rows, key_space)[1] for m in range(maps)])
+    want = 0
+    for k in range(key_space):
+        want += int(lv[lk == k].astype(np.int64).sum()) * \
+            int(rv[rk == k].astype(np.int64).sum())
+    assert got == want
+
+
+def test_pagerank_iterations(cluster):
+    """Two PageRank iterations, each a shuffle job through the engine."""
+    driver, execs = cluster
+    V, P, maps, epd = 64, 4, 3, 300  # vertices, partitions, maps, edges/map
+    engine = DAGEngine(driver, execs)
+
+    def edges_of(m):
+        rng = np.random.default_rng(7000 + m)
+        return (rng.integers(0, V, size=epd).astype(np.int64),
+                rng.integers(0, V, size=epd).astype(np.int64))
+
+    src_all = np.concatenate([edges_of(m)[0] for m in range(maps)])
+    deg = np.maximum(np.bincount(src_all, minlength=V), 1)
+
+    ranks = np.full(V, 1.0 / V, dtype=np.float64)
+    for _ in range(2):
+        snapshot = ranks.copy()
+
+        def contrib_fn(ctx, writer, task_id):
+            src, dst = edges_of(task_id)
+            contrib = (snapshot[src] / deg[src]).astype("<f4")
+            writer.write((dst.astype(np.uint64),
+                          contrib.view(np.uint8).reshape(-1, 4)))
+
+        def agg_fn(ctx, task_id):
+            acc: dict = {}
+            for keys, payload in ctx.read(0).readBatches():
+                vals = np.ascontiguousarray(payload).view("<f4").ravel()
+                for k, v in zip(keys, vals):
+                    acc[int(k)] = acc.get(int(k), 0.0) + float(v)
+            return acc
+
+        stage = MapStage(maps, ShuffleDependency(
+            P, PartitionerSpec("modulo"), row_payload_bytes=4), contrib_fn)
+        parts = engine.run(ResultStage(P, agg_fn, parents=[stage]))
+        ranks = np.full(V, 0.15 / V)
+        for part in parts:
+            for v, s in part.items():
+                ranks[v] += 0.85 * s
+
+    # dense numpy oracle, identical float32 contributions
+    want = np.full(V, 1.0 / V, dtype=np.float64)
+    for _ in range(2):
+        acc = np.zeros(V)
+        for m in range(maps):
+            src, dst = edges_of(m)
+            np.add.at(acc, dst, (want[src] / deg[src]).astype(np.float32)
+                      .astype(np.float64))
+        want = 0.15 / V + 0.85 * acc
+    np.testing.assert_allclose(ranks, want, rtol=1e-6)
+
+
+def test_mid_job_executor_loss_recovers(cluster, caplog):
+    """An executor dies between the map stage and the reduce: the engine's
+    own retry recomputes its maps on survivors and the job completes with
+    exact results (scala/RdmaShuffleFetcherIterator.scala:376-381 story)."""
+    import logging
+
+    caplog.set_level(logging.WARNING, logger="sparkrdma_tpu.engine")
+    driver, execs = cluster
+    P, maps, rows, key_space = 4, 6, 500, 5000
+
+    def map_fn(ctx, writer, task_id):
+        keys, vals = _table(9000 + task_id, rows, key_space)
+        writer.write((keys, _u32_payload(vals)))
+
+    killed = {"done": False}
+
+    def reduce_fn(ctx, task_id):
+        if task_id == 0 and not killed["done"]:
+            killed["done"] = True
+            victim = execs[1].native
+            mid = victim.executor.manager_id
+            victim.executor.stop()
+            driver.native.driver.remove_member(mid)
+            time.sleep(0.3)
+        total = 0
+        for keys, payload in ctx.read(0).readBatches():
+            total += int(_payload_u32(payload).astype(np.int64).sum())
+        return total
+
+    stage = MapStage(maps, ShuffleDependency(
+        P, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn)
+    engine = DAGEngine(driver, execs)
+    got = sum(engine.run(ResultStage(P, reduce_fn, parents=[stage])))
+    assert killed["done"], "failure injection never ran"
+
+    want = sum(int(_table(9000 + m, rows, key_space)[1].astype(np.int64).sum())
+               for m in range(maps))
+    assert got == want
+    # the engine's recovery path must actually have fired
+    assert any("recovering shuffle" in r.message for r in caplog.records)
