@@ -46,6 +46,8 @@ from sparkrdma_tpu.shuffle.spark_compat import (
 log = logging.getLogger(__name__)
 
 _stage_ids = itertools.count()
+# process-global so two engines over one cluster can't collide on ids
+_shuffle_ids = itertools.count(1)
 
 # map task: fn(ctx, writer, task_id) -> None  (writes its records)
 MapTaskFn = Callable[["TaskContext", CompatWriter, int], None]
@@ -126,7 +128,6 @@ class DAGEngine:
         self._handles: Dict[int, object] = {}      # stage_id -> ShuffleHandle
         self._stages: Dict[int, MapStage] = {}     # stage_id -> stage
         self._owners: Dict[int, Dict[int, int]] = {}  # stage_id -> map->slot
-        self._next_shuffle_id = itertools.count(1)
 
     # -- public ----------------------------------------------------------
 
@@ -147,9 +148,11 @@ class DAGEngine:
                 self._owners.pop(stage.stage_id, None)
                 if handle is not None:
                     self.driver.unregisterShuffle(handle.shuffle_id)
+                    # executor-side too: drops the resolver's spill data and
+                    # the memoized driver table, not just the driver entry —
+                    # else every job leaks its full shuffle dataset
                     for mgr in self._live():
-                        mgr.native.executor.invalidate_shuffle(
-                            handle.shuffle_id)
+                        mgr.unregisterShuffle(handle.shuffle_id)
 
     # -- scheduling ------------------------------------------------------
 
@@ -175,7 +178,7 @@ class DAGEngine:
         return mgr.native.executor.exec_index(timeout=1)
 
     def _run_map_stage(self, stage: MapStage) -> None:
-        shuffle_id = next(self._next_shuffle_id)
+        shuffle_id = next(_shuffle_ids)
         handle = self.driver.registerShuffle(shuffle_id, stage.num_tasks,
                                              stage.dep)
         self._handles[stage.stage_id] = handle
@@ -186,17 +189,27 @@ class DAGEngine:
 
     def _run_task(self, stage, task_id: int,
                   mgr: Optional[SparkCompatShuffleManager] = None):
-        """One task with FetchFailed-driven stage retry."""
-        for attempt in range(self.max_stage_retries + 1):
-            mgr = mgr if mgr is not None and attempt == 0 else None
-            target = mgr or self._pick_live(task_id)
+        """One task with FetchFailed-driven stage retry.
+
+        The budget counts repeated failures per shuffle: one executor loss
+        damaging several parent shuffles costs the task one recovery per
+        parent (each makes forward progress), not its whole budget.
+        """
+        attempts_by_shuffle: Dict[int, int] = {}
+        first = True
+        while True:
+            target = mgr if mgr is not None and first else \
+                self._pick_live(task_id)
+            first = False
             try:
                 return self._attempt_task(stage, task_id, target)
             except FetchFailedError as e:
-                if attempt >= self.max_stage_retries:
+                n = attempts_by_shuffle.get(e.shuffle_id, 0) + 1
+                attempts_by_shuffle[e.shuffle_id] = n
+                if n > self.max_stage_retries:
                     raise
                 log.warning("stage %d task %d: %s; retrying (%d)",
-                            stage.stage_id, task_id, e, attempt + 1)
+                            stage.stage_id, task_id, e, n)
                 self._recover_shuffle(e)
 
     def _pick_live(self, task_id: int) -> SparkCompatShuffleManager:

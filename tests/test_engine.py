@@ -80,6 +80,10 @@ def test_two_table_join(cluster):
     engine = DAGEngine(driver, execs)
     got = sum(engine.run(ResultStage(P, join_fn, parents=[left, right])))
 
+    # job teardown must free executor-side shuffle data, not just the
+    # driver table — long-lived clusters otherwise leak every dataset
+    assert all(not ex.native.resolver._shuffles for ex in execs)
+
     # numpy oracle over the same deterministic tables
     lk = np.concatenate([_table(100 + m, rows, key_space)[0] for m in range(maps)])
     lv = np.concatenate([_table(100 + m, rows, key_space)[1] for m in range(maps)])
