@@ -239,16 +239,19 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
             # host across all R rounds (~out_factor x dataset RSS)
             runs[d].append(out[d][:total - int(pads_for[d])].copy())
 
+    from sparkrdma_tpu.shuffle.external import merge_runs
+
     merged = []
     for d in range(n):
-        allruns = np.concatenate(runs[d]) if runs[d] else \
-            np.zeros((0, rows.shape[1]), rows.dtype)
-        # R sorted runs -> one sorted output. NOTE: this is a full stable
-        # re-sort, not an O(N log R) k-way merge — numpy has no native
-        # merge primitive and a Python heapq over rows is slower in
-        # practice at these run counts; revisit if R grows large.
-        order = np.argsort(allruns[:, 0], kind="stable")
-        merged.append(allruns[order])
+        if not runs[d]:
+            merged.append(np.zeros((0, rows.shape[1]), rows.dtype))
+            continue
+        # R key-sorted runs -> one sorted output via an O(N log R)
+        # pairwise tournament of vectorized positional merges (keys are a
+        # zero-copy view of column 0; earlier rounds win ties, matching
+        # the former stable re-sort's order exactly)
+        _, out = merge_runs([(r[:, 0], r) for r in runs[d]])
+        merged.append(out)
     return merged, num_rounds
 
 

@@ -69,6 +69,23 @@ class TpuShuffleReader:
         order = np.argsort(keys, kind="stable")
         return keys[order], payload[order]
 
+    def read_sorted_spilled(self, memory_budget_bytes: int = 64 << 20,
+                            spill_dir: Optional[str] = None,
+                            ) -> Iterator[Batch]:
+        """Globally key-sorted batches with a bounded resident set: fetched
+        batches spill as sorted runs once ``memory_budget_bytes`` is
+        buffered, then stream back through a k-way disk merge — the
+        ExternalSorter delegation of scala/RdmaShuffleReader.scala:100-114
+        for reduces that exceed host memory (``read_sorted`` materializes
+        everything)."""
+        from sparkrdma_tpu.shuffle.external import ExternalMerger
+
+        with ExternalMerger(self.row_payload_bytes, spill_dir=spill_dir,
+                            memory_budget_bytes=memory_budget_bytes) as m:
+            for keys, payload in self.read():
+                m.add_batch(keys, payload)
+            yield from m.sorted_batches()
+
     def read_aggregated(self, combine: Callable[[np.ndarray, np.ndarray], Batch]
                         ) -> Batch:
         """Aggregate with a vectorized combiner (sorted-run reduction)."""
