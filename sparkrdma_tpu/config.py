@@ -104,6 +104,12 @@ _KEYS = [
              "engine-level shuffle block compression the reference inherits."),
     _Key("wire_compress_min", "8k", "bytes", 0, 1 << 30,
          doc="Minimum payload size worth compressing."),
+    _Key("wire_codec", "", "str",
+         doc="Wire codec for fetch payloads ('hmac-sha256', 'aes-gcm', or "
+             "engine-registered) — the encryption half of the reference's "
+             "stream wrapping (scala/RdmaShuffleReader.scala:118-128)."),
+    _Key("wire_codec_key", "", "str",
+         doc="Hex key material for wire_codec (aes-gcm: 16/24/32 bytes)."),
     _Key("trace_file", "", "str",
          doc="Write a chrome://tracing JSON of shuffle spans here at stop."),
     _Key("collect_shuffle_reader_stats", False, "bool",

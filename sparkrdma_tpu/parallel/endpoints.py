@@ -53,6 +53,16 @@ class DeadExecutorError(RuntimeError):
     """Raised when a fetch resolves to a tombstoned (lost) executor slot."""
 
 
+def _codec_aad(req, flags: int) -> bytes:
+    """Associated data binding a wrapped fetch payload to its request:
+    a recorded response replayed onto a different req_id/shuffle or with
+    flipped flags fails verification (both sides derive this
+    independently — it never travels)."""
+    import struct
+
+    return struct.pack("<qiI", req.req_id, req.shuffle_id, flags)
+
+
 class ShuffleDataSource(Protocol):
     """What an executor serves to its peers (implemented by the resolver)."""
 
@@ -88,6 +98,17 @@ class DriverEndpoint:
         self._broadcaster = threading.Thread(
             target=self._broadcast_loop, daemon=True, name="driver-announce")
         self._broadcaster.start()
+        # Long-poll table waiters: shuffle_id -> [(conn, req_id,
+        # min_published, deadline)]. Registered when a fetch can't be
+        # satisfied yet; answered by the publish that satisfies it (push,
+        # not client polling) or by the expiry sweeper with the partial
+        # table. Never blocks a handler thread — a blocked handler would
+        # deadlock against publishes arriving on the same connection.
+        self._waiters: Dict[int, list] = {}
+        self._waiters_lock = threading.Lock()
+        self._sweeper = threading.Thread(target=self._sweep_waiters,
+                                         daemon=True, name="driver-sweeper")
+        self._sweeper.start()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -105,6 +126,11 @@ class DriverEndpoint:
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._tables_lock:
             self._tables.pop(shuffle_id, None)
+        # unblock long-pollers: the shuffle is gone, answer "unknown"
+        with self._waiters_lock:
+            waiters = self._waiters.pop(shuffle_id, [])
+        for conn, req_id, _, _ in waiters:
+            self._answer_waiter(conn, M.FetchTableResp(req_id, -1, b""))
 
     def members(self) -> List[ShuffleManagerId]:
         with self._members_lock:
@@ -135,7 +161,7 @@ class DriverEndpoint:
         if isinstance(msg, M.PublishMsg):
             return self._on_publish(msg)
         if isinstance(msg, M.FetchTableReq):
-            return self._on_fetch_table(msg)
+            return self._on_fetch_table(conn, msg)
         log.warning("driver: unexpected %s", type(msg).__name__)
         return None
 
@@ -237,14 +263,75 @@ class DriverEndpoint:
         except (ValueError, IndexError) as e:
             log.warning("driver: bad publish for shuffle %d map %d: %s",
                         msg.shuffle_id, msg.map_id, e)
+            return None
+        # push: answer any long-poller this publish satisfies (the write
+        # above happens-before the waiter scan; _on_fetch_table re-checks
+        # the count inside the same lock, so no wakeup can be lost)
+        ready = []
+        with self._waiters_lock:
+            pending = self._waiters.get(msg.shuffle_id)
+            if pending:
+                n = table.num_published
+                still = [w for w in pending if w[2] > n]
+                ready = [w for w in pending if w[2] <= n]
+                if still:
+                    self._waiters[msg.shuffle_id] = still
+                else:
+                    self._waiters.pop(msg.shuffle_id, None)
+        if ready:
+            count, table_bytes = table.num_published, table.to_bytes()
+            for conn, req_id, _, _ in ready:
+                self._answer_waiter(conn, M.FetchTableResp(
+                    req_id, count, table_bytes))
         return None
 
-    def _on_fetch_table(self, msg: M.FetchTableReq) -> RpcMsg:
+    def _on_fetch_table(self, conn: Connection,
+                        msg: M.FetchTableReq) -> Optional[RpcMsg]:
         with self._tables_lock:
             table = self._tables.get(msg.shuffle_id)
         if table is None:
             return M.FetchTableResp(msg.req_id, -1, b"")
-        return M.FetchTableResp(msg.req_id, table.num_published, table.to_bytes())
+        with self._waiters_lock:
+            n = table.num_published
+            if n >= msg.min_published or msg.timeout_ms <= 0:
+                return M.FetchTableResp(msg.req_id, n, table.to_bytes())
+            deadline = time.monotonic() + msg.timeout_ms / 1000
+            self._waiters.setdefault(msg.shuffle_id, []).append(
+                (conn, msg.req_id, msg.min_published, deadline))
+        return None  # answered later by a publish or the sweeper
+
+    def _answer_waiter(self, conn: Connection, resp: RpcMsg) -> None:
+        try:
+            conn.send(resp)
+        except TransportError as e:
+            log.warning("driver: long-poll answer failed: %s", e)
+
+    def _sweep_waiters(self) -> None:
+        """Expire long-polls at their deadline with the partial table."""
+        while not self._announce_stop:
+            time.sleep(0.05)
+            now = time.monotonic()
+            expired = []  # [(sid, table, [waiter, ...])]
+            with self._waiters_lock:
+                for sid, pending in list(self._waiters.items()):
+                    live = [w for w in pending if w[3] > now]
+                    dead = [w for w in pending if w[3] <= now]
+                    if dead:
+                        with self._tables_lock:
+                            table = self._tables.get(sid)
+                        expired.append((table, dead))
+                        if live:
+                            self._waiters[sid] = live
+                        else:
+                            self._waiters.pop(sid, None)
+            for table, dead in expired:
+                if table is None:
+                    count, table_bytes = -1, b""
+                else:
+                    count, table_bytes = table.num_published, table.to_bytes()
+                for conn, req_id, _, _ in dead:
+                    self._answer_waiter(conn, M.FetchTableResp(
+                        req_id, count, table_bytes))
 
     def stop(self) -> None:
         with self._announce_cond:
@@ -281,6 +368,10 @@ class ExecutorEndpoint:
         self._table_lock = threading.Lock()
         self.wire_bytes_in = 0  # compressed-on-the-wire fetch payload total
         self._wire_lock = threading.Lock()
+        # wire codec (encryption/integrity hook, utils/codecs.py — the
+        # scala/RdmaShuffleReader.scala:118-128 wrapStream analogue)
+        from sparkrdma_tpu.utils import codecs as _codecs
+        self._codec, self._codec_key = _codecs.resolve(self.conf)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -409,6 +500,10 @@ class ExecutorEndpoint:
             compressed = zlib.compress(payload, level=1)
             if len(compressed) < len(payload):
                 payload, flags = compressed, M.FLAG_ZLIB
+        if self._codec is not None:
+            flags |= M.FLAG_WRAPPED
+            payload = self._codec.wrap(payload, self._codec_key,
+                                       _codec_aad(msg, flags))
         return M.FetchBlocksResp(msg.req_id, M.STATUS_OK, payload, flags)
 
     # -- client-side fetch calls (used by the fetcher iterator) ----------
@@ -425,8 +520,10 @@ class ExecutorEndpoint:
 
     def get_driver_table(self, shuffle_id: int, expect_published: int,
                          timeout: Optional[float] = None) -> DriverTable:
-        """Fetch + poll until the expected publishes have landed
-        (scala/RdmaShuffleManager.scala:341-376; wait budget
+        """One long-poll: the driver holds the response until the expected
+        publishes have landed (push on publish, not client polling — the
+        event-driven analogue of the reference's READ-once-after-known-
+        complete, scala/RdmaShuffleManager.scala:341-376; wait budget
         partitionLocationFetchTimeout, scala/RdmaShuffleConf.scala:112-115).
         Memoized per shuffle only once ALL maps have published, so a later
         call with a higher expectation never sees a stale partial table."""
@@ -438,9 +535,14 @@ class ExecutorEndpoint:
                else self.conf.partition_location_fetch_timeout_ms / 1000)
         deadline = time.monotonic() + tmo
         conn = self.driver_conn()
-        delay = 0.002
         while True:
-            resp = conn.request(M.FetchTableReq(conn.next_req_id(), shuffle_id))
+            remaining = deadline - time.monotonic()
+            resp = conn.request(
+                M.FetchTableReq(conn.next_req_id(), shuffle_id,
+                                min_published=expect_published,
+                                timeout_ms=max(1, int(remaining * 1000))),
+                timeout=max(0.05, remaining) + 5.0)  # grace over the
+            # server-side hold so the sweeper answers before we give up
             assert isinstance(resp, M.FetchTableResp)
             if resp.num_published >= expect_published:
                 table = DriverTable.from_bytes(resp.table)
@@ -448,12 +550,17 @@ class ExecutorEndpoint:
                     with self._table_lock:
                         self._table_cache[shuffle_id] = table
                 return table
+            if resp.num_published < 0:
+                # driver doesn't know the shuffle (unregistered mid-poll or
+                # never registered): re-arming would spin, fail now
+                raise TimeoutError(
+                    f"shuffle {shuffle_id} is not registered at the driver")
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"shuffle {shuffle_id}: only {resp.num_published}/"
                     f"{expect_published} map outputs published")
-            time.sleep(delay)
-            delay = min(delay * 2, 0.25)
+            # partial answer before the deadline (sweeper raced a publish
+            # burst): re-arm the long-poll for the remaining budget
 
     def invalidate_shuffle(self, shuffle_id: int) -> None:
         """Drop the memoized driver table (shuffle unregistered; ids can
@@ -475,15 +582,16 @@ class ExecutorEndpoint:
                      blocks) -> bytes:
         # prefer the peer's native block server when advertised: same wire
         # protocol, no Python on the serving side. The native server doesn't
-        # compress, so when wire compression is requested (bandwidth-starved
-        # DCN) stay on the control path which does.
+        # compress or wrap, so when wire compression or a wire codec is
+        # configured stay on the control path which does.
         blocks = list(blocks)
         port = (peer.block_port
                 if peer.block_port and not self.conf.wire_compress
+                and self._codec is None
                 else peer.rpc_port)
         conn = self._clients.get(peer.rpc_host, port)
-        resp = conn.request(M.FetchBlocksReq(conn.next_req_id(), shuffle_id,
-                                             blocks))
+        req = M.FetchBlocksReq(conn.next_req_id(), shuffle_id, blocks)
+        resp = conn.request(req)
         assert isinstance(resp, M.FetchBlocksResp)
         if resp.status == M.STATUS_BAD_RANGE and port != peer.rpc_port:
             # only the size-cap case is worth retrying: the native server
@@ -492,14 +600,32 @@ class ExecutorEndpoint:
             # on the control connection — retrying would just double the
             # failure-path load during an executor-loss storm
             conn = self._clients.get(peer.rpc_host, peer.rpc_port)
-            resp = conn.request(M.FetchBlocksReq(conn.next_req_id(),
-                                                 shuffle_id, blocks))
+            req = M.FetchBlocksReq(conn.next_req_id(), shuffle_id, blocks)
+            resp = conn.request(req)
             assert isinstance(resp, M.FetchBlocksResp)
         if resp.status != M.STATUS_OK:
             raise TransportError(f"fetch_blocks status={resp.status}")
         with self._wire_lock:
             self.wire_bytes_in += len(resp.data)
+        data = resp.data
+        if self._codec is not None and not (resp.flags & M.FLAG_WRAPPED):
+            # a stripped FLAG_WRAPPED must not downgrade the channel to
+            # accepting unauthenticated bytes
+            raise TransportError(
+                "peer sent an unwrapped payload but wire_codec is "
+                "configured (downgrade or peer config drift)")
+        if resp.flags & M.FLAG_WRAPPED:
+            from sparkrdma_tpu.utils.codecs import CodecError
+            if self._codec is None:
+                raise TransportError(
+                    "peer wrapped the payload but no wire_codec is "
+                    "configured here")
+            try:
+                data = self._codec.unwrap(data, self._codec_key,
+                                          _codec_aad(req, resp.flags))
+            except CodecError as e:
+                raise TransportError(f"fetch_blocks unwrap failed: {e}") from e
         if resp.flags & M.FLAG_ZLIB:
             import zlib
-            return zlib.decompress(resp.data)
-        return resp.data
+            return zlib.decompress(data)
+        return data

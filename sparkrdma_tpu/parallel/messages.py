@@ -58,16 +58,30 @@ class PublishMsg(RpcMsg):
 
 @register(5)
 class FetchTableReq(RpcMsg):
-    def __init__(self, req_id: int, shuffle_id: int):
+    """``min_published > 0`` turns the fetch into a long-poll: the driver
+    holds the response until that many maps have published (or
+    ``timeout_ms`` passes, answering with the partial table) — one
+    request per reducer instead of a poll loop against the driver, the
+    role the reference's known-complete one-sided READ plays
+    (scala/RdmaShuffleManager.scala:341-376)."""
+
+    def __init__(self, req_id: int, shuffle_id: int,
+                 min_published: int = 0, timeout_ms: int = 0):
         self.req_id = req_id
         self.shuffle_id = shuffle_id
+        self.min_published = min_published
+        self.timeout_ms = timeout_ms
 
     def payload(self) -> bytes:
-        return _QI.pack(self.req_id, self.shuffle_id)
+        return (_QI.pack(self.req_id, self.shuffle_id)
+                + struct.pack("<ii", self.min_published, self.timeout_ms))
 
     @classmethod
     def from_payload(cls, payload: bytes) -> "FetchTableReq":
-        return cls(*_QI.unpack_from(payload, 0))
+        req_id, shuffle_id = _QI.unpack_from(payload, 0)
+        min_published, timeout_ms = struct.unpack_from("<ii", payload,
+                                                       _QI.size)
+        return cls(req_id, shuffle_id, min_published, timeout_ms)
 
 
 @register(6)
@@ -158,7 +172,10 @@ class FetchBlocksReq(RpcMsg):
         return cls(req_id, shuffle_id, blocks)
 
 
-FLAG_ZLIB = 1  # FetchBlocksResp.flags: payload is zlib-compressed
+FLAG_ZLIB = 1     # FetchBlocksResp.flags: payload is zlib-compressed
+FLAG_WRAPPED = 2  # payload passed through the configured wire codec
+                  # (utils/codecs.py; applied after compression, so
+                  # readers unwrap first)
 
 _QII = struct.Struct("<qii")
 

@@ -212,20 +212,18 @@ class _RunCursor:
         self._payload = np.zeros((0, row_bytes - 8), np.uint8)
 
     def refill(self) -> bool:
-        """Read the next chunk; False when the run is exhausted."""
+        """Read the next chunk into the (empty) buffer; False when the
+        run is exhausted. Only called with an empty buffer, which is what
+        keeps the resident bound at exactly buffer_rows per run."""
+        assert not len(self._keys)
         if self._remaining == 0:
-            return len(self._keys) > 0
+            return False
         take = min(self._buffer_rows, self._remaining)
         data = self._f.read(take * self._row_bytes)
         self._remaining -= take
         rows = np.frombuffer(data, np.uint8).reshape(take, self._row_bytes)
-        keys = rows[:, :8].copy().view(np.uint64).ravel()
-        payload = rows[:, 8:].copy()
-        if len(self._keys):  # leftover from take_upto
-            self._keys = np.concatenate([self._keys, keys])
-            self._payload = np.concatenate([self._payload, payload])
-        else:
-            self._keys, self._payload = keys, payload
+        self._keys = rows[:, :8].copy().view(np.uint64).ravel()
+        self._payload = rows[:, 8:].copy()
         return True
 
     def ensure(self) -> bool:

@@ -114,18 +114,32 @@ class CompatWriter:
 
 
 class CompatReader:
-    """``read()`` -> record iterator (scala/RdmaShuffleReader.scala:43)."""
+    """``read()`` -> record iterator (scala/RdmaShuffleReader.scala:43).
+
+    ``readBatches()`` is the performance surface: it yields
+    ``(keys u64[N], payload u8[N, W])`` numpy batches straight off the
+    fetcher with no per-row Python. ``read()`` exists for reference-shaped
+    row-at-a-time consumers and costs a Python loop per record — at
+    TeraSort scale use the batch form (everything in-tree does).
+    """
 
     def __init__(self, inner):
         self._r = inner
 
     def read(self) -> Iterator[Tuple[int, np.ndarray]]:
+        """Row-at-a-time compatibility shim over ``readBatches``."""
         for keys, payload in self._r.read():
             for i in range(len(keys)):
                 yield int(keys[i]), payload[i]
 
     def readBatches(self):
+        """Vectorized record batches — the fast path."""
         return self._r.read()
+
+    def readSortedSpilled(self, memoryBudgetBytes: int = 64 << 20):
+        """Globally key-sorted batches with bounded memory (the
+        ExternalSorter delegation, scala/RdmaShuffleReader.scala:100-114)."""
+        return self._r.read_sorted_spilled(memory_budget_bytes=memoryBudgetBytes)
 
     @property
     def metrics(self):
