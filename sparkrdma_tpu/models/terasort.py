@@ -43,6 +43,17 @@ class TeraSortConfig:
     rows_per_device: int
     payload_words: int = 24  # 4B key word + 24*4B payload ≈ the classic 100B row
     out_factor: int = 2      # receive headroom (uniform keys -> mild skew)
+    # How payload follows its key through a local sort:
+    #   "gather"    — sort (key, iota) then ONE row gather. The gather costs
+    #                 ~43ns/row on v5e regardless of row width (measured:
+    #                 random-access bound, ~5x the key sort) — it is the
+    #                 step's bottleneck.
+    #   "multisort" — every payload column rides the sort network as an
+    #                 extra lax.sort operand: no gather at all, but the sort
+    #                 moves width/8 more bytes per pass. Which wins is
+    #                 hardware-dependent (gather is latency-bound, the sort
+    #                 bandwidth-bound); bench A/Bs via BENCH_SORT_MODE.
+    sort_mode: str = "gather"
 
     @property
     def row_bytes(self) -> int:
@@ -62,19 +73,25 @@ def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
     """
     n = mesh.shape[axis_name]
     impl = resolve_impl(mesh, impl)
+    if cfg.sort_mode not in ("gather", "multisort"):
+        # a typo must not silently measure (and mislabel) the gather path
+        raise ValueError(f"unknown sort_mode {cfg.sort_mode!r} "
+                         "(expected 'gather' or 'multisort')")
     splitters = uniform_splitters(n, jnp.uint32)
     spec = P(axis_name)
 
     def sort_rows_by_key(rows, keys):
-        """One co-sort of (key, row-index) + ONE row gather.
-
-        The row gather is the expensive op on TPU (~40ns/row fixed cost —
-        measured: a [10.7M, 25] u32 take is 5x the cost of the u32 sort),
-        so the step is built around doing exactly one per exchange side.
-        """
-        iota = jnp.arange(rows.shape[0], dtype=jnp.int32)
-        sorted_keys, order = jax.lax.sort((keys, iota), num_keys=1)
-        sorted_rows = jnp.take(rows, order, axis=0)
+        """One local sort of full rows by key; exactly one per exchange
+        side (see TeraSortConfig.sort_mode for the two strategies)."""
+        if cfg.sort_mode == "multisort":
+            cols = tuple(rows[:, j] for j in range(rows.shape[1]))
+            out = jax.lax.sort((keys,) + cols, num_keys=1)
+            sorted_keys = out[0]
+            sorted_rows = jnp.stack(out[1:], axis=1)
+        else:
+            iota = jnp.arange(rows.shape[0], dtype=jnp.int32)
+            sorted_keys, order = jax.lax.sort((keys, iota), num_keys=1)
+            sorted_rows = jnp.take(rows, order, axis=0)
         # the key column already equals sorted_keys for valid rows; only
         # padding rows (sentinel keys) need the overwrite
         return sorted_rows.at[:, 0].set(sorted_keys), sorted_keys
@@ -173,6 +190,7 @@ def run_terasort(mesh: Mesh, cfg: TeraSortConfig, axis_name: str = "shuffle",
 
 def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
                           axis_name: str = "shuffle", impl: str = "auto",
+                          pipeline_rounds: bool = True,
                           ) -> Tuple[list, int]:
     """TeraSort a dataset LARGER than one round's device capacity.
 
@@ -180,10 +198,15 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
     holds only a fraction of the data, so the job runs as R rounds of the
     jitted partition/exchange/sort step — each round bounded to
     ``rows_per_device`` rows per device — and each device merges its R
-    key-sorted runs host-side. Round memory is static; total data is not
-    (the chunked-transfer discipline of the reference's grouped fetches,
-    scala/RdmaShuffleFetcherIterator.scala:240-276, applied to the whole
-    job).
+    key-sorted runs host-side. Per-round memory is static; total data is
+    not (the chunked-transfer discipline of the reference's grouped
+    fetches, scala/RdmaShuffleFetcherIterator.scala:240-276, applied to
+    the whole job).
+
+    ``pipeline_rounds`` (default) double-buffers: round r+1's staging +
+    device step overlap round r's host-side collection, at the cost of up
+    to TWO rounds of device footprint resident at once. Pass False for
+    the strict one-round footprint when a round is sized near HBM.
 
     Returns ``(per_device_sorted_rows: [D] list of u32[*, 1+P], rounds)``.
     """
@@ -215,10 +238,11 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
                          "out_factor >= 2 (pad headroom)")
 
     runs: list = [[] for _ in range(n)]
-    pads_for: np.ndarray = np.zeros(n, dtype=np.int64)
-    for r in range(num_rounds):
+
+    def dispatch(r: int):
+        """Stage + launch round r; returns (pads_for, async device results)."""
         chunk = rows[r * per_round:(r + 1) * per_round]
-        pads_for[:] = 0
+        pads_for = np.zeros(n, dtype=np.int64)
         tail_pad = per_round - len(chunk)
         if tail_pad:
             pad = np.zeros((tail_pad, rows.shape[1]), rows.dtype)
@@ -226,8 +250,10 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
             pad[:, 0] = range_max[dests]
             np.add.at(pads_for, dests, 1)
             chunk = np.concatenate([chunk, pad])
-        out, counts, overflowed = jax.block_until_ready(
-            step(jax.device_put(chunk, sharding)))
+        return pads_for, step(jax.device_put(chunk, sharding))
+
+    def collect(pads_for, results):
+        out, counts, overflowed = results
         if np.asarray(overflowed).any():
             raise OverflowError("streamed round receive overflow; raise "
                                 "out_factor or shrink rows_per_device")
@@ -238,6 +264,23 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
             # .copy(): a view would pin the whole padded round buffer on the
             # host across all R rounds (~out_factor x dataset RSS)
             runs[d].append(out[d][:total - int(pads_for[d])].copy())
+
+    # Double-buffered rounds: round r+1's device work is dispatched (jax
+    # dispatch is async) before round r's host-side collection, so staging
+    # + host processing overlap the device step — the inter-round pipeline
+    # the reference gets from its async fetch window
+    # (scala/RdmaShuffleFetcherIterator.scala:264-276).
+    if pipeline_rounds:
+        pending = None
+        for r in range(num_rounds):
+            nxt = dispatch(r)
+            if pending is not None:
+                collect(*pending)
+            pending = nxt
+        collect(*pending)
+    else:
+        for r in range(num_rounds):
+            collect(*dispatch(r))
 
     from sparkrdma_tpu.shuffle.external import merge_runs
 

@@ -102,3 +102,21 @@ def test_streamed_terasort_sentinel_keys_survive(mesh):
     got = np.concatenate(merged)
     assert len(got) == n_rows
     assert int((got[:, 0] == 0xFFFFFFFF).sum()) == n_max
+
+
+def test_multisort_mode_matches_gather(mesh):
+    """sort_mode='multisort' (payload through the sort network, no gather)
+    is bit-identical to the gather path."""
+    from sparkrdma_tpu.models.terasort import (TeraSortConfig, generate_rows,
+                                               run_terasort, verify_terasort)
+
+    rows = generate_rows(TeraSortConfig(rows_per_device=512, payload_words=6),
+                         8, seed=9)
+    outs = {}
+    for mode in ("gather", "multisort"):
+        cfg = TeraSortConfig(rows_per_device=512, payload_words=6,
+                             out_factor=2, sort_mode=mode)
+        out, counts, _ = run_terasort(mesh, cfg, rows=rows)
+        verify_terasort(out, counts, rows, 8)
+        outs[mode] = out
+    np.testing.assert_array_equal(outs["gather"], outs["multisort"])

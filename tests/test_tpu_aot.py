@@ -79,6 +79,19 @@ def test_terasort_step_compiles_for_tpu(tpu_mesh):
     assert "ragged_all_to_all" in text
 
 
+def test_terasort_multisort_compiles_for_tpu(tpu_mesh):
+    """The gather-free sort strategy also passes the v5e compiler (the
+    hardware A/B in bench.py needs both variants compilable)."""
+    from sparkrdma_tpu.models.terasort import TeraSortConfig, make_terasort_step
+
+    cfg = TeraSortConfig(rows_per_device=256, payload_words=24, out_factor=2,
+                         sort_mode="multisort")
+    step = make_terasort_step(tpu_mesh, AXIS, cfg)
+    rows = jax.ShapeDtypeStruct((8 * cfg.rows_per_device, 25), jnp.uint32,
+                                sharding=NamedSharding(tpu_mesh, P(AXIS)))
+    _lower_compile(step, rows)
+
+
 def test_ring_kernel_mosaic_compiles(tpu_mesh):
     """The hand-scheduled Pallas ring (remote DMAs + neighbor barrier)
     passes Mosaic in compiled mode — the barrier code interpret mode can't
