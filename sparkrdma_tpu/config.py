@@ -130,6 +130,11 @@ _KEYS = [
     _Key("block_server_cpus", "", "str",
          doc="Comma-separated cores to pin block-server workers to; empty = "
              "no pinning (ref cpuList + java/RdmaThread.java:46-48)."),
+    _Key("task_threads", 4, "int", 1, 1024,
+         doc="Worker threads for shipped engine tasks per executor "
+             "(Spark's executor task slots analogue)."),
+    _Key("task_timeout_ms", 600_000, "int", 1000, 86_400_000,
+         doc="Driver-side wait budget for one shipped task."),
 ]
 
 _KEY_MAP: Dict[str, _Key] = {k.name: k for k in _KEYS}

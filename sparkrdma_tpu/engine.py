@@ -113,10 +113,16 @@ class DAGEngine:
     """Schedules stage DAGs over a cluster of compat shuffle managers.
 
     ``driver`` is the driver-role manager; ``executors`` the executor-role
-    managers. Tasks round-robin over live executors; a FetchFailed from any
-    task triggers recompute of the lost maps of the failed shuffle on
-    survivors (positional republish repairs the driver table atomically),
-    then the task retries — ``max_stage_retries`` bounds attempts per task.
+    managers — in-process ``SparkCompatShuffleManager`` objects and/or
+    ``tasks.RemoteExecutor`` proxies for executor PROCESSES (tasks ship by
+    cloudpickle and run against the remote manager, the way Spark ships
+    closures to the reference's executors). Tasks round-robin over live
+    executors; a FetchFailed from any task triggers recompute of the lost
+    maps of the failed shuffle on survivors (positional republish repairs
+    the driver table atomically), then the task retries —
+    ``max_stage_retries`` bounds attempts per task per failed shuffle; an
+    unreachable executor costs the same budget under the task-delivery
+    key instead.
     """
 
     def __init__(self, driver: SparkCompatShuffleManager,
@@ -151,8 +157,15 @@ class DAGEngine:
                     # executor-side too: drops the resolver's spill data and
                     # the memoized driver table, not just the driver entry —
                     # else every job leaks its full shuffle dataset
-                    for mgr in self._live():
-                        mgr.unregisterShuffle(handle.shuffle_id)
+                    for ex in self._live():
+                        try:
+                            self._unregister_on(ex, handle.shuffle_id)
+                        except Exception:  # noqa: BLE001 — cleanup is
+                            # best-effort; a dying executor must not mask
+                            # the job's real outcome
+                            log.warning("cleanup of shuffle %d failed on an "
+                                        "executor", handle.shuffle_id,
+                                        exc_info=True)
 
     # -- scheduling ------------------------------------------------------
 
@@ -169,13 +182,51 @@ class DAGEngine:
         visit(final)
         return order
 
-    def _live(self) -> List[SparkCompatShuffleManager]:
-        return [m for m in self.executors
-                if m.native.executor is not None
-                and not m.native.executor.server.stopped]
+    def _live(self) -> List[object]:
+        out = []
+        members = None
+        for ex in self.executors:
+            if self._is_remote(ex):
+                if members is None:
+                    members = self.driver.native.driver.members()
+                # a tombstoned member is dead regardless of what this
+                # process's proxy has observed (its slot can't be resolved)
+                if ex.alive and ex.manager_id in members:
+                    out.append(ex)
+            elif (ex.native.executor is not None
+                  and not ex.native.executor.server.stopped):
+                out.append(ex)
+        return out
 
-    def _slot_of(self, mgr: SparkCompatShuffleManager) -> int:
-        return mgr.native.executor.exec_index(timeout=1)
+    @staticmethod
+    def _is_remote(ex) -> bool:
+        from sparkrdma_tpu.tasks import RemoteExecutor
+
+        return isinstance(ex, RemoteExecutor)
+
+    def _slot_of(self, ex) -> int:
+        """The executor's stable membership slot, or -1 if it has been
+        tombstoned since the caller's liveness check (a racing loss must
+        flow into the retry machinery, not raise ValueError)."""
+        if self._is_remote(ex):
+            members = self.driver.native.driver.members()
+            try:
+                return members.index(ex.manager_id)
+            except ValueError:
+                return -1
+        return ex.native.executor.exec_index(timeout=1)
+
+    def _unregister_on(self, ex, shuffle_id: int) -> None:
+        if self._is_remote(ex):
+            ex.unregister_shuffle(shuffle_id)
+        else:
+            ex.unregisterShuffle(shuffle_id)
+
+    def _invalidate_on(self, ex, shuffle_id: int) -> None:
+        if self._is_remote(ex):
+            ex.invalidate_shuffle(shuffle_id)
+        else:
+            ex.native.executor.invalidate_shuffle(shuffle_id)
 
     def _run_map_stage(self, stage: MapStage) -> None:
         shuffle_id = next(_shuffle_ids)
@@ -195,6 +246,8 @@ class DAGEngine:
         damaging several parent shuffles costs the task one recovery per
         parent (each makes forward progress), not its whole budget.
         """
+        from sparkrdma_tpu.tasks import ExecutorLostError
+
         attempts_by_shuffle: Dict[int, int] = {}
         first = True
         while True:
@@ -211,6 +264,16 @@ class DAGEngine:
                 log.warning("stage %d task %d: %s; retrying (%d)",
                             stage.stage_id, task_id, e, n)
                 self._recover_shuffle(e)
+            except ExecutorLostError as e:
+                # delivery failure: nothing ran, so no shuffle to repair —
+                # just place the task on another live executor (data the
+                # dead process owned surfaces later as FetchFailed above)
+                n = attempts_by_shuffle.get(-1, 0) + 1
+                attempts_by_shuffle[-1] = n
+                if n > self.max_stage_retries:
+                    raise
+                log.warning("stage %d task %d: %s; re-placing (%d)",
+                            stage.stage_id, task_id, e, n)
 
     def _pick_live(self, task_id: int) -> SparkCompatShuffleManager:
         live = self._live()
@@ -218,19 +281,28 @@ class DAGEngine:
             raise RuntimeError("no live executors")
         return live[task_id % len(live)]
 
-    def _attempt_task(self, stage, task_id: int,
-                      mgr: SparkCompatShuffleManager):
-        ctx = TaskContext(self, mgr, stage, task_id)
+    def _attempt_task(self, stage, task_id: int, target):
+        parent_handles = [self._handles[p.stage_id] for p in stage.parents]
+        if self._is_remote(target):
+            if isinstance(stage, MapStage):
+                handle = self._handles[stage.stage_id]
+                target.run_map_task(stage.task_fn, handle, parent_handles,
+                                    task_id)
+                self._owners[stage.stage_id][task_id] = self._slot_of(target)
+                return None
+            return target.run_result_task(stage.task_fn, parent_handles,
+                                          task_id)
+        ctx = TaskContext(self, target, stage, task_id)
         if isinstance(stage, MapStage):
             handle = self._handles[stage.stage_id]
-            writer = mgr.getWriter(handle, task_id)
+            writer = target.getWriter(handle, task_id)
             try:
                 stage.task_fn(ctx, writer, task_id)
             except BaseException:
                 writer.stop(False)
                 raise
             writer.stop(True)
-            self._owners[stage.stage_id][task_id] = self._slot_of(mgr)
+            self._owners[stage.stage_id][task_id] = self._slot_of(target)
             return None
         return stage.task_fn(ctx, task_id)
 
@@ -246,11 +318,13 @@ class DAGEngine:
             raise failure  # not one of ours (already unregistered?)
         owners = self._owners[stage.stage_id]
         dead = failure.exec_index
-        lost = [m for m, slot in owners.items() if slot == dead]
+        # slot < 0 = owner was tombstoned before its slot resolved: its
+        # data is on a dead executor too, recompute alongside
+        lost = [m for m, slot in owners.items() if slot == dead or slot < 0]
         if not lost and failure.map_id >= 0:
             lost = [failure.map_id]
         live = [m for m in self._live()
-                if self._slot_of(m) != dead]
+                if self._slot_of(m) not in (dead, -1)]
         if not live:
             raise RuntimeError("no surviving executors to recompute on")
         log.warning("recovering shuffle %d: recomputing maps %s lost with "
@@ -259,5 +333,12 @@ class DAGEngine:
             # recompute tasks read their parents through _run_task too, so
             # a grandparent loss recovers recursively within its own budget
             self._run_task(stage, m, mgr=live[k % len(live)])
-        for mgr in self._live():
-            mgr.native.executor.invalidate_shuffle(failure.shuffle_id)
+        for ex in self._live():
+            try:
+                self._invalidate_on(ex, failure.shuffle_id)
+            except Exception:  # noqa: BLE001 — a second executor dying
+                # during recovery must not crash the job; its stale cache
+                # only matters if it serves again, which its own failure
+                # path handles
+                log.warning("cache invalidation failed on an executor "
+                            "during recovery", exc_info=True)

@@ -372,6 +372,10 @@ class ExecutorEndpoint:
         # scala/RdmaShuffleReader.scala:118-128 wrapStream analogue)
         from sparkrdma_tpu.utils import codecs as _codecs
         self._codec, self._codec_key = _codecs.resolve(self.conf)
+        # task shipping (engine tasks run here when a runner is installed;
+        # see sparkrdma_tpu/tasks.py)
+        self._task_runner = None
+        self._task_pool = None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -383,6 +387,8 @@ class ExecutorEndpoint:
         return self._clients.get(*self._driver_addr)
 
     def stop(self) -> None:
+        if self._task_pool is not None:
+            self._task_pool.shutdown(wait=False, cancel_futures=True)
         self._clients.close_all()
         self.server.stop()
 
@@ -442,9 +448,45 @@ class ExecutorEndpoint:
             return self._on_fetch_output(msg)
         if isinstance(msg, M.FetchBlocksReq):
             return self._on_fetch_blocks(msg)
+        if isinstance(msg, M.RunTaskReq):
+            return self._on_run_task(conn, msg)
         log.warning("%s: unexpected %s", self.manager_id.executor_id.executor,
                     type(msg).__name__)
         return None
+
+    # -- task shipping ---------------------------------------------------
+
+    def set_task_runner(self, runner) -> None:
+        """Install ``runner(payload bytes) -> (status, result bytes)``; it
+        runs on a bounded worker pool (a task must never run on the
+        connection's reader thread — it would block the control plane,
+        including the publishes its own writes produce)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._task_runner = runner
+        if self._task_pool is None:
+            self._task_pool = ThreadPoolExecutor(
+                max_workers=self.conf.task_threads,
+                thread_name_prefix=f"task-{self.manager_id.executor_id.executor}")
+
+    def _on_run_task(self, conn: Connection,
+                     msg: M.RunTaskReq) -> Optional[RpcMsg]:
+        runner = self._task_runner
+        if runner is None or self._task_pool is None:
+            return M.RunTaskResp(msg.req_id, M.TASK_NO_RUNNER, b"")
+
+        def work():
+            try:
+                status, result = runner(msg.data)
+            except Exception as e:  # noqa: BLE001 — runner contract breach
+                status, result = M.TASK_ERROR, repr(e).encode()
+            try:
+                conn.send(M.RunTaskResp(msg.req_id, status, result))
+            except TransportError as e:
+                log.warning("task response lost (driver gone?): %s", e)
+
+        self._task_pool.submit(work)
+        return None  # answered by the worker when the task finishes
 
     def _on_fetch_output(self, msg: M.FetchOutputReq) -> RpcMsg:
         """Serve 16B location entries

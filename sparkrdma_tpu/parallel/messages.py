@@ -197,9 +197,54 @@ class FetchBlocksResp(RpcMsg):
         return cls(req_id, status, payload[_QII.size:], flags)
 
 
+@register(11)
+class RunTaskReq(RpcMsg):
+    """Ship one serialized task to an executor (the role Spark's task
+    scheduler plays for the reference: tasks arrive at executors with the
+    shuffle handle in their closure, scala/RdmaUtils.scala:145-159).
+    Payload is an opaque serialized descriptor (engine-defined)."""
+
+    def __init__(self, req_id: int, payload: bytes):
+        self.req_id = req_id
+        self.data = payload
+
+    def payload(self) -> bytes:
+        return struct.pack("<q", self.req_id) + self.data
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "RunTaskReq":
+        (req_id,) = struct.unpack_from("<q", payload, 0)
+        return cls(req_id, payload[8:])
+
+
+@register(12)
+class RunTaskResp(RpcMsg):
+    """status: TASK_OK / TASK_ERROR / TASK_FETCH_FAILED; payload is the
+    serialized result or error detail."""
+
+    def __init__(self, req_id: int, status: int, payload: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.data = payload
+
+    def payload(self) -> bytes:
+        return struct.pack("<qi", self.req_id, self.status) + self.data
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "RunTaskResp":
+        req_id, status = struct.unpack_from("<qi", payload, 0)
+        return cls(req_id, status, payload[12:])
+
+
 # Status codes shared by responses.
 STATUS_OK = 0
 STATUS_UNKNOWN_SHUFFLE = 1
 STATUS_UNKNOWN_MAP = 2
 STATUS_BAD_RANGE = 3
 STATUS_ERROR = 4
+
+# RunTaskResp statuses.
+TASK_OK = 0
+TASK_ERROR = 1
+TASK_FETCH_FAILED = 2
+TASK_NO_RUNNER = 3

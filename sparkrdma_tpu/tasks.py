@@ -1,0 +1,214 @@
+"""Task shipping: run engine tasks in executor processes.
+
+The reference never ships tasks — Spark does: closures (carrying the
+shuffle handle, scala/RdmaUtils.scala:145-159) are serialized to
+executors and run in task slots, and that is the only reason its
+ShuffleManager works multi-node. This module is that half for the
+in-tree engine: the driver serializes a task descriptor (cloudpickle, so
+closures work like Spark's), ships it over the control plane
+(``RunTaskReq``), and an executor-side runner executes it against the
+LOCAL manager — writers/readers/publishes all happen in the executor
+process, exactly as under Spark.
+
+Trust model: descriptors are deserialized with cloudpickle, i.e. the
+driver can execute arbitrary code on workers. This is Spark's own model
+(closure serialization); the control plane must only span trusted
+machines, like the reference's verbs endpoints.
+
+* ``install_task_server(compat_mgr)`` — worker side: handle shipped
+  tasks on the manager's executor endpoint.
+* ``RemoteExecutor`` — driver side: an executor proxy the DAG engine
+  schedules onto exactly like an in-process manager; FetchFailed raised
+  by a remote task re-raises driver-side with its slot/map identity so
+  stage retry works transparently across processes.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+from typing import List, Optional, Tuple
+
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel.transport import ConnectionCache, TransportError
+from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
+
+log = logging.getLogger(__name__)
+
+
+def _cloudpickle():
+    # lazy: in-process DAG jobs (which import this module only for the
+    # exception types) must not require cloudpickle to be installed
+    import cloudpickle
+
+    return cloudpickle
+
+
+class TaskError(RuntimeError):
+    """A shipped task failed for a non-FetchFailed reason."""
+
+
+class ExecutorLostError(RuntimeError):
+    """Task delivery failed: the executor process is unreachable."""
+
+
+class _RemoteTaskContext:
+    """Worker-side TaskContext: reads parents through the local manager."""
+
+    def __init__(self, mgr, parent_handles, task_id: int):
+        self.manager = mgr
+        self._parents = parent_handles
+        self.task_id = task_id
+
+    def read(self, parent_index: int = 0):
+        handle = self._parents[parent_index]
+        return self.manager.getReader(handle, self.task_id, self.task_id + 1)
+
+
+def install_task_server(compat_mgr) -> None:
+    """Serve shipped tasks on this executor (worker-side entry point)."""
+
+    def run(payload: bytes) -> Tuple[int, bytes]:
+        try:
+            desc = _cloudpickle().loads(payload)
+            kind = desc["kind"]
+            if kind == "map":
+                ctx = _RemoteTaskContext(compat_mgr, desc["parents"],
+                                         desc["task_id"])
+                writer = compat_mgr.getWriter(desc["handle"], desc["task_id"])
+                try:
+                    desc["fn"](ctx, writer, desc["task_id"])
+                except BaseException:
+                    writer.stop(False)
+                    raise
+                writer.stop(True)
+                result = None
+            elif kind == "result":
+                ctx = _RemoteTaskContext(compat_mgr, desc["parents"],
+                                         desc["task_id"])
+                result = desc["fn"](ctx, desc["task_id"])
+            elif kind == "invalidate":
+                compat_mgr.native.executor.invalidate_shuffle(
+                    desc["shuffle_id"])
+                result = None
+            elif kind == "unregister":
+                compat_mgr.unregisterShuffle(desc["shuffle_id"])
+                result = None
+            else:
+                return M.TASK_ERROR, f"unknown task kind {kind!r}".encode()
+            return M.TASK_OK, _cloudpickle().dumps(result)
+        except FetchFailedError as e:
+            return M.TASK_FETCH_FAILED, pickle.dumps(
+                (e.shuffle_id, e.map_id, e.exec_index, str(e)))
+        except Exception as e:  # noqa: BLE001 — report, don't kill the slot
+            log.exception("shipped task failed")
+            return M.TASK_ERROR, repr(e).encode()
+
+    compat_mgr.native.executor.set_task_runner(run)
+
+
+class RemoteExecutor:
+    """Driver-side proxy for one executor process.
+
+    The DAG engine schedules tasks onto this exactly like an in-process
+    manager; the descriptor travels by cloudpickle (closures allowed, as
+    with Spark), the result or a typed failure comes back.
+    """
+
+    def __init__(self, manager_id, conf, clients: Optional[ConnectionCache] = None):
+        self.manager_id = manager_id
+        self.conf = conf
+        self._clients = clients or ConnectionCache(conf)
+        self._own_clients = clients is None
+        self.alive = True
+
+    # -- engine-facing ---------------------------------------------------
+
+    def run_map_task(self, fn, handle, parent_handles, task_id: int) -> None:
+        self._run({"kind": "map", "fn": fn, "handle": handle,
+                   "parents": list(parent_handles), "task_id": task_id})
+
+    def run_result_task(self, fn, parent_handles, task_id: int):
+        return self._run({"kind": "result", "fn": fn,
+                          "parents": list(parent_handles),
+                          "task_id": task_id})
+
+    def invalidate_shuffle(self, shuffle_id: int) -> None:
+        self._run({"kind": "invalidate", "shuffle_id": shuffle_id})
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self._run({"kind": "unregister", "shuffle_id": shuffle_id})
+
+    def stop(self) -> None:
+        if self._own_clients:
+            self._clients.close_all()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _run(self, desc: dict):
+        import time
+
+        payload = _cloudpickle().dumps(desc)
+        # A worker hellos the driver DURING manager construction, before
+        # its process gets to install_task_server — so a freshly-announced
+        # executor can briefly answer NO_RUNNER. Retry through that
+        # bootstrap window before declaring it misconfigured.
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                conn = self._clients.get(self.manager_id.rpc_host,
+                                         self.manager_id.rpc_port)
+                resp = conn.request(
+                    M.RunTaskReq(conn.next_req_id(), payload),
+                    timeout=self.conf.task_timeout_ms / 1000)
+            except TransportError as e:
+                self.alive = False
+                raise ExecutorLostError(
+                    f"executor {self.manager_id.executor_id.executor} "
+                    f"unreachable: {e}") from e
+            except TimeoutError as e:
+                # the executor is reachable but the task outlived its
+                # budget: re-place THIS task, don't write off a healthy
+                # process (alive=False would also skip it at job cleanup,
+                # leaking its shuffle data)
+                raise ExecutorLostError(
+                    f"task on {self.manager_id.executor_id.executor} "
+                    f"exceeded task_timeout_ms: {e}") from e
+            assert isinstance(resp, M.RunTaskResp)
+            if resp.status != M.TASK_NO_RUNNER:
+                break
+            if time.monotonic() > deadline:
+                raise TaskError(
+                    f"executor {self.manager_id.executor_id.executor} has "
+                    "no task server (call tasks.install_task_server there)")
+            time.sleep(0.05)
+        if resp.status == M.TASK_OK:
+            return (_cloudpickle().loads(resp.data)
+                    if resp.data else None)
+        if resp.status == M.TASK_FETCH_FAILED:
+            shuffle_id, map_id, exec_index, cause = pickle.loads(resp.data)
+            raise FetchFailedError(shuffle_id, map_id, exec_index,
+                                   f"(remote) {cause}")
+        raise TaskError(f"remote task failed: "
+                        f"{resp.data.decode(errors='replace')[:500]}")
+
+
+def remote_executors(driver_compat, conf,
+                     expect: Optional[int] = None,
+                     timeout: float = 30.0) -> List[RemoteExecutor]:
+    """Proxies for every live member the driver currently knows (waits
+    for ``expect`` members when given)."""
+    import time
+
+    from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+
+    deadline = time.monotonic() + timeout
+    while True:
+        members = driver_compat.native.driver.members()
+        live = [m for m in members if m != TOMBSTONE]
+        if expect is None or len(live) >= expect:
+            return [RemoteExecutor(m, conf) for m in live]
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"only {len(live)}/{expect} executors joined")
+        time.sleep(0.05)
