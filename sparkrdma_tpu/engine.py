@@ -250,9 +250,10 @@ class DAGEngine:
 
         attempts_by_shuffle: Dict[int, int] = {}
         first = True
+        avoid = None
         while True:
             target = mgr if mgr is not None and first else \
-                self._pick_live(task_id)
+                self._pick_live(task_id, avoid=avoid)
             first = False
             try:
                 return self._attempt_task(stage, task_id, target)
@@ -266,17 +267,21 @@ class DAGEngine:
                 self._recover_shuffle(e)
             except ExecutorLostError as e:
                 # delivery failure: nothing ran, so no shuffle to repair —
-                # just place the task on another live executor (data the
-                # dead process owned surfaces later as FetchFailed above)
+                # place the task on a DIFFERENT live executor (a timed-out
+                # target stays alive, so round-robin alone would re-pick
+                # it every attempt and burn the budget on one slow node)
                 n = attempts_by_shuffle.get(-1, 0) + 1
                 attempts_by_shuffle[-1] = n
                 if n > self.max_stage_retries:
                     raise
+                avoid = target
                 log.warning("stage %d task %d: %s; re-placing (%d)",
                             stage.stage_id, task_id, e, n)
 
-    def _pick_live(self, task_id: int) -> SparkCompatShuffleManager:
+    def _pick_live(self, task_id: int, avoid=None):
         live = self._live()
+        if avoid is not None and len(live) > 1:
+            live = [ex for ex in live if ex is not avoid]
         if not live:
             raise RuntimeError("no live executors")
         return live[task_id % len(live)]

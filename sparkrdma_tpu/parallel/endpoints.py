@@ -478,7 +478,9 @@ class ExecutorEndpoint:
         def work():
             try:
                 status, result = runner(msg.data)
-            except Exception as e:  # noqa: BLE001 — runner contract breach
+            except BaseException as e:  # noqa: BLE001 — even SystemExit
+                # from a shipped task must produce a response; a silent
+                # swallow leaves the driver waiting out task_timeout_ms
                 status, result = M.TASK_ERROR, repr(e).encode()
             try:
                 conn.send(M.RunTaskResp(msg.req_id, status, result))

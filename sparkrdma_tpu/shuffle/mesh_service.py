@@ -74,7 +74,6 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
-    from sparkrdma_tpu.shuffle.writer import decode_rows
 
     n_dev = mesh.shape[axis_name]
     impl = resolve_impl(mesh, impl)
@@ -84,17 +83,9 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     # through the resolver's locked serving API (safe vs. concurrent
     # re-commit/unregister disposal)
     all_keys, all_payloads = [], []
-    for mgr in managers:
-        if mgr.resolver is None:
-            continue
-        for m in mgr.resolver.map_ids(handle.shuffle_id):
-            raw = mgr.resolver.local_blocks(handle.shuffle_id, m, 0,
-                                            handle.num_partitions)
-            if raw is None:
-                continue  # disposed between map_ids() and the read
-            k, p = decode_rows(raw, handle.row_payload_bytes)
-            all_keys.append(k)
-            all_payloads.append(p)
+    for k, p in _iter_committed_batches(managers, handle):
+        all_keys.append(k)
+        all_payloads.append(p)
     keys = (np.concatenate(all_keys) if all_keys
             else np.zeros(0, dtype=np.uint64))
     payload = (np.concatenate(all_payloads) if all_payloads
@@ -143,4 +134,113 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
             order = np.argsort(k, kind="stable")
             k, p, parts = k[order], p[order], parts[order]
         results.append((k, p, parts))
+    return results
+
+
+def _iter_committed_batches(managers, handle):
+    """Decoded (keys, payload) batches of every committed local spill."""
+    from sparkrdma_tpu.shuffle.writer import decode_rows
+
+    for mgr in managers:
+        if mgr.resolver is None:
+            continue
+        for m in mgr.resolver.map_ids(handle.shuffle_id):
+            raw = mgr.resolver.local_blocks(handle.shuffle_id, m, 0,
+                                            handle.num_partitions)
+            if raw is None:
+                continue  # disposed between map_ids() and the read
+            yield decode_rows(raw, handle.row_payload_bytes)
+
+
+def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
+                             handle: ShuffleHandle, mesh,
+                             axis_name: str = "shuffle", impl: str = "auto",
+                             rows_per_round: int = 1 << 18,
+                             out_factor: int = 2,
+                             ) -> List[Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]]:
+    """``run_mesh_reduce`` for datasets beyond one exchange's device (or
+    host staging) budget: spills stream through the SAME jitted exchange
+    step in bounded rounds of ``rows_per_round`` rows per device — device
+    memory is static per round, host staging holds one round — and each
+    device's key-sorted round outputs merge O(N log R) via the tournament
+    merge (`shuffle/external.py`). Same contract as ``run_mesh_reduce``
+    with ``sort_by_key=True``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
+    from sparkrdma_tpu.shuffle.external import merge_runs
+
+    n_dev = mesh.shape[axis_name]
+    impl = resolve_impl(mesh, impl)
+    partitioner = handle.partitioner.build(handle.num_partitions)
+    pw = 2 + (handle.row_payload_bytes + 3) // 4
+    cap = rows_per_round
+    spec = P(axis_name)
+    sharding = NamedSharding(mesh, spec)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
+                       out_specs=(spec, spec, spec))
+    def reduce_step(data, dest):
+        output = jnp.zeros((data.shape[0] * out_factor, pw), jnp.uint32)
+        received, recv_counts, _ = shuffle_shard(
+            data, dest, axis_name, n_dev, output=output, impl=impl)
+        return received, recv_counts[None], (recv_counts.sum()
+                                             > output.shape[0])[None]
+
+    runs: List[list] = [[] for _ in range(n_dev)]
+
+    def run_round(rows_np: np.ndarray) -> None:
+        dest = (np.asarray(partitioner(
+            rows_np[:, :2].copy().view(np.uint64).reshape(-1)),
+            dtype=np.int32) % n_dev)
+        total_cap = cap * n_dev
+        rows_p = np.zeros((total_cap, pw), np.uint32)
+        rows_p[:len(rows_np)] = rows_np
+        dest_p = np.full(total_cap, -1, np.int32)
+        dest_p[:len(rows_np)] = dest
+        received, counts, overflowed = jax.block_until_ready(reduce_step(
+            jax.device_put(rows_p, sharding),
+            jax.device_put(dest_p, sharding)))
+        if np.asarray(overflowed).any():
+            raise OverflowError("mesh reduce receive overflow; raise "
+                                "out_factor or shrink rows_per_round")
+        received = np.asarray(received).reshape(n_dev, -1, pw)
+        counts = np.asarray(counts)
+        for d in range(n_dev):
+            got = received[d][:int(counts[d].sum())]
+            keys = got[:, :2].copy().view(np.uint64).reshape(-1)
+            runs[d].append(got[np.argsort(keys, kind="stable")].copy())
+
+    # stage in rounds: buffer decoded batches up to one round's capacity
+    pending: List[np.ndarray] = []
+    pending_rows = 0
+    per_round = cap * n_dev
+    for k, p in _iter_committed_batches(managers, handle):
+        rows = _rows_to_u32(k, p)
+        while len(rows):
+            take = min(len(rows), per_round - pending_rows)
+            pending.append(rows[:take])
+            pending_rows += take
+            rows = rows[take:]
+            if pending_rows == per_round:
+                run_round(np.concatenate(pending))
+                pending, pending_rows = [], 0
+    if pending_rows:
+        run_round(np.concatenate(pending))
+
+    results = []
+    for d in range(n_dev):
+        if runs[d]:
+            _, merged = merge_runs([(r[:, :2].copy().view(np.uint64)
+                                     .reshape(-1), r) for r in runs[d]])
+        else:
+            merged = np.zeros((0, pw), np.uint32)
+        keys, payload = _u32_to_rows(merged, handle.row_payload_bytes)
+        parts = np.asarray(partitioner(keys), dtype=np.int64)
+        results.append((keys, payload, parts))
     return results

@@ -134,10 +134,14 @@ class RemoteExecutor:
                           "task_id": task_id})
 
     def invalidate_shuffle(self, shuffle_id: int) -> None:
-        self._run({"kind": "invalidate", "shuffle_id": shuffle_id})
+        # admin ops are cheap: a wedged executor must stall recovery and
+        # cleanup by a connect budget, not the 10-minute task budget
+        self._run({"kind": "invalidate", "shuffle_id": shuffle_id},
+                  timeout=self.conf.connect_timeout_ms / 1000)
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
-        self._run({"kind": "unregister", "shuffle_id": shuffle_id})
+        self._run({"kind": "unregister", "shuffle_id": shuffle_id},
+                  timeout=self.conf.connect_timeout_ms / 1000)
 
     def stop(self) -> None:
         if self._own_clients:
@@ -145,9 +149,10 @@ class RemoteExecutor:
 
     # -- plumbing --------------------------------------------------------
 
-    def _run(self, desc: dict):
+    def _run(self, desc: dict, timeout: Optional[float] = None):
         import time
 
+        timeout = timeout or self.conf.task_timeout_ms / 1000
         payload = _cloudpickle().dumps(desc)
         # A worker hellos the driver DURING manager construction, before
         # its process gets to install_task_server — so a freshly-announced
@@ -160,7 +165,7 @@ class RemoteExecutor:
                                          self.manager_id.rpc_port)
                 resp = conn.request(
                     M.RunTaskReq(conn.next_req_id(), payload),
-                    timeout=self.conf.task_timeout_ms / 1000)
+                    timeout=timeout)
             except TransportError as e:
                 self.alive = False
                 raise ExecutorLostError(
