@@ -131,6 +131,10 @@ class DAGEngine:
         self.driver = driver
         self.executors = list(executors)
         self.max_stage_retries = max_stage_retries
+        # driver-side spans for stages/tasks (the scheduling-layer view the
+        # reference gets from Spark's event log; chrome-trace via
+        # conf trace_file, utils/trace.py)
+        self.tracer = driver.native.tracer
         self._handles: Dict[int, object] = {}      # stage_id -> ShuffleHandle
         self._stages: Dict[int, MapStage] = {}     # stage_id -> stage
         self._owners: Dict[int, Dict[int, int]] = {}  # stage_id -> map->slot
@@ -146,7 +150,11 @@ class DAGEngine:
                 registered.append(stage)  # before running: a mid-stage
                 # failure must still unregister the freshly-made shuffle
                 self._run_map_stage(stage)
-            return [self._run_task(final, t) for t in range(final.num_tasks)]
+            with self.tracer.span("engine.stage", "engine",
+                                  stage=final.stage_id,
+                                  tasks=final.num_tasks):
+                return [self._run_task(final, t)
+                        for t in range(final.num_tasks)]
         finally:
             for stage in registered:
                 handle = self._handles.pop(stage.stage_id, None)
@@ -235,8 +243,11 @@ class DAGEngine:
         self._handles[stage.stage_id] = handle
         self._stages[stage.stage_id] = stage
         self._owners[stage.stage_id] = {}
-        for t in range(stage.num_tasks):
-            self._run_task(stage, t)
+        with self.tracer.span("engine.stage", "engine",
+                              stage=stage.stage_id, shuffle=shuffle_id,
+                              tasks=stage.num_tasks):
+            for t in range(stage.num_tasks):
+                self._run_task(stage, t)
 
     def _run_task(self, stage, task_id: int,
                   mgr: Optional[SparkCompatShuffleManager] = None):
@@ -256,7 +267,10 @@ class DAGEngine:
                 self._pick_live(task_id, avoid=avoid)
             first = False
             try:
-                return self._attempt_task(stage, task_id, target)
+                with self.tracer.span("engine.task", "engine",
+                                      stage=stage.stage_id, task=task_id,
+                                      remote=self._is_remote(target)):
+                    return self._attempt_task(stage, task_id, target)
             except FetchFailedError as e:
                 n = attempts_by_shuffle.get(e.shuffle_id, 0) + 1
                 attempts_by_shuffle[e.shuffle_id] = n

@@ -17,7 +17,6 @@ executors).
 
 from __future__ import annotations
 
-import functools
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -70,13 +69,11 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     key-sorted within the device when ``sort_by_key``.
     """
     import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
+    from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
 
     n_dev = mesh.shape[axis_name]
-    impl = resolve_impl(mesh, impl)
     partitioner = handle.partitioner.build(handle.num_partitions)
 
     # 1. stage: stream every local spill sequentially (no host scatter),
@@ -103,28 +100,19 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     dest_p[:len(rows)] = dest_part % n_dev  # partition owner device
 
     width = rows.shape[1]
-    spec = P(axis_name)
 
-    @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
-                       out_specs=(spec, spec, spec))
-    def reduce_step(data, dest):
-        output = jnp.zeros((data.shape[0] * out_factor, width), jnp.uint32)
-        received, recv_counts, _ = shuffle_shard(
-            data, dest, axis_name, n_dev, output=output, impl=impl)
-        total = recv_counts.sum()
-        overflowed = total > output.shape[0]
-        return received, recv_counts[None], overflowed[None]
-
-    sharding = NamedSharding(mesh, spec)
-    received, counts, overflowed = jax.block_until_ready(reduce_step(
+    # 2. the one shared jitted exchange (parallel/exchange.py)
+    exchange = make_shuffle_exchange(mesh, axis_name, impl=impl,
+                                     out_factor=out_factor)
+    sharding = NamedSharding(mesh, P(axis_name))
+    received, counts, _ = jax.block_until_ready(exchange(
         jax.device_put(rows_p, sharding), jax.device_put(dest_p, sharding)))
-    if np.asarray(overflowed).any():
-        raise OverflowError("mesh reduce receive overflow")
 
     # 3. unpack per device (host-side view of the device results)
     received = np.asarray(received).reshape(n_dev, -1, width)
     counts = np.asarray(counts)
+    if (counts.sum(axis=1) > cap * out_factor).any():
+        raise OverflowError("mesh reduce receive overflow")
     results = []
     for d in range(n_dev):
         total = int(counts[d].sum())
@@ -168,29 +156,19 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
     with ``sort_by_key=True``.
     """
     import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
+    from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
     from sparkrdma_tpu.shuffle.external import merge_runs
 
     n_dev = mesh.shape[axis_name]
-    impl = resolve_impl(mesh, impl)
     partitioner = handle.partitioner.build(handle.num_partitions)
     pw = 2 + (handle.row_payload_bytes + 3) // 4
     cap = rows_per_round
-    spec = P(axis_name)
-    sharding = NamedSharding(mesh, spec)
-
-    @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
-                       out_specs=(spec, spec, spec))
-    def reduce_step(data, dest):
-        output = jnp.zeros((data.shape[0] * out_factor, pw), jnp.uint32)
-        received, recv_counts, _ = shuffle_shard(
-            data, dest, axis_name, n_dev, output=output, impl=impl)
-        return received, recv_counts[None], (recv_counts.sum()
-                                             > output.shape[0])[None]
+    sharding = NamedSharding(mesh, P(axis_name))
+    # the one shared jitted exchange, compiled once for the round shape
+    exchange = make_shuffle_exchange(mesh, axis_name, impl=impl,
+                                     out_factor=out_factor)
 
     runs: List[list] = [[] for _ in range(n_dev)]
 
@@ -203,14 +181,14 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
         rows_p[:len(rows_np)] = rows_np
         dest_p = np.full(total_cap, -1, np.int32)
         dest_p[:len(rows_np)] = dest
-        received, counts, overflowed = jax.block_until_ready(reduce_step(
+        received, counts, _ = jax.block_until_ready(exchange(
             jax.device_put(rows_p, sharding),
             jax.device_put(dest_p, sharding)))
-        if np.asarray(overflowed).any():
-            raise OverflowError("mesh reduce receive overflow; raise "
-                                "out_factor or shrink rows_per_round")
         received = np.asarray(received).reshape(n_dev, -1, pw)
         counts = np.asarray(counts)
+        if (counts.sum(axis=1) > cap * out_factor).any():
+            raise OverflowError("mesh reduce receive overflow; raise "
+                                "out_factor or shrink rows_per_round")
         for d in range(n_dev):
             got = received[d][:int(counts[d].sum())]
             keys = got[:, :2].copy().view(np.uint64).reshape(-1)

@@ -178,7 +178,7 @@ class RemoteExecutor:
                 # leaking its shuffle data)
                 raise ExecutorLostError(
                     f"task on {self.manager_id.executor_id.executor} "
-                    f"exceeded task_timeout_ms: {e}") from e
+                    f"exceeded its {timeout:.0f}s wait budget: {e}") from e
             assert isinstance(resp, M.RunTaskResp)
             if resp.status != M.TASK_NO_RUNNER:
                 break

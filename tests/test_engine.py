@@ -188,3 +188,38 @@ def test_mid_job_executor_loss_recovers(cluster, caplog):
     assert got == want
     # the engine's recovery path must actually have fired
     assert any("recovering shuffle" in r.message for r in caplog.records)
+
+
+def test_engine_emits_trace_spans(tmp_path):
+    """Stage/task spans land in the driver's chrome trace."""
+    import json
+
+    conf = TpuShuffleConf(connect_timeout_ms=1000, max_connection_attempts=2,
+                          trace_file=str(tmp_path / "trace"))
+    driver = SparkCompatShuffleManager(conf, isDriver=True)
+    execs = [SparkCompatShuffleManager(
+        conf, driverAddr=driver.driverAddr, executorId=str(i),
+        spill_dir=str(tmp_path / f"e{i}")) for i in range(2)]
+    try:
+        for ex in execs:
+            ex.native.executor.wait_for_members(2)
+
+        def map_fn(ctx, writer, t):
+            writer.write((np.arange(10, dtype=np.uint64),
+                          np.zeros((10, 4), np.uint8)))
+
+        def red_fn(ctx, t):
+            return sum(len(k) for k, _ in ctx.read(0).readBatches())
+
+        stage = MapStage(2, ShuffleDependency(
+            2, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn)
+        total = sum(DAGEngine(driver, execs).run(
+            ResultStage(2, red_fn, parents=[stage])))
+        assert total == 20
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
+    trace = json.loads((tmp_path / "trace.driver.json").read_text())
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"engine.stage", "engine.task"} <= names, names
