@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -127,14 +128,28 @@ class DAGEngine:
 
     def __init__(self, driver: SparkCompatShuffleManager,
                  executors: Sequence[SparkCompatShuffleManager],
-                 max_stage_retries: int = 2):
+                 max_stage_retries: int = 2,
+                 max_parallel_tasks: Optional[int] = None):
         self.driver = driver
         self.executors = list(executors)
         self.max_stage_retries = max_stage_retries
+        # Tasks within a stage dispatch concurrently up to this bound
+        # (Spark's running-tasks-per-stage model; remote executors run
+        # them in their task_threads slots). Default 1 = sequential, the
+        # original contract — task_fns written against it may touch
+        # shared driver-side state non-atomically, so parallelism is
+        # opt-in (len(executors) is the natural setting).
+        self.max_parallel_tasks = (1 if max_parallel_tasks is None
+                                   else max(1, max_parallel_tasks))
         # driver-side spans for stages/tasks (the scheduling-layer view the
         # reference gets from Spark's event log; chrome-trace via
         # conf trace_file, utils/trace.py)
         self.tracer = driver.native.tracer
+        # recoveries serialize: concurrent tasks tripping over the same
+        # dead executor must repair a shuffle once, not once per task.
+        # RLock: a recompute task's own FetchFailed recovers recursively.
+        self._recover_lock = threading.RLock()
+        self._recovered: set = set()  # (shuffle_id, dead_slot)
         self._handles: Dict[int, object] = {}      # stage_id -> ShuffleHandle
         self._stages: Dict[int, MapStage] = {}     # stage_id -> stage
         self._owners: Dict[int, Dict[int, int]] = {}  # stage_id -> map->slot
@@ -153,14 +168,15 @@ class DAGEngine:
             with self.tracer.span("engine.stage", "engine",
                                   stage=final.stage_id,
                                   tasks=final.num_tasks):
-                return [self._run_task(final, t)
-                        for t in range(final.num_tasks)]
+                return self._run_stage_tasks(final)
         finally:
             for stage in registered:
                 handle = self._handles.pop(stage.stage_id, None)
                 self._stages.pop(stage.stage_id, None)
                 self._owners.pop(stage.stage_id, None)
                 if handle is not None:
+                    self._recovered = {k for k in self._recovered
+                                       if k[0] != handle.shuffle_id}
                     self.driver.unregisterShuffle(handle.shuffle_id)
                     # executor-side too: drops the resolver's spill data and
                     # the memoized driver table, not just the driver entry —
@@ -246,8 +262,31 @@ class DAGEngine:
         with self.tracer.span("engine.stage", "engine",
                               stage=stage.stage_id, shuffle=shuffle_id,
                               tasks=stage.num_tasks):
-            for t in range(stage.num_tasks):
-                self._run_task(stage, t)
+            self._run_stage_tasks(stage)
+
+    def _run_stage_tasks(self, stage) -> List[object]:
+        """All of a stage's tasks, up to max_parallel_tasks in flight
+        (ordered results)."""
+        if self.max_parallel_tasks <= 1 or stage.num_tasks <= 1:
+            return [self._run_task(stage, t) for t in range(stage.num_tasks)]
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(
+            max_workers=min(self.max_parallel_tasks, stage.num_tasks),
+            thread_name_prefix=f"stage-{stage.stage_id}")
+        try:
+            futures = [pool.submit(self._run_task, stage, t)
+                       for t in range(stage.num_tasks)]
+            return [f.result() for f in futures]
+        except BaseException:
+            # first failure aborts the stage: drop queued siblings now
+            # instead of letting each burn its full retry budget
+            # (already-running attempts finish their bounded retries in
+            # the background; they can no longer affect the result)
+            pool.shutdown(wait=False, cancel_futures=True)
+            raise
+        finally:
+            pool.shutdown(wait=False)
 
     def _run_task(self, stage, task_id: int,
                   mgr: Optional[SparkCompatShuffleManager] = None):
@@ -329,12 +368,35 @@ class DAGEngine:
 
     def _recover_shuffle(self, failure: FetchFailedError) -> None:
         """Recompute every map of the failed shuffle owned by the dead slot
-        on surviving executors; positional republish repairs the table."""
-        stage = next((s for s in self._stages.values()
-                      if self._handles[s.stage_id].shuffle_id
-                      == failure.shuffle_id), None)
-        if stage is None:
-            raise failure  # not one of ours (already unregistered?)
+        on surviving executors; positional republish repairs the table.
+        Serialized: with parallel tasks, N readers tripping over one dead
+        executor trigger ONE repair (later arrivals see it recorded and
+        just retry)."""
+        with self._recover_lock:
+            key = (failure.shuffle_id, failure.exec_index)
+            stage = next((s for s in self._stages.values()
+                          if self._handles[s.stage_id].shuffle_id
+                          == failure.shuffle_id), None)
+            if stage is None:
+                raise failure  # not one of ours (already unregistered?)
+            owners = self._owners[stage.stage_id].values()
+            # Skip only when this exact loss was repaired AND the repair
+            # stuck (no map still owned by the dead/unknown slot). A
+            # memo hit must never suppress a recovery the table still
+            # needs — e.g. unpublished-map failures (exec_index -1) can
+            # name different maps each time, so they always re-run.
+            if (failure.exec_index >= 0 and key in self._recovered
+                    and not any(slot == failure.exec_index or slot < 0
+                                for slot in owners)):
+                return
+            self._recover_shuffle_locked(failure)
+            if failure.exec_index >= 0:
+                self._recovered.add(key)
+
+    def _recover_shuffle_locked(self, failure: FetchFailedError) -> None:
+        stage = next(s for s in self._stages.values()
+                     if self._handles[s.stage_id].shuffle_id
+                     == failure.shuffle_id)
         owners = self._owners[stage.stage_id]
         dead = failure.exec_index
         # slot < 0 = owner was tombstoned before its slot resolved: its

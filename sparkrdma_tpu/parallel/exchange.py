@@ -322,9 +322,12 @@ def chunked_exchange(mesh: Mesh, axis_name: str, grouped: np.ndarray,
     return received, num_rounds
 
 
+@functools.lru_cache(maxsize=64)
 def make_shuffle_exchange(mesh: Mesh, axis_name: str, impl: str = "auto",
                           out_factor: int = 1):
-    """Build a jitted all-device shuffle-exchange over ``mesh``.
+    """Build a jitted all-device shuffle-exchange over ``mesh``. Memoized
+    per (mesh, axis, impl, out_factor) like ``make_chunked_exchange`` so
+    per-job callers (mesh_service) compile once.
 
     The returned callable takes globally-sharded arrays
     ``(data[D*capacity, ...], dest[D*capacity])`` (sharded on the leading

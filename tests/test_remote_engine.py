@@ -154,3 +154,30 @@ def test_remote_executor_loss_recovers(cluster, tmp_path, caplog):
         want += int(rng.integers(0, 1000, 600).astype(np.int64).sum())
     assert got == want
     assert any("recovering shuffle" in r.message for r in caplog.records)
+
+
+def test_parallel_task_dispatch(cluster):
+    """Tasks within a stage run concurrently across executor processes
+    (and their task slots): 4 sleeping result tasks over 2 workers finish
+    in ~1 sleep, not 4."""
+    driver, remotes, _ = cluster
+    job, want = _job(P=4, maps=2, rows=50, seed=90)
+
+    def slow_reduce(ctx, task_id):
+        t0 = time.monotonic()
+        time.sleep(0.5)
+        total = 0
+        for keys, payload in ctx.read(0).readBatches():
+            vals = np.ascontiguousarray(payload).view("<u4")
+            total += int(vals.astype(np.int64).sum())
+        return total, t0
+
+    stage = job.parents[0]
+    results = DAGEngine(driver, remotes, max_parallel_tasks=4).run(
+        ResultStage(4, slow_reduce, parents=[stage]))
+    assert sum(r[0] for r in results) == want
+    # overlap, not wall time (load-tolerant): some pair of the 0.5s sleep
+    # windows [t0, t0+0.5) must intersect — impossible if serialized
+    starts = sorted(r[1] for r in results)
+    gaps = [b - a for a, b in zip(starts, starts[1:])]
+    assert min(gaps) < 0.5, f"tasks were serialized (gaps {gaps})"
