@@ -129,18 +129,34 @@ class DAGEngine:
     def __init__(self, driver: SparkCompatShuffleManager,
                  executors: Sequence[SparkCompatShuffleManager],
                  max_stage_retries: int = 2,
-                 max_parallel_tasks: Optional[int] = None):
+                 max_parallel_tasks: Optional[int] = None,
+                 speculation: bool = False,
+                 speculation_multiplier: float = 1.5):
         self.driver = driver
         self.executors = list(executors)
         self.max_stage_retries = max_stage_retries
+        # Speculative execution (Spark's spark.speculation): once half a
+        # stage's tasks have finished, a task running longer than
+        # multiplier x their median gets a backup attempt on a different
+        # executor; first completion wins. Safe because map publishes are
+        # idempotent positional writes and tasks are deterministic — the
+        # same properties stage retry already relies on. Requires
+        # max_parallel_tasks > 1 (a sequential stage has no one to race).
+        self.speculation = speculation
+        self.speculation_multiplier = speculation_multiplier
         # Tasks within a stage dispatch concurrently up to this bound
         # (Spark's running-tasks-per-stage model; remote executors run
         # them in their task_threads slots). Default 1 = sequential, the
         # original contract — task_fns written against it may touch
         # shared driver-side state non-atomically, so parallelism is
-        # opt-in (len(executors) is the natural setting).
-        self.max_parallel_tasks = (1 if max_parallel_tasks is None
-                                   else max(1, max_parallel_tasks))
+        # opt-in (len(executors) is the natural setting). Speculation
+        # needs concurrency to race a backup, so it implies it.
+        if max_parallel_tasks is None:
+            max_parallel_tasks = max(1, len(self.executors)) if speculation \
+                else 1
+        if speculation and max_parallel_tasks <= 1:
+            raise ValueError("speculation requires max_parallel_tasks > 1")
+        self.max_parallel_tasks = max(1, max_parallel_tasks)
         # driver-side spans for stages/tasks (the scheduling-layer view the
         # reference gets from Spark's event log; chrome-trace via
         # conf trace_file, utils/trace.py)
@@ -275,6 +291,8 @@ class DAGEngine:
             max_workers=min(self.max_parallel_tasks, stage.num_tasks),
             thread_name_prefix=f"stage-{stage.stage_id}")
         try:
+            if self.speculation:
+                return self._collect_speculative(stage, pool)
             futures = [pool.submit(self._run_task, stage, t)
                        for t in range(stage.num_tasks)]
             return [f.result() for f in futures]
@@ -288,19 +306,93 @@ class DAGEngine:
         finally:
             pool.shutdown(wait=False)
 
+    def _collect_speculative(self, stage, pool) -> List[object]:
+        """Await a stage's tasks, racing backups against stragglers.
+
+        Straggle time is measured from when a task actually STARTS (a
+        task queued behind the parallelism bound is waiting, not slow —
+        Spark measures the same way). Backups go to a dedicated pool (a
+        straggler may be occupying a primary slot) and avoid the
+        primary's executor. The loser attempt's outcome is ignored — it
+        finishes (or exhausts its retries) in the background.
+        """
+        import statistics
+        import time as time_mod
+        from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+        from concurrent.futures import wait as fwait
+
+        n = stage.num_tasks
+        start: Dict[int, float] = {}  # stamped at launch, worker-side
+
+        def timed(t: int):
+            start[t] = time_mod.monotonic()
+            return self._run_task(stage, t)
+
+        meta = {pool.submit(timed, t): t for t in range(n)}
+        speculated: set = set()  # tasks that got their ONE backup
+        results: Dict[int, object] = {}
+        durations: List[float] = []
+        backup_pool = ThreadPoolExecutor(
+            max_workers=n, thread_name_prefix=f"spec-{stage.stage_id}")
+        try:
+            while len(results) < n:
+                done, _ = fwait(set(meta), timeout=0.05,
+                                return_when=FIRST_COMPLETED)
+                for f in done:
+                    t = meta.pop(f)
+                    if t in results:
+                        continue  # the other attempt already won
+                    try:
+                        results[t] = f.result()
+                        durations.append(time_mod.monotonic() - start[t])
+                    except Exception:
+                        # a sibling attempt may still win; only a task
+                        # with NO attempt left fails the stage
+                        if not any(mt == t for mt in meta.values()):
+                            raise
+                # enough evidence + a RUNNING straggler => ONE backup
+                if len(durations) >= max(1, n // 2):
+                    threshold = max(
+                        0.25, self.speculation_multiplier
+                        * statistics.median(durations))
+                    now = time_mod.monotonic()
+                    for t in range(n):
+                        if (t in results or t in speculated
+                                or t not in start
+                                or now - start[t] <= threshold):
+                            continue
+                        speculated.add(t)
+                        log.info("stage %d task %d: speculative copy "
+                                 "after %.2fs (median %.2fs)",
+                                 stage.stage_id, t, now - start[t],
+                                 statistics.median(durations))
+                        try:  # keep the backup off the primary's node
+                            avoid = self._pick_live(t)
+                        except RuntimeError:
+                            avoid = None
+                        b = backup_pool.submit(
+                            self._run_task, stage, t, avoid_first=avoid)
+                        meta[b] = t
+            return [results[t] for t in range(n)]
+        finally:
+            backup_pool.shutdown(wait=False, cancel_futures=True)
+
     def _run_task(self, stage, task_id: int,
-                  mgr: Optional[SparkCompatShuffleManager] = None):
+                  mgr: Optional[SparkCompatShuffleManager] = None,
+                  avoid_first=None):
         """One task with FetchFailed-driven stage retry.
 
         The budget counts repeated failures per shuffle: one executor loss
         damaging several parent shuffles costs the task one recovery per
         parent (each makes forward progress), not its whole budget.
+        ``avoid_first`` steers the initial pick away from an executor
+        (speculative copies race on a different node than the primary).
         """
         from sparkrdma_tpu.tasks import ExecutorLostError
 
         attempts_by_shuffle: Dict[int, int] = {}
         first = True
-        avoid = None
+        avoid = avoid_first
         while True:
             target = mgr if mgr is not None and first else \
                 self._pick_live(task_id, avoid=avoid)
