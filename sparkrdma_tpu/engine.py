@@ -437,7 +437,7 @@ class DAGEngine:
             if isinstance(stage, MapStage):
                 handle = self._handles[stage.stage_id]
                 target.run_map_task(stage.task_fn, handle, parent_handles,
-                                    task_id)
+                                    task_id, combiner=stage.dep.combiner)
                 self._owners[stage.stage_id][task_id] = self._slot_of(target)
                 return None
             return target.run_result_task(stage.task_fn, parent_handles,
@@ -445,7 +445,8 @@ class DAGEngine:
         ctx = TaskContext(self, target, stage, task_id)
         if isinstance(stage, MapStage):
             handle = self._handles[stage.stage_id]
-            writer = target.getWriter(handle, task_id)
+            writer = target.getWriter(handle, task_id,
+                                      combiner=stage.dep.combiner)
             try:
                 stage.task_fn(ctx, writer, task_id)
             except BaseException:

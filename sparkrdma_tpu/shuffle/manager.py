@@ -146,14 +146,17 @@ class TpuShuffleManager:
             self._handles[shuffle_id] = handle
         return handle
 
-    def get_writer(self, handle: ShuffleHandle, map_id: int) -> "_PublishingWriter":
-        """(scala/RdmaShuffleManager.scala:263-291)."""
+    def get_writer(self, handle: ShuffleHandle, map_id: int,
+                   combiner=None) -> "_PublishingWriter":
+        """(scala/RdmaShuffleManager.scala:263-291). ``combiner`` enables
+        map-side combine (writer.make_sum_combiner or a custom
+        ``(keys_sorted, payload_sorted) -> (keys', payload')``)."""
         if self.executor is None or self.resolver is None:
             raise RuntimeError("get_writer is an executor-role call")
         inner = TpuShuffleWriter(
             self.resolver, handle.shuffle_id, map_id, handle.num_partitions,
             handle.partitioner.build(handle.num_partitions),
-            handle.row_payload_bytes)
+            handle.row_payload_bytes, combiner=combiner)
         return _PublishingWriter(inner, self.executor, tracer=self.tracer)
 
     def get_reader(self, handle: ShuffleHandle, start_partition: int,

@@ -28,14 +28,19 @@ from sparkrdma_tpu.shuffle.manager import (
 
 class ShuffleDependency:
     """The slice of Spark's ShuffleDependency the reference consumes:
-    partition count + partitioner (scala/RdmaShuffleManager.scala:143-183)."""
+    partition count + partitioner (scala/RdmaShuffleManager.scala:143-183),
+    plus the aggregator (``combiner``) Spark carries on the dependency —
+    when set, every writer of this shuffle applies map-side combine
+    (the engine and shipped tasks pick it up automatically)."""
 
     def __init__(self, num_partitions: int,
                  partitioner: Optional[PartitionerSpec] = None,
-                 row_payload_bytes: int = 0):
+                 row_payload_bytes: int = 0,
+                 combiner=None):
         self.num_partitions = num_partitions
         self.partitioner = partitioner or PartitionerSpec("hash")
         self.row_payload_bytes = row_payload_bytes
+        self.combiner = combiner
 
 
 class SparkCompatShuffleManager:
@@ -58,8 +63,11 @@ class SparkCompatShuffleManager:
                                         dependency.row_payload_bytes)
 
     def getWriter(self, handle: ShuffleHandle, mapId: int,
-                  context=None) -> "CompatWriter":
-        return CompatWriter(self._m.get_writer(handle, mapId))
+                  context=None, combiner=None) -> "CompatWriter":
+        """``combiner`` is the map-side-combine hook (the aggregator half
+        Spark's write path applies before spilling)."""
+        return CompatWriter(self._m.get_writer(handle, mapId,
+                                               combiner=combiner))
 
     def getReader(self, handle: ShuffleHandle, startPartition: int,
                   endPartition: int, context=None) -> "CompatReader":

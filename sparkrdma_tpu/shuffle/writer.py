@@ -36,13 +36,22 @@ class TpuShuffleWriter:
 
     def __init__(self, resolver: TpuShuffleBlockResolver, shuffle_id: int,
                  map_id: int, num_partitions: int, partitioner: Partitioner,
-                 row_payload_bytes: int):
+                 row_payload_bytes: int,
+                 combiner: Optional[Callable] = None):
         self.resolver = resolver
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.num_partitions = num_partitions
         self.partitioner = partitioner
         self.row_payload_bytes = row_payload_bytes
+        # Map-side combine (the aggregator half of Spark's shuffle write,
+        # which the reference inherits by wrapping Spark's writers —
+        # writer/wrapper/RdmaWrapperShuffleWriter.scala:83-99):
+        # ``combiner(keys_sorted, payload_sorted) -> (keys', payload')``
+        # runs once at close over key-sorted rows, collapsing duplicate
+        # keys BEFORE bytes hit disk/the wire. Same key -> same partition,
+        # so combining globally before partitioning is exact.
+        self.combiner = combiner
         self._keys: List[np.ndarray] = []
         self._payloads: List[np.ndarray] = []
         self._closed = False
@@ -83,6 +92,17 @@ class TpuShuffleWriter:
                    else np.zeros((0, self.row_payload_bytes), dtype=np.uint8))
         self._keys, self._payloads = [], []
 
+        if self.combiner is not None and len(keys):
+            order = np.argsort(keys, kind="stable")
+            keys, payload = self.combiner(keys[order], payload[order])
+            keys = np.ascontiguousarray(keys, dtype=np.uint64)
+            payload = np.ascontiguousarray(payload, dtype=np.uint8)
+            if payload.shape != (len(keys), self.row_payload_bytes):
+                raise ValueError("combiner changed the row width")
+            # Spark's recordsWritten counts rows actually written to the
+            # shuffle file — post-combine
+            self.records_written = len(keys)
+
         dest = np.asarray(self.partitioner(keys), dtype=np.int64)
         if len(dest) != len(keys):
             raise ValueError("partitioner returned wrong-length array")
@@ -104,6 +124,26 @@ class TpuShuffleWriter:
                                         partition_lengths)
         self.bytes_written = int(partition_lengths.sum())
         return token, partition_lengths
+
+
+def make_sum_combiner(dtype: str = "<u4") -> Callable:
+    """Vectorized built-in combiner: payload viewed as ``dtype`` vectors,
+    summed per key (wrapping per dtype — matches on-device u32 aggregate
+    semantics, ops/aggregate.py). Usable as ``get_writer(combiner=...)``."""
+
+    def combine(keys: np.ndarray, payload: np.ndarray):
+        # keys arrive sorted (writer contract): group starts are O(n),
+        # no second sort
+        change = np.empty(len(keys), dtype=bool)
+        change[0] = True
+        np.not_equal(keys[1:], keys[:-1], out=change[1:])
+        starts = np.flatnonzero(change)
+        vals = np.ascontiguousarray(payload).view(dtype)
+        sums = np.add.reduceat(vals, starts, axis=0)
+        return keys[starts], np.ascontiguousarray(sums, dtype=dtype).view(
+            np.uint8).reshape(len(starts), -1)
+
+    return combine
 
 
 def decode_rows(data: bytes, row_payload_bytes: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -181,3 +181,33 @@ def test_parallel_task_dispatch(cluster):
     starts = sorted(r[1] for r in results)
     gaps = [b - a for a, b in zip(starts, starts[1:])]
     assert min(gaps) < 0.5, f"tasks were serialized (gaps {gaps})"
+
+
+def test_dependency_combiner_applies_in_shipped_tasks(cluster):
+    """A combiner on ShuffleDependency rides the cloudpickled task to the
+    worker: duplicate keys collapse before bytes hit the wire."""
+    from sparkrdma_tpu.shuffle.writer import make_sum_combiner
+
+    driver, remotes, _ = cluster
+
+    def map_fn(ctx, writer, t):
+        keys = np.full(1000, 7 + t, np.uint64)  # 1000 dups per map
+        vals = np.ones(1000, "<u4")
+        writer.write((keys, vals.view(np.uint8).reshape(1000, 4)))
+
+    def red_fn(ctx, t):
+        rows = 0
+        total = 0
+        for keys, payload in ctx.read(0).readBatches():
+            rows += len(keys)
+            total += int(np.ascontiguousarray(payload).view("<u4")
+                         .astype(np.int64).sum())
+        return rows, total
+
+    stage = MapStage(2, ShuffleDependency(
+        4, PartitionerSpec("modulo"), row_payload_bytes=4,
+        combiner=make_sum_combiner()), map_fn)
+    results = DAGEngine(driver, remotes).run(
+        ResultStage(4, red_fn, parents=[stage]))
+    assert sum(r[0] for r in results) == 2, "combine did not collapse rows"
+    assert sum(r[1] for r in results) == 2000
