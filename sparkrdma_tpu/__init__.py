@@ -31,7 +31,9 @@ Subpackages
 ``parallel``  mesh endpoints, control RPC, ragged exchange (L2/L3 equiv).
 ``ops``       TPU kernels: partitioning, sorting, ragged collectives (data plane).
 ``shuffle``   engine-facing Manager/Reader/Writer/Resolver (L5/L4 equiv).
-``models``    end-to-end workloads: TeraSort, PageRank, ALS, joins.
+``models``    end-to-end workloads: TeraSort, PageRank, ALS, joins, TPC-DS.
+``engine``    DAG/stage scheduler driving the drop-in SPI (DAGScheduler equiv).
+``tasks``     cloudpickle task shipping to executor processes (task scheduler equiv).
 """
 
 __version__ = "0.1.0"
@@ -48,4 +50,10 @@ def __getattr__(name):
     if name == "SparkCompatShuffleManager":
         from sparkrdma_tpu.shuffle.spark_compat import SparkCompatShuffleManager
         return SparkCompatShuffleManager
+    if name in ("DAGEngine", "MapStage", "ResultStage"):
+        from sparkrdma_tpu import engine
+        return getattr(engine, name)
+    if name == "ShuffleDependency":
+        from sparkrdma_tpu.shuffle.spark_compat import ShuffleDependency
+        return ShuffleDependency
     raise AttributeError(f"module 'sparkrdma_tpu' has no attribute {name!r}")

@@ -432,12 +432,17 @@ class DAGEngine:
         return live[task_id % len(live)]
 
     def _attempt_task(self, stage, task_id: int, target):
-        parent_handles = [self._handles[p.stage_id] for p in stage.parents]
+        from dataclasses import replace
+
+        # read-side handles don't need the combiner closure (it can
+        # capture large state); strip it so shipped descriptors stay small
+        parent_handles = [replace(self._handles[p.stage_id], combiner=None)
+                          for p in stage.parents]
         if self._is_remote(target):
             if isinstance(stage, MapStage):
                 handle = self._handles[stage.stage_id]
                 target.run_map_task(stage.task_fn, handle, parent_handles,
-                                    task_id, combiner=stage.dep.combiner)
+                                    task_id)  # combiner rides the handle
                 self._owners[stage.stage_id][task_id] = self._slot_of(target)
                 return None
             return target.run_result_task(stage.task_fn, parent_handles,
@@ -445,8 +450,7 @@ class DAGEngine:
         ctx = TaskContext(self, target, stage, task_id)
         if isinstance(stage, MapStage):
             handle = self._handles[stage.stage_id]
-            writer = target.getWriter(handle, task_id,
-                                      combiner=stage.dep.combiner)
+            writer = target.getWriter(handle, task_id)  # combiner on handle
             try:
                 stage.task_fn(ctx, writer, task_id)
             except BaseException:

@@ -26,7 +26,7 @@ import os
 import tempfile
 import threading
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -76,13 +76,18 @@ class PartitionerSpec:
 
 @dataclass(frozen=True)
 class ShuffleHandle:
-    """(scala/RdmaUtils.scala:145-159 analogue)."""
+    """(scala/RdmaUtils.scala:145-159 analogue). ``combiner`` is the
+    map-side aggregator registered with the shuffle (Spark carries it on
+    the handle's dependency): every writer of this shuffle applies it —
+    including stage-retry recomputes and shipped tasks, whose handles
+    travel by cloudpickle. None = no map-side combine."""
 
     shuffle_id: int
     num_maps: int
     num_partitions: int
     row_payload_bytes: int
     partitioner: PartitionerSpec
+    combiner: Optional[Callable] = None
 
 
 class TpuShuffleManager:
@@ -135,28 +140,32 @@ class TpuShuffleManager:
     def register_shuffle(self, shuffle_id: int, num_maps: int,
                          num_partitions: int,
                          partitioner: PartitionerSpec,
-                         row_payload_bytes: int = 0) -> ShuffleHandle:
+                         row_payload_bytes: int = 0,
+                         combiner=None) -> ShuffleHandle:
         """Driver-side (scala/RdmaShuffleManager.scala:143-183)."""
         if self.driver is None:
             raise RuntimeError("register_shuffle is a driver-role call")
         self.driver.register_shuffle(shuffle_id, num_maps)
         handle = ShuffleHandle(shuffle_id, num_maps, num_partitions,
-                               row_payload_bytes, partitioner)
+                               row_payload_bytes, partitioner, combiner)
         with self._lock:
             self._handles[shuffle_id] = handle
         return handle
 
     def get_writer(self, handle: ShuffleHandle, map_id: int,
                    combiner=None) -> "_PublishingWriter":
-        """(scala/RdmaShuffleManager.scala:263-291). ``combiner`` enables
-        map-side combine (writer.make_sum_combiner or a custom
+        """(scala/RdmaShuffleManager.scala:263-291). Map-side combine
+        comes from the handle's registered combiner (every writer of the
+        shuffle, on every path — recomputes included); the ``combiner``
+        kwarg overrides per-writer (writer.make_sum_combiner or a custom
         ``(keys_sorted, payload_sorted) -> (keys', payload')``)."""
         if self.executor is None or self.resolver is None:
             raise RuntimeError("get_writer is an executor-role call")
         inner = TpuShuffleWriter(
             self.resolver, handle.shuffle_id, map_id, handle.num_partitions,
             handle.partitioner.build(handle.num_partitions),
-            handle.row_payload_bytes, combiner=combiner)
+            handle.row_payload_bytes,
+            combiner=combiner if combiner is not None else handle.combiner)
         return _PublishingWriter(inner, self.executor, tracer=self.tracer)
 
     def get_reader(self, handle: ShuffleHandle, start_partition: int,

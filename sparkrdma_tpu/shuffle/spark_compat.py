@@ -60,7 +60,8 @@ class SparkCompatShuffleManager:
         return self._m.register_shuffle(shuffleId, numMaps,
                                         dependency.num_partitions,
                                         dependency.partitioner,
-                                        dependency.row_payload_bytes)
+                                        dependency.row_payload_bytes,
+                                        combiner=dependency.combiner)
 
     def getWriter(self, handle: ShuffleHandle, mapId: int,
                   context=None, combiner=None) -> "CompatWriter":

@@ -96,7 +96,14 @@ class TpuShuffleWriter:
             order = np.argsort(keys, kind="stable")
             keys, payload = self.combiner(keys[order], payload[order])
             keys = np.ascontiguousarray(keys, dtype=np.uint64)
-            payload = np.ascontiguousarray(payload, dtype=np.uint8)
+            payload = np.asarray(payload)
+            if payload.dtype != np.uint8:
+                # a silent value-cast would wrap non-byte outputs mod 256;
+                # combiners must reinterpret (.view(np.uint8)), not cast
+                raise ValueError(
+                    f"combiner must return uint8 payload bytes, got "
+                    f"{payload.dtype} (reinterpret with .view(np.uint8))")
+            payload = np.ascontiguousarray(payload)
             if payload.shape != (len(keys), self.row_payload_bytes):
                 raise ValueError("combiner changed the row width")
             # Spark's recordsWritten counts rows actually written to the
@@ -132,6 +139,8 @@ def make_sum_combiner(dtype: str = "<u4") -> Callable:
     semantics, ops/aggregate.py). Usable as ``get_writer(combiner=...)``."""
 
     def combine(keys: np.ndarray, payload: np.ndarray):
+        if not len(keys):
+            return keys, payload
         # keys arrive sorted (writer contract): group starts are O(n),
         # no second sort
         change = np.empty(len(keys), dtype=bool)

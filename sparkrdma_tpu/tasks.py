@@ -75,8 +75,8 @@ def install_task_server(compat_mgr) -> None:
             if kind == "map":
                 ctx = _RemoteTaskContext(compat_mgr, desc["parents"],
                                          desc["task_id"])
-                writer = compat_mgr.getWriter(desc["handle"], desc["task_id"],
-                                              combiner=desc.get("combiner"))
+                writer = compat_mgr.getWriter(desc["handle"],
+                                              desc["task_id"])
                 try:
                     desc["fn"](ctx, writer, desc["task_id"])
                 except BaseException:
@@ -125,11 +125,9 @@ class RemoteExecutor:
 
     # -- engine-facing ---------------------------------------------------
 
-    def run_map_task(self, fn, handle, parent_handles, task_id: int,
-                     combiner=None) -> None:
+    def run_map_task(self, fn, handle, parent_handles, task_id: int) -> None:
         self._run({"kind": "map", "fn": fn, "handle": handle,
-                   "parents": list(parent_handles), "task_id": task_id,
-                   "combiner": combiner})
+                   "parents": list(parent_handles), "task_id": task_id})
 
     def run_result_task(self, fn, parent_handles, task_id: int):
         return self._run({"kind": "result", "fn": fn,
