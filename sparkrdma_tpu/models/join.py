@@ -53,7 +53,7 @@ def make_join_step(mesh: Mesh, axis_name: str, cfg: JoinConfig,
     aggregate the per-device partials host-side.
     """
     n = mesh.shape[axis_name]
-    impl = resolve_impl(mesh, impl)
+    impl = resolve_impl(mesh, impl, axis_name)
     spec = P(axis_name)
     PAD = jnp.uint32(0xFFFFFFFF)
 

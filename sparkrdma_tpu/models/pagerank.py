@@ -57,7 +57,7 @@ def make_pagerank_step(mesh: Mesh, axis_name: str, cfg: PageRankConfig,
     ``out_factor``), mirroring the TeraSort/join steps.
     """
     n = mesh.shape[axis_name]
-    impl = resolve_impl(mesh, impl)
+    impl = resolve_impl(mesh, impl, axis_name)
     v_local = cfg.num_vertices // n
     spec = P(axis_name)
 

@@ -72,7 +72,7 @@ def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
     and must not be trusted — raise ``out_factor`` or chunk the round).
     """
     n = mesh.shape[axis_name]
-    impl = resolve_impl(mesh, impl)
+    impl = resolve_impl(mesh, impl, axis_name)
     if cfg.sort_mode not in ("gather", "multisort"):
         # a typo must not silently measure (and mislabel) the gather path
         raise ValueError(f"unknown sort_mode {cfg.sort_mode!r} "

@@ -103,7 +103,7 @@ def make_tpcds_step(mesh: Mesh, axis_name: str, cfg: TpcdsConfig,
     devices is the full GROUP BY result.
     """
     n = mesh.shape[axis_name]
-    impl = resolve_impl(mesh, impl)
+    impl = resolve_impl(mesh, impl, axis_name)
     spec = P(axis_name)
     G = cfg.num_groups
     pad = jnp.uint32(PAD)

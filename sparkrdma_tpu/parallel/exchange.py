@@ -172,13 +172,63 @@ def shuffle_shard(data: jnp.ndarray, dest: jnp.ndarray, axis_name: str,
     return ragged_exchange_shard(grouped, counts, axis_name, output, impl)
 
 
-def resolve_impl(mesh: Mesh, impl: str = "auto") -> str:
-    """``auto`` -> native on TPU meshes, decomposed fallback elsewhere
-    (XLA:CPU has no ragged-all-to-all opcode)."""
+@functools.lru_cache(maxsize=32)
+def _native_compiles(mesh: Mesh, axis_name: str) -> Tuple[bool, str]:
+    """(supported, reason): whether THIS mesh's TPU compiler accepts
+    ragged-all-to-all over ``axis_name``.
+
+    Not every topology does: v5e slices above 16 chips have limited ICI
+    routing and the opcode is rejected at compile time ("Ragged
+    all-to-all is currently not supported in limited ICI routing
+    settings" — found via AOT compile, tests/test_tpu_aot.py). One tiny
+    throwaway compile per (mesh, axis), cached; the actual compiler
+    error is preserved so a transient/unexpected failure is never
+    misreported as a topology limit.
+    """
+    n = mesh.shape[axis_name]
+    spec = P(axis_name)
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 4,
+                       out_specs=spec)
+    def probe(op, out, iof, sz):
+        return lax.ragged_all_to_all(op[0], out[0], iof[0], sz[0], iof[0],
+                                     sz[0], axis_name=axis_name)[None]
+
+    sh = jax.sharding.NamedSharding(mesh, spec)
+    arg = jax.ShapeDtypeStruct((n, n * 8), jnp.int32, sharding=sh)
+    idx = jax.ShapeDtypeStruct((n, n), jnp.int32, sharding=sh)
+    try:
+        probe.lower(arg, arg, idx, idx).compile()
+        return True, ""
+    except Exception as e:  # noqa: BLE001 — any rejection means no
+        return False, f"{type(e).__name__}: {e}"
+
+
+def resolve_impl(mesh: Mesh, impl: str = "auto",
+                 axis_name: Optional[str] = None) -> str:
+    """``auto`` -> native on TPU meshes whose compiler supports the
+    ragged-all-to-all opcode over the exchange axis, decomposed fallback
+    elsewhere (XLA:CPU has no opcode at all; large v5e slices reject it
+    for limited ICI routing — there the gather decomposition keeps
+    results correct, and ``make_chunked_exchange(impl="ring")`` is the
+    bandwidth-efficient alternative). ``axis_name`` defaults to the last
+    mesh axis (the convention everywhere in this package)."""
     if impl != "auto":
         return impl
     platform = next(iter(mesh.devices.flat)).platform
-    return "native" if platform == "tpu" else "gather"
+    if platform != "tpu":
+        return "gather"
+    ok, reason = _native_compiles(mesh, axis_name or mesh.axis_names[-1])
+    if ok:
+        return "native"
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "this TPU topology rejects ragged-all-to-all; falling back to "
+        "the gather decomposition (consider the chunked ring transport "
+        "at this scale). Compiler said: %s", reason[:300])
+    return "gather"
 
 
 @functools.lru_cache(maxsize=128)
@@ -202,7 +252,8 @@ def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
     ``group_by_destination``).
     """
     n = mesh.shape[axis_name]
-    impl_resolved = impl if impl in ("ring", "ring_interpret") else resolve_impl(mesh, impl)
+    impl_resolved = (impl if impl in ("ring", "ring_interpret")
+                     else resolve_impl(mesh, impl, axis_name))
     spec = P(axis_name)
 
     # pallas interpret-mode outputs confuse the vma checker when mixed
@@ -342,7 +393,7 @@ def make_shuffle_exchange(mesh: Mesh, axis_name: str, impl: str = "auto",
     """
     spec = P(axis_name)
     n = mesh.shape[axis_name]
-    impl = resolve_impl(mesh, impl)
+    impl = resolve_impl(mesh, impl, axis_name)
 
     @jax.jit
     @functools.partial(

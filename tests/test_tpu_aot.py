@@ -155,6 +155,34 @@ def test_tpcds_step_compiles_for_tpu(tpu_mesh):
     assert text.count("ragged_all_to_all") >= 5
 
 
+def test_scale_up_topologies_resolve_and_compile():
+    """The v5e compiler accepts ragged-all-to-all only up to 16 chips
+    (32+ have limited ICI routing and reject the opcode — discovered by
+    this AOT suite). resolve_impl probe-compiles per mesh, so the
+    flagship step must pick native at 16 chips and degrade to the
+    decomposed exchange at 64 — compiling at BOTH scales."""
+    from jax.experimental import topologies
+
+    from sparkrdma_tpu.models.terasort import TeraSortConfig, make_terasort_step
+    from sparkrdma_tpu.parallel.exchange import resolve_impl
+
+    cfg = TeraSortConfig(rows_per_device=256, payload_words=24, out_factor=2)
+    for name, n, native_ok in (("v5e:4x4", 16, True), ("v5e:8x8", 64, False)):
+        try:
+            topo = topologies.get_topology_desc(name)
+        except Exception as e:  # noqa: BLE001
+            pytest.skip(f"{name} AOT topology unavailable: {str(e)[:100]}")
+        mesh = Mesh(np.array(topo.devices).reshape(n), (AXIS,))
+        impl = resolve_impl(mesh)
+        assert impl == ("native" if native_ok else "gather"), (name, impl)
+        step = make_terasort_step(mesh, AXIS, cfg)
+        rows = jax.ShapeDtypeStruct((n * cfg.rows_per_device, 25),
+                                    jnp.uint32,
+                                    sharding=NamedSharding(mesh, P(AXIS)))
+        text, _ = _lower_compile(step, rows)
+        assert ("ragged_all_to_all" in text) == native_ok, name
+
+
 def test_native_parity_where_backend_executes():
     """Bit-identity of impl='native' vs the gather oracle, on any running
     backend that honors the opcode (today: real multi-chip TPU; XLA:CPU
