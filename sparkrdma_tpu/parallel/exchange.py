@@ -24,11 +24,21 @@ Everything is static-shape: ``data`` and ``output`` are fixed-capacity
 buffers; raggedness lives in the offset/size vectors, which is what keeps
 XLA happy (no dynamic shapes under jit).
 
-Three transports (``impl``):
+Four transports (``impl``):
 
-* ``"native"`` — ``lax.ragged_all_to_all`` (TPU; switch-routed ICI).
-* ``"gather"`` — decomposed ``all_gather`` + mask-compaction for backends
-  whose XLA lacks the ragged-all-to-all opcode (XLA:CPU validation meshes).
+* ``"native"`` — ``lax.ragged_all_to_all`` (TPU; switch-routed ICI; the
+  v5e compiler accepts it only up to 16 chips — larger slices have
+  limited ICI routing and reject the opcode, so ``resolve_impl``
+  probe-compiles per mesh).
+* ``"dense"`` — ``lax.all_to_all`` over fixed per-pair slots (supported
+  at every scale): each (source, dest) pair gets ``out_capacity / D``
+  slot rows; skew past a slot raises the callers' overflow flag exactly
+  like a capacity overflow. Bandwidth = the padded capacity, i.e. an
+  ``out_factor``-bounded overhead instead of gather's D× — the auto
+  fallback where native is rejected.
+* ``"gather"`` — decomposed ``all_gather`` + mask-compaction, D×
+  bandwidth; the last-resort oracle (XLA:CPU validation meshes use it as
+  the reference semantics).
 * ``"ring"`` / ``"ring_interpret"`` — the hand-scheduled Pallas ring kernel
   (``ops.ring_exchange``): explicit chip-to-chip async remote DMAs, the
   closest structural analogue of the reference's one-sided verbs engine;
@@ -52,6 +62,43 @@ def _exclusive_cumsum(x: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
     return jnp.cumsum(x, axis=axis) - x
 
 
+def _slot_fill(data: jnp.ndarray, starts: jnp.ndarray, counts: jnp.ndarray,
+               n: int, q: int):
+    """Fill fixed per-destination slots: result ``[n*q, ...]`` where slot
+    (j, k) holds row ``starts[j] + k`` of ``data`` when ``k < counts[j]``
+    and zeros otherwise. Shared by the dense transport and the
+    chunked-ring round (their block shape IS this slot layout)."""
+    cap = data.shape[0]
+    slot = jnp.arange(n * q, dtype=jnp.int32)
+    dest_of_slot = jnp.minimum(slot // q, n - 1)
+    within = slot - dest_of_slot * q
+    src_idx = starts[dest_of_slot] + within
+    valid = within < counts[dest_of_slot]
+    picked = jnp.take(
+        data, jnp.where(valid, jnp.minimum(src_idx, cap - 1), 0), axis=0)
+    vmask = valid.reshape((-1,) + (1,) * (data.ndim - 1))
+    return jnp.where(vmask, picked, 0), valid, dest_of_slot, within
+
+
+def _pack_by_source(blocks: jnp.ndarray, recv_counts: jnp.ndarray,
+                    base: jnp.ndarray) -> jnp.ndarray:
+    """Compact per-source slot blocks ``[n, q, ...]`` into ``base``-shaped
+    packed rows grouped by source (``recv_counts[j] <= q`` rows from
+    source j, in slot order); ``base`` supplies rows past the total."""
+    n, q = blocks.shape[0], blocks.shape[1]
+    out_len = base.shape[0]
+    off = _exclusive_cumsum(recv_counts)
+    cum = jnp.cumsum(recv_counts)
+    pos = jnp.arange(out_len, dtype=jnp.int32)
+    src_of_pos = jnp.minimum(
+        jnp.sum(pos[:, None] >= cum[None, :], axis=1), n - 1)
+    flat_idx = src_of_pos * q + jnp.minimum(pos - off[src_of_pos], q - 1)
+    packed = jnp.take(blocks.reshape((n * q,) + blocks.shape[2:]),
+                      flat_idx, axis=0)
+    mask = (pos < cum[-1]).reshape((-1,) + (1,) * (base.ndim - 1))
+    return jnp.where(mask, packed, base)
+
+
 def ragged_exchange_shard(data: jnp.ndarray, send_counts: jnp.ndarray,
                           axis_name: str,
                           output: Optional[jnp.ndarray] = None,
@@ -67,11 +114,13 @@ def ragged_exchange_shard(data: jnp.ndarray, send_counts: jnp.ndarray,
       axis_name: mesh axis to exchange over.
       output: optional ``[out_capacity, ...]`` buffer to receive into
         (defaults to a zeroed buffer shaped like ``data``).
-      impl: ``"native"`` uses ``lax.ragged_all_to_all`` (TPU: rides ICI with
-        no padding overhead); ``"gather"`` is a decomposed equivalent built
-        from ``all_gather`` + mask-compaction, for backends whose XLA lacks
-        the ragged-all-to-all opcode (XLA:CPU — used by the virtual-device
-        test mesh and multi-host dry runs). Identical results.
+      impl: ``"native"`` uses ``lax.ragged_all_to_all`` (TPU: rides ICI
+        with no padding overhead); ``"dense"`` is fixed per-pair slots
+        over ``lax.all_to_all`` (every topology; padding bounded by
+        out_factor; pair skew past a slot trips the overflow flag);
+        ``"gather"`` is the ``all_gather`` + mask-compaction oracle
+        (D× bandwidth; XLA:CPU validation meshes). Identical results
+        whenever dense's slots fit.
 
     Returns:
       ``(received, recv_counts, recv_offsets)`` where ``received`` is packed
@@ -94,15 +143,55 @@ def ragged_exchange_shard(data: jnp.ndarray, send_counts: jnp.ndarray,
     if output is None:
         output = jnp.zeros_like(data)
     # 2. data exchange over ICI.
+    if impl == "dense" and output.shape[0] < mat.shape[0]:
+        # q = out_cap // D would be zero: no slot can carry even one row.
+        # gather handles any capacity; static shapes make this a
+        # trace-time branch
+        impl = "gather"
     if impl == "native":
         received = lax.ragged_all_to_all(
             data, output, input_offsets, send_sizes, output_offsets, recv_sizes,
             axis_name=axis_name)
+    elif impl == "dense":
+        received, recv_sizes = _dense_exchange(data, mat, my, output,
+                                               axis_name)
     elif impl == "gather":
         received = _gather_exchange(data, mat, my, output, axis_name)
     else:
         raise ValueError(f"unknown exchange impl {impl!r}")
     return received, recv_sizes, _exclusive_cumsum(recv_sizes)
+
+
+def _dense_exchange(data: jnp.ndarray, mat: jnp.ndarray, my: jnp.ndarray,
+                    output: jnp.ndarray, axis_name: str):
+    """Fixed-slot ``lax.all_to_all`` exchange: every (src, dst) pair owns
+    ``Q = out_capacity // D`` slot rows (any ``out_capacity % D``
+    remainder rows are unused headroom).
+
+    Exact (bit-identical to native/gather) whenever no pair exceeds its
+    slot; a pair overflow is surfaced by inflating the reported receive
+    counts past the output capacity, so every caller's existing
+    ``total > capacity`` overflow check fires (remedy is the same:
+    raise ``out_factor``, which grows Q). Unlike ragged-all-to-all this
+    lowers on every topology (plain all-to-all) and on XLA:CPU, so the
+    path is executable in CI.
+    """
+    n = mat.shape[0]
+    out_cap = output.shape[0]
+    q = out_cap // n
+    counts = mat[my]                      # what I send to each dest
+    send, _, _, _ = _slot_fill(data, _exclusive_cumsum(counts), counts, n, q)
+    got = lax.all_to_all(send.reshape((n, q) + data.shape[1:]), axis_name,
+                         split_axis=0, concat_axis=0)
+
+    recv_true = mat[:, my]
+    received = _pack_by_source(got, jnp.minimum(recv_true, q), output)
+    # pair overflow (anyone sent me more than a slot): poison the count
+    # total past out_cap so the callers' overflow flag fires
+    overflowed = (recv_true > q).any()
+    recv_report = recv_true.at[0].add(
+        jnp.where(overflowed, jnp.int32(out_cap + 1), 0))
+    return received, recv_report
 
 
 def _gather_exchange(data: jnp.ndarray, mat: jnp.ndarray, my: jnp.ndarray,
@@ -225,10 +314,11 @@ def resolve_impl(mesh: Mesh, impl: str = "auto",
     import logging
 
     logging.getLogger(__name__).warning(
-        "this TPU topology rejects ragged-all-to-all; falling back to "
-        "the gather decomposition (consider the chunked ring transport "
-        "at this scale). Compiler said: %s", reason[:300])
-    return "gather"
+        "this TPU topology rejects ragged-all-to-all; using the dense "
+        "fixed-slot all-to-all transport (out_factor-bounded padding "
+        "overhead; the chunked ring is the neighbor-traffic "
+        "alternative). Compiler said: %s", reason[:300])
+    return "dense"
 
 
 @functools.lru_cache(maxsize=128)
@@ -274,13 +364,9 @@ def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
         lo = jnp.minimum(round_idx * quota, counts)
         hi = jnp.minimum(lo + quota, counts)
         send_counts = hi - lo
-        slot = jnp.arange(n * quota, dtype=jnp.int32)
-        dest_of_slot = jnp.minimum(slot // quota, n - 1)
-        within = slot - dest_of_slot * quota
-        src_idx = seg_starts[dest_of_slot] + lo[dest_of_slot] + within
-        valid = within < send_counts[dest_of_slot]
-        src_idx = jnp.where(valid, src_idx, 0)
-        picked = jnp.take(grouped, src_idx, axis=0)
+        # per-destination slot layout, shared with the dense transport
+        filled, valid, dest_of_slot, within = _slot_fill(
+            grouped, seg_starts + lo, send_counts, n, quota)
         vmask = valid.reshape((-1,) + (1,) * (grouped.ndim - 1))
 
         if impl_resolved in ("ring", "ring_interpret"):
@@ -292,8 +378,7 @@ def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
             # to [*, 128] lanes (padded by <128 words when quota*row_words
             # isn't a lane multiple) and is unflattened on arrival.
             from sparkrdma_tpu.ops.ring_exchange import ring_all_to_all_shard
-            blocks = jnp.where(vmask, picked, 0).reshape(
-                (n, quota) + grouped.shape[1:])
+            blocks = filled.reshape((n, quota) + grouped.shape[1:])
             words = int(np.prod(blocks.shape[1:]))
             lanes = -(-words // 128) * 128
             flat = blocks.reshape(n, words)
@@ -306,18 +391,11 @@ def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
             mat = lax.all_gather(send_counts, axis_name, axis=0, tiled=False)
             my = lax.axis_index(axis_name)
             recv_counts = mat[:, my]
-            # compact [D, quota] -> packed grouped-by-source via one gather
-            recv_off = _exclusive_cumsum(recv_counts)
-            cum = jnp.cumsum(recv_counts)
-            pos = jnp.arange(n * quota, dtype=jnp.int32)
-            src_of_pos = jnp.sum(pos[:, None] >= cum[None, :], axis=1)
-            src_clamped = jnp.minimum(src_of_pos, n - 1)
-            within_pos = pos - recv_off[src_clamped]
-            flat_idx = src_clamped * quota + jnp.minimum(within_pos, quota - 1)
-            packed = jnp.take(got.reshape((n * quota,) + grouped.shape[1:]),
-                              flat_idx, axis=0)
-            pmask = (pos < cum[-1]).reshape((-1,) + (1,) * (grouped.ndim - 1))
-            received = jnp.where(pmask, packed, 0)
+            # compact [D, quota] -> packed grouped-by-source (recv_counts
+            # <= quota by construction)
+            received = _pack_by_source(
+                got, recv_counts,
+                jnp.zeros((n * quota,) + grouped.shape[1:], grouped.dtype))
             return received, recv_counts[None]
 
         # Collective transport: compact send buffer, destination-grouped.
@@ -329,7 +407,7 @@ def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
         # scatter picked rows to their compact position (invalid rows all
         # collide harmlessly on the last slot, then get overwritten only by
         # at most one valid row — counts guarantee compact positions unique)
-        send_buf = send_buf.at[compact_idx].set(jnp.where(vmask, picked, 0))
+        send_buf = send_buf.at[compact_idx].set(filled)
         received, recv_counts, _ = ragged_exchange_shard(
             send_buf, send_counts, axis_name, impl=impl_resolved)
         return received, recv_counts[None]

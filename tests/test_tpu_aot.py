@@ -159,8 +159,8 @@ def test_scale_up_topologies_resolve_and_compile():
     """The v5e compiler accepts ragged-all-to-all only up to 16 chips
     (32+ have limited ICI routing and reject the opcode — discovered by
     this AOT suite). resolve_impl probe-compiles per mesh, so the
-    flagship step must pick native at 16 chips and degrade to the
-    decomposed exchange at 64 — compiling at BOTH scales."""
+    flagship step must pick native at 16 chips and degrade to the dense
+    fixed-slot transport at 64 — compiling at BOTH scales."""
     from jax.experimental import topologies
 
     from sparkrdma_tpu.models.terasort import TeraSortConfig, make_terasort_step
@@ -173,14 +173,16 @@ def test_scale_up_topologies_resolve_and_compile():
         except Exception as e:  # noqa: BLE001
             pytest.skip(f"{name} AOT topology unavailable: {str(e)[:100]}")
         mesh = Mesh(np.array(topo.devices).reshape(n), (AXIS,))
-        impl = resolve_impl(mesh)
-        assert impl == ("native" if native_ok else "gather"), (name, impl)
+        impl = resolve_impl(mesh, axis_name=AXIS)
+        assert impl == ("native" if native_ok else "dense"), (name, impl)
         step = make_terasort_step(mesh, AXIS, cfg)
         rows = jax.ShapeDtypeStruct((n * cfg.rows_per_device, 25),
                                     jnp.uint32,
                                     sharding=NamedSharding(mesh, P(AXIS)))
         text, _ = _lower_compile(step, rows)
         assert ("ragged_all_to_all" in text) == native_ok, name
+        if not native_ok:  # the dense transport's all-to-all must survive
+            assert "all_to_all" in text, name
 
 
 def test_native_parity_where_backend_executes():
