@@ -96,8 +96,11 @@ def _demo() -> int:
     return 0
 
 
-def _engine_demo() -> int:
-    """Multi-stage TPC-DS star job through the DAG engine (drop-in SPI)."""
+def _engine_demo(use_mesh: bool = False) -> int:
+    """Multi-stage TPC-DS star job through the DAG engine (drop-in SPI).
+    With ``use_mesh``, reduce-side reads ride the ICI collective data
+    plane (engine mesh mode) instead of the TCP fetcher — verified by the
+    exchange dispatch counter."""
     import tempfile
 
     from sparkrdma_tpu.config import TpuShuffleConf
@@ -111,6 +114,17 @@ def _engine_demo() -> int:
     execs = [SparkCompatShuffleManager(
         conf, driverAddr=driver.driverAddr, executorId=str(i),
         spill_dir=tempfile.mkdtemp()) for i in range(2)]
+    mesh = None
+    exchanges = 0
+    if use_mesh:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from sparkrdma_tpu.parallel import exchange as exchange_mod
+
+        mesh = Mesh(np.array(jax.devices()), ("shuffle",))
+        exchanges = exchange_mod.DATA_PLANE["exchanges"]
     try:
         for e in execs:
             e.native.executor.wait_for_members(2)
@@ -118,12 +132,21 @@ def _engine_demo() -> int:
                           dim2_size=256, num_groups=64)
         job, finish = build_tpcds_job(cfg, num_maps=3, num_partitions=4,
                                       seed=1)
-        counts, sums = finish(DAGEngine(driver, execs).run(job))
+        engine = DAGEngine(driver, execs, mesh=mesh)
+        counts, sums = finish(engine.run(job))
         fact, d1, d2 = generate_star(cfg, 1, seed=1)
         want_c, want_s = numpy_tpcds(fact, d1, d2, cfg.num_groups)
         ok = (counts == want_c).all() and (sums == want_s).all()
-        print(json.dumps({"demo": "tpcds-engine", "joined_rows": int(counts.sum()),
-                          "groups": cfg.num_groups, "oracle_exact": bool(ok)}))
+        record = {"demo": "tpcds-engine", "joined_rows": int(counts.sum()),
+                  "groups": cfg.num_groups, "oracle_exact": bool(ok)}
+        if use_mesh:
+            from sparkrdma_tpu.parallel import exchange as exchange_mod
+
+            record["data_plane"] = "mesh"
+            record["collective_exchanges"] = (
+                exchange_mod.DATA_PLANE["exchanges"] - exchanges)
+            ok = ok and record["collective_exchanges"] > 0
+        print(json.dumps(record))
         return 0 if ok else 1
     finally:
         for e in execs:
@@ -135,7 +158,8 @@ def main() -> int:
     cmd = sys.argv[1] if len(sys.argv) > 1 else "info"
     handlers = {"info": _info, "config": _config,
                 "selftest": _selftest, "demo": _demo,
-                "engine-demo": _engine_demo}
+                "engine-demo": _engine_demo,
+                "engine-mesh-demo": lambda: _engine_demo(use_mesh=True)}
     if cmd not in handlers:
         print(f"usage: python -m sparkrdma_tpu {{{' | '.join(handlers)}}}")
         return 2

@@ -92,6 +92,17 @@ def _check_copartition(stage) -> None:
                 f"{p.dep.num_partitions} (task t reads partition t)")
 
 
+class _MeshCell:
+    """Once-cell for one shuffle's mesh-reduce results (per-shuffle lock:
+    independent shuffles reduce concurrently)."""
+
+    __slots__ = ("lock", "value")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.value: Optional[list] = None
+
+
 class TaskContext:
     """What a running task sees: readers over its parents' shuffles."""
 
@@ -104,9 +115,17 @@ class TaskContext:
 
     def read(self, parent_index: int = 0) -> CompatReader:
         """Reader over partition ``task_id`` of the parent's shuffle —
-        the getReader(handle, t, t+1) call Spark issues per reduce task."""
+        the getReader(handle, t, t+1) call Spark issues per reduce task.
+
+        With a mesh configured, the reader serves from the ICI collective
+        data plane (one mesh reduce per parent shuffle, partitions split
+        out); otherwise it drains the TCP fetcher. Same records either
+        way — the reference's property that getReader IS the fast path
+        (scala/RdmaShuffleManager.scala:234-261)."""
         parent = self._stage.parents[parent_index]
         handle = self._engine._handles[parent.stage_id]
+        if self._engine.mesh is not None:
+            return self._engine._mesh_read(handle, self.task_id)
         return self.manager.getReader(handle, self.task_id, self.task_id + 1)
 
 
@@ -131,10 +150,27 @@ class DAGEngine:
                  max_stage_retries: int = 2,
                  max_parallel_tasks: Optional[int] = None,
                  speculation: bool = False,
-                 speculation_multiplier: float = 1.5):
+                 speculation_multiplier: float = 1.5,
+                 mesh=None, mesh_axis: str = "shuffle",
+                 mesh_impl: str = "auto", mesh_rows_per_round: int = 0):
         self.driver = driver
         self.executors = list(executors)
         self.max_stage_retries = max_stage_retries
+        # ICI data plane: with a jax.sharding.Mesh here, reduce-side reads
+        # are served by ONE collective mesh reduce per parent shuffle
+        # (shuffle/mesh_service.py) instead of per-task TCP fetches — the
+        # engine SPI and the accelerated path become the same code path,
+        # as in the reference. mesh_rows_per_round > 0 streams the reduce
+        # in bounded rounds (datasets beyond one exchange's budget).
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self.mesh_impl = mesh_impl
+        self.mesh_rows_per_round = mesh_rows_per_round
+        if mesh is not None and any(self._is_remote(ex) for ex in executors):
+            raise ValueError(
+                "mesh data plane needs in-process executors (their "
+                "resolvers stage straight to the mesh); cross-process "
+                "meshes go through parallel.multihost")
         # Speculative execution (Spark's spark.speculation): once half a
         # stage's tasks have finished, a task running longer than
         # multiplier x their median gets a backup attempt on a different
@@ -169,6 +205,10 @@ class DAGEngine:
         self._handles: Dict[int, object] = {}      # stage_id -> ShuffleHandle
         self._stages: Dict[int, MapStage] = {}     # stage_id -> stage
         self._owners: Dict[int, Dict[int, int]] = {}  # stage_id -> map->slot
+        # mesh mode: shuffle_id -> per-partition (keys, payload); the one
+        # reduce's results, shared by every task reading that shuffle
+        self._mesh_cache: Dict[int, list] = {}
+        self._mesh_lock = threading.Lock()
 
     # -- public ----------------------------------------------------------
 
@@ -193,6 +233,8 @@ class DAGEngine:
                 if handle is not None:
                     self._recovered = {k for k in self._recovered
                                        if k[0] != handle.shuffle_id}
+                    with self._mesh_lock:
+                        self._mesh_cache.pop(handle.shuffle_id, None)
                     self.driver.unregisterShuffle(handle.shuffle_id)
                     # executor-side too: drops the resolver's spill data and
                     # the memoized driver table, not just the driver entry —
@@ -461,6 +503,86 @@ class DAGEngine:
             return None
         return stage.task_fn(ctx, task_id)
 
+    # -- mesh data plane (shuffle/mesh_service.py) -----------------------
+
+    def _mesh_read(self, handle, partition: int) -> CompatReader:
+        """A reader over ``partition`` served from the collective reduce."""
+        from sparkrdma_tpu.shuffle.mesh_service import CachedPartitionReader
+
+        per_part = self._mesh_partitions(handle)
+        return CompatReader(CachedPartitionReader(
+            per_part, partition, partition + 1, handle.row_payload_bytes))
+
+    def _mesh_partitions(self, handle) -> list:
+        """The parent shuffle's per-partition results, computing the ONE
+        mesh reduce on first use. Raises FetchFailedError (feeding the
+        ordinary stage-retry machinery) when a map output is on no live
+        executor — the mesh-mode analogue of a failed remote fetch.
+
+        Per-shuffle compute cells: ``_mesh_lock`` guards only the cache
+        dict, so independent shuffles reduce concurrently and cache hits
+        never wait behind another shuffle's first-touch compute."""
+        sid = handle.shuffle_id
+        with self._mesh_lock:
+            cell = self._mesh_cache.get(sid)
+            if cell is None:
+                cell = _MeshCell()
+                self._mesh_cache[sid] = cell
+        with cell.lock:
+            if cell.value is None:
+                try:
+                    cell.value = self._compute_mesh_partitions(handle)
+                except BaseException:
+                    # a failed compute must not wedge the cell: drop it so
+                    # the retry (post-recovery) computes fresh
+                    with self._mesh_lock:
+                        if self._mesh_cache.get(sid) is cell:
+                            del self._mesh_cache[sid]
+                    raise
+            return cell.value
+
+    def _compute_mesh_partitions(self, handle) -> list:
+        from sparkrdma_tpu.shuffle.mesh_service import (
+            run_mesh_reduce,
+            run_mesh_reduce_streamed,
+            split_by_partition,
+        )
+
+        mgrs = [ex.native for ex in self._live()]
+        present: set = set()
+        for mgr in mgrs:
+            if mgr.resolver is not None:
+                present.update(mgr.resolver.map_ids(handle.shuffle_id))
+        missing = sorted(set(range(handle.num_maps)) - present)
+        if missing:
+            stage_id = next(
+                sid for sid, h in self._handles.items()
+                if h.shuffle_id == handle.shuffle_id)
+            slot = self._owners[stage_id].get(missing[0], -1)
+            raise FetchFailedError(
+                handle.shuffle_id, missing[0], slot,
+                "map output on no live executor (mesh staging)")
+        # receive headroom: with P partitions on D devices only min(P, D)
+        # devices receive at all, so a receiver's fair share is
+        # ceil(D/min(P,D)) x the per-device send capacity — double that
+        # for key skew (the caller-visible knob stays OverflowError)
+        n_dev = self.mesh.shape[self.mesh_axis]
+        fan_in = -(-n_dev // max(1, min(handle.num_partitions, n_dev)))
+        out_factor = 2 * fan_in
+        if self.mesh_rows_per_round > 0:
+            results = run_mesh_reduce_streamed(
+                mgrs, handle, self.mesh, axis_name=self.mesh_axis,
+                impl=self.mesh_impl, out_factor=out_factor,
+                rows_per_round=self.mesh_rows_per_round,
+                expect_maps=handle.num_maps)
+        else:
+            results = run_mesh_reduce(
+                mgrs, handle, self.mesh, axis_name=self.mesh_axis,
+                impl=self.mesh_impl, out_factor=out_factor,
+                expect_maps=handle.num_maps)
+        return split_by_partition(results, handle.num_partitions,
+                                  handle.row_payload_bytes)
+
     # -- recovery (scala/RdmaShuffleFetcherIterator.scala:376-381) -------
 
     def _recover_shuffle(self, failure: FetchFailedError) -> None:
@@ -507,6 +629,9 @@ class DAGEngine:
             raise RuntimeError("no surviving executors to recompute on")
         log.warning("recovering shuffle %d: recomputing maps %s lost with "
                     "slot %d", failure.shuffle_id, lost, dead)
+        # a cached mesh reduce predates the loss; recompute then re-reduce
+        with self._mesh_lock:
+            self._mesh_cache.pop(failure.shuffle_id, None)
         for k, m in enumerate(lost):
             # recompute tasks read their parents through _run_task too, so
             # a grandparent loss recovers recursively within its own budget

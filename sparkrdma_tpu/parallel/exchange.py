@@ -49,6 +49,7 @@ Four transports (``impl``):
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -56,6 +57,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Host-side dispatch tally for the ICI data plane. Callers that launch a
+# collective exchange (mesh_service, models) record here so tests and the
+# engine can assert that a job's shuffle bytes actually crossed the mesh
+# rather than the TCP fetch path (the reference's equivalent evidence is
+# its verbs counters vs. socket counters).
+DATA_PLANE = {"exchanges": 0, "rows": 0}
+_DATA_PLANE_LOCK = threading.Lock()
+
+
+def record_exchange(rows: int) -> None:
+    """Tally one dispatched collective exchange moving ``rows`` rows."""
+    with _DATA_PLANE_LOCK:
+        DATA_PLANE["exchanges"] += 1
+        DATA_PLANE["rows"] += int(rows)
 
 
 def _exclusive_cumsum(x: jnp.ndarray, axis: int = 0) -> jnp.ndarray:

@@ -21,6 +21,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sparkrdma_tpu.shuffle.fetcher import ReadMetrics
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
 
 
@@ -53,6 +54,7 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
                     handle: ShuffleHandle, mesh, axis_name: str = "shuffle",
                     impl: str = "auto", sort_by_key: bool = True,
                     out_factor: int = 2,
+                    expect_maps: Optional[int] = None,
                     ) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Reduce every partition of ``handle`` on the mesh.
 
@@ -71,6 +73,7 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from sparkrdma_tpu.parallel import exchange as exchange_mod
     from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
 
     n_dev = mesh.shape[axis_name]
@@ -80,9 +83,11 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     # through the resolver's locked serving API (safe vs. concurrent
     # re-commit/unregister disposal)
     all_keys, all_payloads = [], []
-    for k, p in _iter_committed_batches(managers, handle):
+    delivered: set = set()
+    for k, p in _iter_committed_batches(managers, handle, delivered):
         all_keys.append(k)
         all_payloads.append(p)
+    _check_staging_complete(delivered, expect_maps, handle.shuffle_id)
     keys = (np.concatenate(all_keys) if all_keys
             else np.zeros(0, dtype=np.uint64))
     payload = (np.concatenate(all_payloads) if all_payloads
@@ -107,6 +112,7 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     sharding = NamedSharding(mesh, P(axis_name))
     received, counts, _ = jax.block_until_ready(exchange(
         jax.device_put(rows_p, sharding), jax.device_put(dest_p, sharding)))
+    exchange_mod.record_exchange(len(rows))
 
     # 3. unpack per device (host-side view of the device results)
     received = np.asarray(received).reshape(n_dev, -1, width)
@@ -125,19 +131,53 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     return results
 
 
-def _iter_committed_batches(managers, handle):
-    """Decoded (keys, payload) batches of every committed local spill."""
+def _iter_committed_batches(managers, handle, delivered: Optional[set] = None):
+    """Decoded (keys, payload) batches of every committed local spill.
+
+    Each map id is taken from the FIRST resolver holding it: stage retry
+    and speculation can leave identical copies of one map output on two
+    live executors (deterministic tasks, idempotent positional publishes —
+    the same invariant the driver table's overwrite relies on), and a
+    reduce must consume exactly one. ``delivered`` (when given) records
+    the map ids actually read, so callers can detect outputs disposed
+    mid-staging instead of silently reducing a partial dataset.
+    """
     from sparkrdma_tpu.shuffle.writer import decode_rows
 
+    seen: set = set()
     for mgr in managers:
         if mgr.resolver is None:
             continue
         for m in mgr.resolver.map_ids(handle.shuffle_id):
+            if m in seen:
+                continue
             raw = mgr.resolver.local_blocks(handle.shuffle_id, m, 0,
                                             handle.num_partitions)
             if raw is None:
-                continue  # disposed between map_ids() and the read
+                continue  # disposed between map_ids() and the read;
+                # another manager may still hold a copy — completeness is
+                # the caller's expect_maps check
+            seen.add(m)
+            if delivered is not None:
+                delivered.add(m)
             yield decode_rows(raw, handle.row_payload_bytes)
+
+
+def _check_staging_complete(delivered: set, expect_maps: Optional[int],
+                            shuffle_id: int) -> None:
+    """Raise FetchFailedError for the first map output that went missing
+    during staging (disposed under a dying executor) — the mesh-mode
+    analogue of a failed remote fetch; the engine's stage retry recomputes
+    it (scala/RdmaShuffleFetcherIterator.scala:376-381)."""
+    if expect_maps is None:
+        return
+    missing = sorted(set(range(expect_maps)) - delivered)
+    if missing:
+        from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
+
+        raise FetchFailedError(
+            shuffle_id, missing[0], -1,
+            "map output disposed during mesh staging")
 
 
 def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
@@ -145,6 +185,7 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
                              axis_name: str = "shuffle", impl: str = "auto",
                              rows_per_round: int = 1 << 18,
                              out_factor: int = 2,
+                             expect_maps: Optional[int] = None,
                              ) -> List[Tuple[np.ndarray, np.ndarray,
                                              np.ndarray]]:
     """``run_mesh_reduce`` for datasets beyond one exchange's device (or
@@ -158,6 +199,7 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from sparkrdma_tpu.parallel import exchange as exchange_mod
     from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
     from sparkrdma_tpu.shuffle.external import merge_runs
 
@@ -184,6 +226,7 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
         received, counts, _ = jax.block_until_ready(exchange(
             jax.device_put(rows_p, sharding),
             jax.device_put(dest_p, sharding)))
+        exchange_mod.record_exchange(len(rows_np))
         received = np.asarray(received).reshape(n_dev, -1, pw)
         counts = np.asarray(counts)
         if (counts.sum(axis=1) > cap * out_factor).any():
@@ -198,7 +241,8 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
     pending: List[np.ndarray] = []
     pending_rows = 0
     per_round = cap * n_dev
-    for k, p in _iter_committed_batches(managers, handle):
+    delivered: set = set()
+    for k, p in _iter_committed_batches(managers, handle, delivered):
         rows = _rows_to_u32(k, p)
         while len(rows):
             take = min(len(rows), per_round - pending_rows)
@@ -208,6 +252,7 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
             if pending_rows == per_round:
                 run_round(np.concatenate(pending))
                 pending, pending_rows = [], 0
+    _check_staging_complete(delivered, expect_maps, handle.shuffle_id)
     if pending_rows:
         run_round(np.concatenate(pending))
 
@@ -222,3 +267,72 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
         parts = np.asarray(partitioner(keys), dtype=np.int64)
         results.append((keys, payload, parts))
     return results
+
+
+def split_by_partition(results, num_partitions: int, row_payload_bytes: int
+                       ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Re-index a mesh reduce's per-DEVICE results as per-PARTITION
+    ``(keys, payload)`` — the unit the engine's reduce tasks consume
+    (task ``t`` reads partition ``t``). Within-partition key order is
+    preserved from the device results (sorted when the reduce sorted)."""
+    per: List[Optional[Tuple[np.ndarray, np.ndarray]]] = [None] * num_partitions
+    for k, p, parts in results:
+        for pid in np.unique(parts):
+            m = parts == pid
+            per[int(pid)] = (k[m], p[m])
+    empty = (np.zeros(0, dtype=np.uint64),
+             np.zeros((0, row_payload_bytes), dtype=np.uint8))
+    return [e if e is not None else empty for e in per]
+
+
+class CachedPartitionReader:
+    """Reader over a partition range served from mesh-reduce results.
+
+    This is what the engine hands a task in mesh mode: the same surface as
+    ``TpuShuffleReader`` (``read`` yields batches; ``read_all`` /
+    ``read_sorted`` / ``read_sorted_spilled``; ``metrics``), but every byte
+    arrived over the ICI collective — the ``metrics`` show local serving
+    only, never remote fetches. Mirrors the reference property that the
+    engine-facing reader IS the accelerated path
+    (scala/RdmaShuffleManager.scala:234-261).
+    """
+
+    def __init__(self, per_partition: Sequence[Tuple[np.ndarray, np.ndarray]],
+                 start_partition: int, end_partition: int,
+                 row_payload_bytes: int):
+        self._parts = per_partition
+        self._range = range(start_partition, end_partition)
+        self.row_payload_bytes = row_payload_bytes
+        self.metrics = ReadMetrics()
+
+    def read(self):
+        for p in self._range:
+            keys, payload = self._parts[p]
+            if len(keys):
+                self.metrics.record_local(
+                    len(keys) * (8 + self.row_payload_bytes))
+                yield keys, payload
+
+    def read_all(self) -> Tuple[np.ndarray, np.ndarray]:
+        ks, ps = [], []
+        for k, p in self.read():
+            ks.append(k)
+            ps.append(p)
+        if not ks:
+            return (np.zeros(0, dtype=np.uint64),
+                    np.zeros((0, self.row_payload_bytes), dtype=np.uint8))
+        return np.concatenate(ks), np.concatenate(ps)
+
+    def read_sorted(self) -> Tuple[np.ndarray, np.ndarray]:
+        keys, payload = self.read_all()
+        order = np.argsort(keys, kind="stable")
+        return keys[order], payload[order]
+
+    def read_sorted_spilled(self, memory_budget_bytes: int = 64 << 20,
+                            spill_dir: Optional[str] = None):
+        # data is already resident (mesh results live on the driver); the
+        # bounded-memory contract is about FETCH buffering, which the
+        # collective already did — serve the sorted view in one batch
+        keys, payload = self.read_sorted()
+        if len(keys):
+            yield keys, payload
