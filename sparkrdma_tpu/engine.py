@@ -92,6 +92,13 @@ def _check_copartition(stage) -> None:
                 f"{p.dep.num_partitions} (task t reads partition t)")
 
 
+class _JobTornDownError(Exception):
+    """Internal: the job finished and tore its shuffles down while this
+    (abandoned speculative-loser or cancelled-sibling) attempt was still
+    running. The attempt's outcome can no longer matter — exit quietly
+    instead of dying on a missing handle."""
+
+
 class _MeshCell:
     """Once-cell for one shuffle's mesh-reduce results (per-shuffle lock:
     independent shuffles reduce concurrently)."""
@@ -123,7 +130,9 @@ class TaskContext:
         way — the reference's property that getReader IS the fast path
         (scala/RdmaShuffleManager.scala:234-261)."""
         parent = self._stage.parents[parent_index]
-        handle = self._engine._handles[parent.stage_id]
+        handle = self._engine._handles.get(parent.stage_id)
+        if handle is None:
+            raise _JobTornDownError(parent.stage_id)
         if self._engine.mesh is not None:
             return self._engine._mesh_read(handle, self.task_id)
         return self.manager.getReader(handle, self.task_id, self.task_id + 1)
@@ -182,14 +191,12 @@ class DAGEngine:
         self.speculation_multiplier = speculation_multiplier
         # Tasks within a stage dispatch concurrently up to this bound
         # (Spark's running-tasks-per-stage model; remote executors run
-        # them in their task_threads slots). Default 1 = sequential, the
-        # original contract — task_fns written against it may touch
-        # shared driver-side state non-atomically, so parallelism is
-        # opt-in (len(executors) is the natural setting). Speculation
-        # needs concurrency to race a backup, so it implies it.
+        # them in their task_threads slots). Default = one in-flight task
+        # per executor — concurrency is the contract, as in Spark, and
+        # task_fns must be thread-safe the way Spark closures must be.
+        # Pass 1 for strictly sequential debugging runs.
         if max_parallel_tasks is None:
-            max_parallel_tasks = max(1, len(self.executors)) if speculation \
-                else 1
+            max_parallel_tasks = max(1, len(self.executors))
         if speculation and max_parallel_tasks <= 1:
             raise ValueError("speculation requires max_parallel_tasks > 1")
         self.max_parallel_tasks = max(1, max_parallel_tasks)
@@ -205,9 +212,10 @@ class DAGEngine:
         self._handles: Dict[int, object] = {}      # stage_id -> ShuffleHandle
         self._stages: Dict[int, MapStage] = {}     # stage_id -> stage
         self._owners: Dict[int, Dict[int, int]] = {}  # stage_id -> map->slot
-        # mesh mode: shuffle_id -> per-partition (keys, payload); the one
-        # reduce's results, shared by every task reading that shuffle
-        self._mesh_cache: Dict[int, list] = {}
+        # mesh mode: shuffle_id -> _MeshCell whose .value is the list of
+        # per-partition (keys, payload) — ONE reduce per shuffle, shared
+        # by every task reading it
+        self._mesh_cache: Dict[int, _MeshCell] = {}
         self._mesh_lock = threading.Lock()
 
     # -- public ----------------------------------------------------------
@@ -372,6 +380,9 @@ class DAGEngine:
 
         meta = {pool.submit(timed, t): t for t in range(n)}
         speculated: set = set()  # tasks that got their ONE backup
+        backups: set = set()     # backup futures (their win durations
+        # would be measured from the PRIMARY's start — excluding them
+        # keeps the median honest for later speculation thresholds)
         results: Dict[int, object] = {}
         durations: List[float] = []
         backup_pool = ThreadPoolExecutor(
@@ -386,7 +397,8 @@ class DAGEngine:
                         continue  # the other attempt already won
                     try:
                         results[t] = f.result()
-                        durations.append(time_mod.monotonic() - start[t])
+                        if f not in backups:
+                            durations.append(time_mod.monotonic() - start[t])
                     except Exception:
                         # a sibling attempt may still win; only a task
                         # with NO attempt left fails the stage
@@ -414,6 +426,7 @@ class DAGEngine:
                             avoid = None
                         b = backup_pool.submit(
                             self._run_task, stage, t, avoid_first=avoid)
+                        backups.add(b)
                         meta[b] = t
             return [results[t] for t in range(n)]
         finally:
@@ -444,6 +457,10 @@ class DAGEngine:
                                       stage=stage.stage_id, task=task_id,
                                       remote=self._is_remote(target)):
                     return self._attempt_task(stage, task_id, target)
+            except _JobTornDownError:
+                log.debug("stage %d task %d: attempt abandoned, job torn "
+                          "down", stage.stage_id, task_id)
+                return None
             except FetchFailedError as e:
                 n = attempts_by_shuffle.get(e.shuffle_id, 0) + 1
                 attempts_by_shuffle[e.shuffle_id] = n
@@ -451,7 +468,12 @@ class DAGEngine:
                     raise
                 log.warning("stage %d task %d: %s; retrying (%d)",
                             stage.stage_id, task_id, e, n)
-                self._recover_shuffle(e)
+                try:
+                    self._recover_shuffle(e)
+                except _JobTornDownError:
+                    log.debug("stage %d task %d: abandoned mid-recovery, "
+                              "job torn down", stage.stage_id, task_id)
+                    return None
             except ExecutorLostError as e:
                 # delivery failure: nothing ran, so no shuffle to repair —
                 # place the task on a DIFFERENT live executor (a timed-out
@@ -476,22 +498,28 @@ class DAGEngine:
     def _attempt_task(self, stage, task_id: int, target):
         from dataclasses import replace
 
+        # snapshot handles with .get: the job may tear down concurrently
+        # (abandoned speculative losers / cancelled siblings) — a missing
+        # handle means this attempt's outcome no longer matters
+        handle = self._handles.get(stage.stage_id) \
+            if isinstance(stage, MapStage) else None
+        raw_parents = [self._handles.get(p.stage_id) for p in stage.parents]
+        if (isinstance(stage, MapStage) and handle is None) \
+                or any(h is None for h in raw_parents):
+            raise _JobTornDownError(stage.stage_id)
         # read-side handles don't need the combiner closure (it can
         # capture large state); strip it so shipped descriptors stay small
-        parent_handles = [replace(self._handles[p.stage_id], combiner=None)
-                          for p in stage.parents]
+        parent_handles = [replace(h, combiner=None) for h in raw_parents]
         if self._is_remote(target):
             if isinstance(stage, MapStage):
-                handle = self._handles[stage.stage_id]
                 target.run_map_task(stage.task_fn, handle, parent_handles,
                                     task_id)  # combiner rides the handle
-                self._owners[stage.stage_id][task_id] = self._slot_of(target)
+                self._record_owner(stage.stage_id, task_id, target)
                 return None
             return target.run_result_task(stage.task_fn, parent_handles,
                                           task_id)
         ctx = TaskContext(self, target, stage, task_id)
         if isinstance(stage, MapStage):
-            handle = self._handles[stage.stage_id]
             writer = target.getWriter(handle, task_id)  # combiner on handle
             try:
                 stage.task_fn(ctx, writer, task_id)
@@ -499,9 +527,15 @@ class DAGEngine:
                 writer.stop(False)
                 raise
             writer.stop(True)
-            self._owners[stage.stage_id][task_id] = self._slot_of(target)
+            self._record_owner(stage.stage_id, task_id, target)
             return None
         return stage.task_fn(ctx, task_id)
+
+    def _record_owner(self, stage_id: int, task_id: int, target) -> None:
+        owners = self._owners.get(stage_id)
+        if owners is not None:  # gone = job already torn down; late
+            # publishes of an abandoned attempt are harmless (idempotent)
+            owners[task_id] = self._slot_of(target)
 
     # -- mesh data plane (shuffle/mesh_service.py) -----------------------
 
@@ -556,9 +590,11 @@ class DAGEngine:
         missing = sorted(set(range(handle.num_maps)) - present)
         if missing:
             stage_id = next(
-                sid for sid, h in self._handles.items()
-                if h.shuffle_id == handle.shuffle_id)
-            slot = self._owners[stage_id].get(missing[0], -1)
+                (sid for sid, h in self._handles.items()
+                 if h.shuffle_id == handle.shuffle_id), None)
+            if stage_id is None:
+                raise _JobTornDownError(handle.shuffle_id)
+            slot = self._owners.get(stage_id, {}).get(missing[0], -1)
             raise FetchFailedError(
                 handle.shuffle_id, missing[0], slot,
                 "map output on no live executor (mesh staging)")
@@ -593,12 +629,14 @@ class DAGEngine:
         just retry)."""
         with self._recover_lock:
             key = (failure.shuffle_id, failure.exec_index)
-            stage = next((s for s in self._stages.values()
-                          if self._handles[s.stage_id].shuffle_id
-                          == failure.shuffle_id), None)
+            stage = self._stage_of_shuffle(failure.shuffle_id)
             if stage is None:
-                raise failure  # not one of ours (already unregistered?)
-            owners = self._owners[stage.stage_id].values()
+                # every in-tree reader goes through engine-registered
+                # shuffles, so an unknown shuffle means run()'s finally
+                # tore the job down while this (abandoned) attempt was
+                # mid-fetch — exit quietly, don't burn retries
+                raise _JobTornDownError(failure.shuffle_id)
+            owners = self._owners.get(stage.stage_id, {}).values()
             # Skip only when this exact loss was repaired AND the repair
             # stuck (no map still owned by the dead/unknown slot). A
             # memo hit must never suppress a recovery the table still
@@ -612,11 +650,21 @@ class DAGEngine:
             if failure.exec_index >= 0:
                 self._recovered.add(key)
 
+    def _stage_of_shuffle(self, shuffle_id: int):
+        """The registered stage producing ``shuffle_id``, or None mid/post
+        teardown (handles pop before stages in run()'s finally, so both
+        maps are consulted defensively)."""
+        for s in list(self._stages.values()):
+            h = self._handles.get(s.stage_id)
+            if h is not None and h.shuffle_id == shuffle_id:
+                return s
+        return None
+
     def _recover_shuffle_locked(self, failure: FetchFailedError) -> None:
-        stage = next(s for s in self._stages.values()
-                     if self._handles[s.stage_id].shuffle_id
-                     == failure.shuffle_id)
-        owners = self._owners[stage.stage_id]
+        stage = self._stage_of_shuffle(failure.shuffle_id)
+        if stage is None:
+            raise _JobTornDownError(failure.shuffle_id)
+        owners = self._owners.get(stage.stage_id, {})
         dead = failure.exec_index
         # slot < 0 = owner was tombstoned before its slot resolved: its
         # data is on a dead executor too, recompute alongside

@@ -176,7 +176,14 @@ class RemoteExecutor:
                 # the executor is reachable but the task outlived its
                 # budget: re-place THIS task, don't write off a healthy
                 # process (alive=False would also skip it at job cleanup,
-                # leaking its shuffle data)
+                # leaking its shuffle data).
+                # DUPLICATE-EXECUTION WINDOW: the abandoned copy keeps
+                # running remotely and may publish after the re-placed
+                # copy — safe only because publishes are idempotent
+                # positional writes of deterministic output, and
+                # _recover_shuffle_locked's failure.map_id fallback can
+                # repair a table entry naming the wrong copy's executor.
+                # Weakening either invariant breaks this branch.
                 raise ExecutorLostError(
                     f"task on {self.manager_id.executor_id.executor} "
                     f"exceeded its {timeout:.0f}s wait budget: {e}") from e

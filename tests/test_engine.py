@@ -8,6 +8,12 @@ import time
 import numpy as np
 import pytest
 
+from engine_helpers import (
+    make_cluster,
+    make_table as _table,
+    payload_u32 as _payload_u32,
+    u32_payload as _u32_payload,
+)
 from sparkrdma_tpu.config import TpuShuffleConf
 from sparkrdma_tpu.engine import DAGEngine, MapStage, ResultStage
 from sparkrdma_tpu.shuffle.manager import PartitionerSpec
@@ -16,36 +22,14 @@ from sparkrdma_tpu.shuffle.spark_compat import (
     SparkCompatShuffleManager,
 )
 
-CONF = TpuShuffleConf(connect_timeout_ms=1000, max_connection_attempts=2)
-
 
 @pytest.fixture
 def cluster(tmp_path):
-    driver = SparkCompatShuffleManager(CONF, isDriver=True)
-    execs = [SparkCompatShuffleManager(
-        CONF, driverAddr=driver.driverAddr, executorId=str(i),
-        spill_dir=str(tmp_path / f"e{i}")) for i in range(3)]
-    for ex in execs:
-        ex.native.executor.wait_for_members(3)
+    driver, execs = make_cluster(tmp_path)
     yield driver, execs
     for ex in execs:
         ex.stop()
     driver.stop()
-
-
-def _u32_payload(values) -> np.ndarray:
-    return np.ascontiguousarray(values, dtype="<u4").view(np.uint8).reshape(-1, 4)
-
-
-def _payload_u32(payload: np.ndarray) -> np.ndarray:
-    return np.ascontiguousarray(payload).view("<u4").ravel()
-
-
-def _table(seed: int, rows: int, key_space: int):
-    rng = np.random.default_rng(seed)
-    keys = rng.integers(0, key_space, size=rows).astype(np.uint64)
-    vals = rng.integers(0, 1000, size=rows).astype(np.uint32)
-    return keys, vals
 
 
 def test_two_table_join(cluster):
@@ -258,3 +242,61 @@ def test_speculative_execution_beats_straggler(cluster):
     # the stage must finish before the straggler's 2.0s sleep could have
     # (load-tolerant: anything under the sleep proves the backup won)
     assert wall < 2.0, f"speculation did not beat the straggler ({wall:.2f}s)"
+
+
+def test_parallel_dispatch_is_default(cluster):
+    """Concurrency is the contract (Spark's running-tasks model): the
+    default bound is one in-flight task per executor, and a stage's tasks
+    really do overlap."""
+    import threading
+
+    driver, execs = cluster
+    engine = DAGEngine(driver, execs)
+    assert engine.max_parallel_tasks == len(execs)
+
+    barrier = threading.Barrier(len(execs), timeout=10)
+
+    def map_fn(ctx, writer, t):
+        barrier.wait()  # passes only if all tasks are in flight at once
+        writer.write((np.arange(10, dtype=np.uint64),
+                      np.zeros((10, 4), np.uint8)))
+
+    def reduce_fn(ctx, t):
+        return sum(len(k) for k, _ in ctx.read(0).readBatches())
+
+    stage = MapStage(len(execs), ShuffleDependency(
+        2, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn)
+    assert sum(engine.run(ResultStage(2, reduce_fn, parents=[stage]))) \
+        == len(execs) * 10
+
+
+def test_abandoned_attempt_exits_cleanly_after_teardown(cluster):
+    """An attempt still running when run() tears the job down (speculative
+    loser / cancelled sibling) must exit via the torn-down signal, not die
+    on a KeyError over popped handles or republish to an unregistered
+    shuffle."""
+    driver, execs = cluster
+    P, maps = 4, 3
+
+    def map_fn(ctx, writer, t):
+        writer.write((np.arange(50, dtype=np.uint64) + t,
+                      np.zeros((50, 4), np.uint8)))
+
+    def reduce_fn(ctx, t):
+        return sum(len(k) for k, _ in ctx.read(0).readBatches())
+
+    stage = MapStage(maps, ShuffleDependency(
+        P, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn)
+    final = ResultStage(P, reduce_fn, parents=[stage])
+    engine = DAGEngine(driver, execs)
+    assert sum(engine.run(final)) == maps * 50
+    # handles/owners are popped now; a late attempt of either stage kind
+    # must return quietly (the engine logs at debug and moves on)
+    assert engine._run_task(final, 0) is None
+    assert engine._run_task(stage, 0) is None
+    # and a late FetchFailed (abandoned attempt mid-fetch at teardown)
+    # must surface the torn-down signal, not KeyError or retry burn
+    from sparkrdma_tpu.engine import _JobTornDownError
+    from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
+    with pytest.raises(_JobTornDownError):
+        engine._recover_shuffle(FetchFailedError(999, 0, 0, "late fetch"))

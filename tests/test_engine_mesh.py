@@ -14,17 +14,18 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
-from sparkrdma_tpu.config import TpuShuffleConf
+from engine_helpers import (
+    make_cluster,
+    make_table as _table,
+    payload_u32 as _payload_u32,
+    u32_payload as _u32_payload,
+)
 from sparkrdma_tpu.engine import DAGEngine, MapStage, ResultStage
 from sparkrdma_tpu.parallel import exchange as exchange_mod
 from sparkrdma_tpu.shuffle.manager import PartitionerSpec
-from sparkrdma_tpu.shuffle.spark_compat import (
-    ShuffleDependency,
-    SparkCompatShuffleManager,
-)
+from sparkrdma_tpu.shuffle.spark_compat import ShuffleDependency
 
 D = 8
-CONF = TpuShuffleConf(connect_timeout_ms=1000, max_connection_attempts=2)
 
 
 @pytest.fixture(scope="module")
@@ -34,31 +35,11 @@ def mesh():
 
 @pytest.fixture
 def cluster(tmp_path):
-    driver = SparkCompatShuffleManager(CONF, isDriver=True)
-    execs = [SparkCompatShuffleManager(
-        CONF, driverAddr=driver.driverAddr, executorId=str(i),
-        spill_dir=str(tmp_path / f"e{i}")) for i in range(3)]
-    for ex in execs:
-        ex.native.executor.wait_for_members(3)
+    driver, execs = make_cluster(tmp_path)
     yield driver, execs
     for ex in execs:
         ex.stop()
     driver.stop()
-
-
-def _u32_payload(values) -> np.ndarray:
-    return np.ascontiguousarray(values, dtype="<u4").view(np.uint8).reshape(-1, 4)
-
-
-def _payload_u32(payload: np.ndarray) -> np.ndarray:
-    return np.ascontiguousarray(payload).view("<u4").ravel()
-
-
-def _table(seed: int, rows: int, key_space: int):
-    rng = np.random.default_rng(seed)
-    keys = rng.integers(0, key_space, size=rows).astype(np.uint64)
-    vals = rng.integers(0, 1000, size=rows).astype(np.uint32)
-    return keys, vals
 
 
 def _no_tcp_fetchers(monkeypatch):
@@ -153,7 +134,10 @@ def test_engine_mesh_survives_executor_loss(cluster, mesh, caplog):
 
     stage = MapStage(maps, ShuffleDependency(
         P, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn)
-    engine = DAGEngine(driver, execs, mesh=mesh)
+    # sequential: the injection relies on task 0 killing BEFORE any other
+    # task's read triggers the mesh reduce (a concurrent sibling would
+    # legitimately cache the pre-kill reduce and no recovery would fire)
+    engine = DAGEngine(driver, execs, mesh=mesh, max_parallel_tasks=1)
     got = sum(engine.run(ResultStage(P, reduce_fn, parents=[stage])))
     assert killed["done"], "failure injection never ran"
 
