@@ -373,98 +373,166 @@ def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
     @jax.jit
     @functools.partial(jax.shard_map, **shard_kwargs)
     def round_fn(grouped, counts, round_idx):
-        counts = counts.reshape(-1).astype(jnp.int32)
-        seg_starts = _exclusive_cumsum(counts)
-        # This round's slice of each destination segment:
-        # [start + r*quota, start + min((r+1)*quota, count))
-        lo = jnp.minimum(round_idx * quota, counts)
-        hi = jnp.minimum(lo + quota, counts)
-        send_counts = hi - lo
-        # per-destination slot layout, shared with the dense transport
-        filled, valid, dest_of_slot, within = _slot_fill(
-            grouped, seg_starts + lo, send_counts, n, quota)
-        vmask = valid.reshape((-1,) + (1,) * (grouped.ndim - 1))
-
-        if impl_resolved in ("ring", "ring_interpret"):
-            # Hand-scheduled ICI transport (ops/ring_exchange.py): send rows
-            # stay in natural [D, quota] block layout — no compaction needed
-            # on the send side; the ring's fixed block shape IS the quota.
-            # Mosaic remote-DMA slices need the lane (last) dim 128-aligned,
-            # so each per-destination block travels as flat words reshaped
-            # to [*, 128] lanes (padded by <128 words when quota*row_words
-            # isn't a lane multiple) and is unflattened on arrival.
-            from sparkrdma_tpu.ops.ring_exchange import ring_all_to_all_shard
-            blocks = filled.reshape((n, quota) + grouped.shape[1:])
-            words = int(np.prod(blocks.shape[1:]))
-            lanes = -(-words // 128) * 128
-            flat = blocks.reshape(n, words)
-            if lanes != words:
-                flat = jnp.pad(flat, ((0, 0), (0, lanes - words)))
-            got_flat = ring_all_to_all_shard(
-                flat.reshape(n, lanes // 128, 128), axis_name, n,
-                interpret=(impl_resolved == "ring_interpret"))
-            got = got_flat.reshape(n, lanes)[:, :words].reshape(blocks.shape)
-            mat = lax.all_gather(send_counts, axis_name, axis=0, tiled=False)
-            my = lax.axis_index(axis_name)
-            recv_counts = mat[:, my]
-            # compact [D, quota] -> packed grouped-by-source (recv_counts
-            # <= quota by construction)
-            received = _pack_by_source(
-                got, recv_counts,
-                jnp.zeros((n * quota,) + grouped.shape[1:], grouped.dtype))
-            return received, recv_counts[None]
-
-        # Collective transport: compact send buffer, destination-grouped.
-        send_off = _exclusive_cumsum(send_counts)
-        compact_idx = jnp.where(valid,
-                                send_off[dest_of_slot] + within,
-                                n * quota - 1)
-        send_buf = jnp.zeros((n * quota,) + grouped.shape[1:], grouped.dtype)
-        # scatter picked rows to their compact position (invalid rows all
-        # collide harmlessly on the last slot, then get overwritten only by
-        # at most one valid row — counts guarantee compact positions unique)
-        send_buf = send_buf.at[compact_idx].set(filled)
-        received, recv_counts, _ = ragged_exchange_shard(
-            send_buf, send_counts, axis_name, impl=impl_resolved)
+        received, recv_counts = _chunked_round_shard(
+            grouped, counts, round_idx, axis_name, n, quota, impl_resolved)
         return received, recv_counts[None]
 
     return round_fn
 
 
+def _chunked_round_shard(grouped, counts, round_idx, axis_name: str, n: int,
+                         quota: int, impl_resolved: str):
+    """One chunked round, inside shard_map: returns this round's received
+    rows packed grouped-by-source plus per-source counts."""
+    counts = counts.reshape(-1).astype(jnp.int32)
+    seg_starts = _exclusive_cumsum(counts)
+    # This round's slice of each destination segment:
+    # [start + r*quota, start + min((r+1)*quota, count))
+    lo = jnp.minimum(round_idx * quota, counts)
+    hi = jnp.minimum(lo + quota, counts)
+    send_counts = hi - lo
+    # per-destination slot layout, shared with the dense transport
+    filled, valid, dest_of_slot, within = _slot_fill(
+        grouped, seg_starts + lo, send_counts, n, quota)
+
+    if impl_resolved in ("ring", "ring_interpret"):
+        # Hand-scheduled ICI transport (ops/ring_exchange.py): send rows
+        # stay in natural [D, quota] block layout — no compaction needed
+        # on the send side; the ring's fixed block shape IS the quota.
+        # Mosaic remote-DMA slices need the lane (last) dim 128-aligned,
+        # so each per-destination block travels as flat words reshaped
+        # to [*, 128] lanes (padded by <128 words when quota*row_words
+        # isn't a lane multiple) and is unflattened on arrival.
+        from sparkrdma_tpu.ops.ring_exchange import ring_all_to_all_shard
+        blocks = filled.reshape((n, quota) + grouped.shape[1:])
+        words = int(np.prod(blocks.shape[1:]))
+        lanes = -(-words // 128) * 128
+        flat = blocks.reshape(n, words)
+        if lanes != words:
+            flat = jnp.pad(flat, ((0, 0), (0, lanes - words)))
+        got_flat = ring_all_to_all_shard(
+            flat.reshape(n, lanes // 128, 128), axis_name, n,
+            interpret=(impl_resolved == "ring_interpret"))
+        got = got_flat.reshape(n, lanes)[:, :words].reshape(blocks.shape)
+        mat = lax.all_gather(send_counts, axis_name, axis=0, tiled=False)
+        my = lax.axis_index(axis_name)
+        recv_counts = mat[:, my]
+        # compact [D, quota] -> packed grouped-by-source (recv_counts
+        # <= quota by construction)
+        received = _pack_by_source(
+            got, recv_counts,
+            jnp.zeros((n * quota,) + grouped.shape[1:], grouped.dtype))
+        return received, recv_counts
+
+    # Collective transport: compact send buffer, destination-grouped.
+    send_off = _exclusive_cumsum(send_counts)
+    compact_idx = jnp.where(valid,
+                            send_off[dest_of_slot] + within,
+                            n * quota - 1)
+    send_buf = jnp.zeros((n * quota,) + grouped.shape[1:], grouped.dtype)
+    # scatter picked rows to their compact position (invalid rows all
+    # collide harmlessly on the last slot, then get overwritten only by
+    # at most one valid row — counts guarantee compact positions unique)
+    send_buf = send_buf.at[compact_idx].set(filled)
+    received, recv_counts, _ = ragged_exchange_shard(
+        send_buf, send_counts, axis_name, impl=impl_resolved)
+    return received, recv_counts
+
+
+@functools.lru_cache(maxsize=128)
+def make_chunked_exchange_acc(mesh: Mesh, axis_name: str, quota: int,
+                              impl: str = "auto"):
+    """``make_chunked_exchange`` with a DEVICE-RESIDENT accumulator: each
+    round scatters its received rows straight into a per-device output
+    buffer at their final source-major position, so the host loop touches
+    no data at all — per-round host work is the loop counter, and the
+    whole result crosses to the host (if ever) exactly once.
+
+    Landing offsets need no device->host sync: every shard re-derives the
+    full DxD count matrix with one O(D^2)-int ``all_gather`` per round and
+    computes ``base[src] + already_sent[src] + within`` locally — the same
+    trick the one-shot exchange uses for its receive offsets.
+
+    Returns ``round_acc(grouped, counts, round_idx, acc) -> acc`` where
+    ``acc`` is ``[D * cap_out, ...]`` sharded on the leading axis (its
+    shape IS the capacity — jit re-specializes per shape); rows a device
+    nets beyond ``cap_out`` are the CALLER's sizing error (cap_out must be
+    ``max_d sum_s counts[s, d]``, which the caller knows — it has the
+    count matrix).
+    """
+    n = mesh.shape[axis_name]
+    impl_resolved = (impl if impl in ("ring", "ring_interpret")
+                     else resolve_impl(mesh, impl, axis_name))
+    spec = P(axis_name)
+    shard_kwargs = dict(mesh=mesh, in_specs=(spec, spec, None, spec),
+                        out_specs=spec)
+    if impl_resolved in ("ring", "ring_interpret"):
+        shard_kwargs["check_vma"] = False
+
+    @functools.partial(jax.jit, donate_argnums=(3,))
+    @functools.partial(jax.shard_map, **shard_kwargs)
+    def round_acc(grouped, counts, round_idx, acc):
+        counts = counts.reshape(-1).astype(jnp.int32)
+        received, _ = _chunked_round_shard(
+            grouped, counts, round_idx, axis_name, n, quota, impl_resolved)
+        # full count matrix -> my column = total rows each source sends me
+        mat = lax.all_gather(counts, axis_name, axis=0, tiled=False)
+        my = lax.axis_index(axis_name)
+        to_me = mat[:, my]
+        base = _exclusive_cumsum(to_me)          # source-major layout
+        lo = jnp.minimum(round_idx * quota, to_me)
+        hi = jnp.minimum(lo + quota, to_me)
+        rcnt = hi - lo                           # received per source now
+        off = _exclusive_cumsum(rcnt)            # packed positions
+        src = jnp.repeat(jnp.arange(n), quota)
+        w = jnp.tile(jnp.arange(quota), n)
+        valid = w < rcnt[src]
+        rows = received[jnp.where(valid, off[src] + w, 0)]
+        # invalid slots aim past the buffer and drop
+        dst = jnp.where(valid, base[src] + lo[src] + w, acc.shape[0])
+        return acc.at[dst].set(rows, mode="drop")
+
+    return round_acc
+
+
 def chunked_exchange(mesh: Mesh, axis_name: str, grouped: np.ndarray,
                      counts: np.ndarray, quota: int, impl: str = "auto"):
-    """Host driver for ``make_chunked_exchange``: runs all rounds, returns
-    (received_rows_per_device, total_rounds). Each device's rows are grouped
-    by source device, in the source's original within-destination order
-    (the per-round segments are re-assembled source-major so the contract
-    matches ``ragged_exchange_shard``'s). ``grouped``/``counts`` are global
-    arrays sharded on axis 0."""
+    """Host driver for the chunked exchange: runs all rounds with the
+    device-resident accumulator, returns (received_rows_per_device,
+    total_rounds). Each device's rows are grouped by source device, in the
+    source's original within-destination order (same contract as
+    ``ragged_exchange_shard``). ``grouped``/``counts`` are global arrays
+    sharded on axis 0.
+
+    Host cost model: O(1) work per round (the loop index), one
+    device->host transfer at the end. The previous per-round
+    ``np.asarray`` + O(D^2) Python segment slicing made the HOST the
+    bottleneck at ALS/skew scale — the round loop now leaves data in HBM
+    (the reference's analogous property: fetched blocks land in
+    registered memory and stay there,
+    scala/RdmaShuffleFetcherIterator.scala:240-276)."""
     n = mesh.shape[axis_name]
     counts_host = np.asarray(counts).reshape(n, n)
     num_rounds = max(1, int(-(-counts_host.max() // quota)))
-    round_fn = make_chunked_exchange(mesh, axis_name, quota, impl)
+    recv_totals = counts_host.sum(axis=0)        # rows landing per device
+    cap_out = max(1, int(recv_totals.max()))
+    round_acc = make_chunked_exchange_acc(mesh, axis_name, quota, impl)
     sharding = NamedSharding(mesh, P(axis_name))
     grouped_d = jax.device_put(grouped, sharding)
     counts_d = jax.device_put(counts_host.reshape(-1), sharding)
-    # per destination, per source: list of that source's round segments
-    per_source = [[[] for _ in range(n)] for _ in range(n)]
+    # host-side zeros: device_put then ships each device ONLY its shard —
+    # a jnp.zeros here would transiently commit the whole global buffer to
+    # the default device before resharding (D-fold HBM spike)
+    acc = jax.device_put(
+        np.zeros((n * cap_out,) + grouped.shape[1:], grouped.dtype),
+        sharding)
     for r in range(num_rounds):
-        out, rc = round_fn(grouped_d, counts_d, r)
-        out = np.asarray(out).reshape(n, quota * n, *grouped.shape[1:])
-        rc = np.asarray(rc)  # [n_dest, n_src] rows received this round
-        for d in range(n):
-            start = 0
-            for j in range(n):
-                c = int(rc[d][j])
-                if c:
-                    per_source[d][j].append(out[d][start:start + c])
-                start += c
-    empty = np.zeros((0,) + grouped.shape[1:], grouped.dtype)
-    received = []
-    for d in range(n):
-        segs = [seg for j in range(n) for seg in per_source[d][j]]
-        received.append(np.concatenate(segs) if segs else empty)
-    return received, num_rounds
+        acc = round_acc(grouped_d, counts_d, r, acc)
+    record_exchange(int(counts_host.sum()))
+    out = np.asarray(acc).reshape(n, cap_out, *grouped.shape[1:])
+    # copies, not views: under skew the padded base array is up to D x the
+    # real data, and callers (ALS) hold the results across whole solves
+    return [out[d][:int(recv_totals[d])].copy() for d in range(n)], num_rounds
 
 
 @functools.lru_cache(maxsize=64)

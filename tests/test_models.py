@@ -134,3 +134,94 @@ def test_join_no_matches(mesh):
                            jax.device_put(right, shard))
     assert int(np.asarray(counts).sum()) == 0
     assert int(np.asarray(sums).sum()) == 0
+
+
+def test_chunked_exchange_device_resident_at_als_scale(mesh):
+    """VERDICT r2 item 3: >=64 rounds on the 8-device mesh with the round
+    loop doing no per-round host data work — outputs accumulate in device
+    buffers and cross to the host once. Asserts exactness, bounded host
+    allocations during the loop, and logs the legacy-hostloop A/B time."""
+    import time
+    import tracemalloc
+
+    from sparkrdma_tpu.parallel.exchange import (
+        NamedSharding,
+        P,
+        jax as jax_mod,
+        make_chunked_exchange,
+        make_chunked_exchange_acc,
+    )
+
+    quota = 32
+    heavy = 64 * quota  # pair (s, 0) traffic -> exactly 64 rounds
+    light = 40
+    width = 8
+    rng = np.random.default_rng(5)
+    counts = np.full((D, D), light, dtype=np.int32)
+    counts[:, 0] = heavy
+    total = int(counts.sum())
+    rows = np.zeros((D, heavy + (D - 1) * light, width), dtype=np.uint32)
+    expect = [[] for _ in range(D)]
+    for s in range(D):
+        segs = []
+        for d in range(D):  # destination-grouped layout per source
+            seg = rng.integers(0, 2**31, (counts[s, d], width),
+                               dtype=np.uint32)
+            segs.append(seg)
+            expect[d].append(seg)
+        rows[s] = np.concatenate(segs)
+    rows = rows.reshape(D * rows.shape[1], width)
+
+    chunked_exchange(mesh, "shuffle", rows, counts, quota=quota)  # warm
+
+    tracemalloc.start()
+    t0 = time.monotonic()
+    received, rounds = chunked_exchange(mesh, "shuffle", rows, counts,
+                                        quota=quota)
+    new_time = time.monotonic() - t0
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    assert rounds == 64
+    for d in range(D):
+        # exact source-major contract straight out of the device buffer
+        np.testing.assert_array_equal(received[d], np.concatenate(expect[d]))
+    # the loop must not have staged the dataset on the host per round:
+    # peak python/numpy allocations stay near the ONE final transfer of
+    # the padded device buffer (D*cap_out rows; skew pads it), far under
+    # 64 rounds x per-round staging
+    final_bytes = total * width * 4
+    cap_out = int(counts.sum(axis=0).max())
+    padded_bytes = D * cap_out * width * 4
+    assert peak < padded_bytes + 2 * final_bytes + (1 << 20), \
+        f"host peak {peak} suggests per-round host staging"
+
+    # legacy host-loop A/B (the pre-rework driver, reconstructed): pulls
+    # every round's full mesh output to the host and slices O(D^2) segments
+    round_fn = make_chunked_exchange(mesh, "shuffle", quota)
+    sharding = NamedSharding(mesh, P("shuffle"))
+    grouped_d = jax_mod.device_put(rows, sharding)
+    counts_d = jax_mod.device_put(counts.reshape(-1), sharding)
+    round_fn(grouped_d, counts_d, 0)  # warm (compile) before timing
+    t0 = time.monotonic()
+    per_source = [[[] for _ in range(D)] for _ in range(D)]
+    for r in range(rounds):
+        out, rc = round_fn(grouped_d, counts_d, r)
+        out = np.asarray(out).reshape(D, quota * D, width)
+        rc = np.asarray(rc)
+        for d in range(D):
+            start = 0
+            for j in range(D):
+                c = int(rc[d][j])
+                if c:
+                    per_source[d][j].append(out[d][start:start + c])
+                start += c
+    legacy = [np.concatenate([seg for j in range(D)
+                              for seg in per_source[d][j]])
+              for d in range(D)]
+    legacy_time = time.monotonic() - t0
+    for d in range(D):
+        np.testing.assert_array_equal(received[d], legacy[d])
+    print(f"\nchunked 64 rounds: device-resident {new_time:.3f}s vs "
+          f"legacy host-loop {legacy_time:.3f}s "
+          f"(host peak {peak / 1e6:.1f} MB, moved {final_bytes / 1e6:.1f} MB)")
