@@ -76,6 +76,147 @@ def shard_local_rows(mesh, axis_name: str, local_rows: np.ndarray,
         sharding, local_rows, (global_rows,) + local_rows.shape[1:])
 
 
+def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
+                              axis_name: str = "shuffle",
+                              impl: str = "auto", out_factor: int = 2,
+                              sort_by_key: bool = True):
+    """Cross-process mesh reduce: committed spills on N hosts -> ONE
+    global-mesh exchange — the reference's whole multi-node pipeline
+    (README.md:11-31: map outputs on every node's disks, NICs carry the
+    MxR redistribution) with the global collective as the data plane.
+
+    Each process stages the spills its LOCAL executors own according to
+    the driver table (so a map recomputed or speculated onto another host
+    stages exactly once, table-owner-wins — the same single-owner contract
+    the TCP fetch path reads by), assembles the global sharded arrays with
+    ``make_array_from_process_local_data``, and the same jitted exchange
+    step every other path uses redistributes rows to their partition's
+    owner device. SPMD: every process must call this collectively.
+
+    ``managers``: this process's executor-role ``TpuShuffleManager`` s.
+    Returns this process's ADDRESSABLE results: a list of
+    ``(keys u64[*], payload u8[*, W], partition_ids i64[*])`` per local
+    mesh device (remote shards belong to their own processes).
+    """
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkrdma_tpu.parallel import exchange as exchange_mod
+    from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
+    from sparkrdma_tpu.shuffle.mesh_service import _rows_to_u32, _u32_to_rows
+    from sparkrdma_tpu.shuffle.writer import decode_rows
+
+    n_global = mesh.devices.size
+    local_mesh_devices = [d for d in mesh.devices.flat
+                          if d.process_index == jax.process_index()]
+    n_local = len(local_mesh_devices)
+    if n_local == 0:
+        raise ValueError("this process owns no devices of the mesh")
+    partitioner = handle.partitioner.build(handle.num_partitions)
+
+    # 1. the driver table names each map's owner slot; stage local ones
+    endpoint_mgr = next((m for m in managers if m.executor is not None),
+                        None)
+    if endpoint_mgr is None:
+        # failing BEFORE the collective: a silent StopIteration here would
+        # leave every peer hung in the allgather
+        raise ValueError("managers must include at least one executor role")
+    table = endpoint_mgr.executor.get_driver_table(
+        handle.shuffle_id, expect_published=handle.num_maps)
+    by_slot = {m.executor.exec_index(): m for m in managers
+               if m.executor is not None and m.resolver is not None}
+    all_keys, all_payloads = [], []
+    staged = np.zeros(handle.num_maps, dtype=np.int64)
+    for m in range(handle.num_maps):
+        entry = table.entry(m)
+        if entry is None:
+            raise RuntimeError(f"map {m} unpublished in driver table")
+        owner = by_slot.get(entry[1])
+        if owner is None:
+            continue  # another process's map (checked globally below)
+        raw = owner.resolver.local_blocks(handle.shuffle_id, m, 0,
+                                          handle.num_partitions)
+        if raw is None:
+            # disposed mid-staging (dying executor): leave it unstaged —
+            # the POST-allgather completeness check raises the retryable
+            # FetchFailedError on EVERY process consistently; raising here
+            # would strand the peers in the collective
+            continue
+        k, p = decode_rows(raw, handle.row_payload_bytes)
+        staged[m] = 1
+        all_keys.append(k)
+        all_payloads.append(p)
+    keys = (np.concatenate(all_keys) if all_keys
+            else np.zeros(0, dtype=np.uint64))
+    payload = (np.concatenate(all_payloads) if all_payloads
+               else np.zeros((0, handle.row_payload_bytes), dtype=np.uint8))
+    rows = _rows_to_u32(keys, payload)
+    dest = np.asarray(partitioner(keys), dtype=np.int32) % n_global
+
+    # 2. one tiny host-side allgather carries ALL the cross-host metadata:
+    # per-process (row total, mesh-device count) for capacity agreement,
+    # plus the staged-map bitmap for global completeness
+    meta = multihost_utils.process_allgather(np.concatenate(
+        [np.array([len(rows), n_local], dtype=np.int64), staged]))
+    meta = meta.reshape(-1, 2 + handle.num_maps)
+    # processes may own different device counts: everyone takes the max of
+    # per-process ceil(rows_i / n_local_i) so the global shape agrees
+    cap = max(1, int(max(-(-int(r) // max(1, int(nl)))
+                         for r, nl in meta[:, :2])))
+    staged_global = meta[:, 2:].sum(axis=0)
+    unstaged = np.flatnonzero(staged_global == 0)
+    if len(unstaged):
+        from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
+
+        m = int(unstaged[0])
+        entry = table.entry(m)
+        raise FetchFailedError(
+            handle.shuffle_id, m, entry[1] if entry else -1,
+            "map output staged by no process (owner died, spill disposed "
+            "mid-staging, or its managers not passed in) — raised on all "
+            "processes; recompute and re-enter collectively")
+
+    width = 2 + (handle.row_payload_bytes + 3) // 4
+    rows_p = np.zeros((n_local * cap, width), dtype=np.uint32)
+    rows_p[:len(rows)] = rows
+    dest_p = np.full(n_local * cap, -1, dtype=np.int32)
+    dest_p[:len(rows)] = dest
+
+    sharding = NamedSharding(mesh, P(axis_name))
+    rows_g = jax.make_array_from_process_local_data(
+        sharding, rows_p, (n_global * cap, width))
+    dest_g = jax.make_array_from_process_local_data(
+        sharding, dest_p, (n_global * cap,))
+
+    # 3. the one shared jitted exchange over the GLOBAL mesh
+    exchange = make_shuffle_exchange(mesh, axis_name, impl=impl,
+                                     out_factor=out_factor)
+    received, counts, _ = jax.block_until_ready(exchange(rows_g, dest_g))
+    exchange_mod.record_exchange(int(meta[:, 0].sum()))
+
+    # 4. unpack this process's addressable shards
+    results = []
+    recv_by_dev = {s.device: np.asarray(s.data)
+                   for s in received.addressable_shards}
+    counts_by_dev = {s.device: np.asarray(s.data)
+                     for s in counts.addressable_shards}
+    for dev in local_mesh_devices:
+        got = recv_by_dev[dev].reshape(-1, width)
+        cnt = counts_by_dev[dev].reshape(-1)
+        total = int(cnt.sum())
+        if total > cap * out_factor:
+            raise OverflowError("multihost mesh reduce receive overflow; "
+                                "raise out_factor")
+        k, p = _u32_to_rows(got[:total], handle.row_payload_bytes)
+        parts = np.asarray(partitioner(k), dtype=np.int64)
+        if sort_by_key:
+            order = np.argsort(k, kind="stable")
+            k, p, parts = k[order], p[order], parts[order]
+        results.append((k, p, parts))
+    return results
+
+
 def run_multihost_terasort(mesh, axis_name: str, rows_per_device: int,
                            payload_words: int = 4, seed: int = 0,
                            ) -> Tuple[np.ndarray, np.ndarray]:
