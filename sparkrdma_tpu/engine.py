@@ -684,6 +684,28 @@ class DAGEngine:
             # recompute tasks read their parents through _run_task too, so
             # a grandparent loss recovers recursively within its own budget
             self._run_task(stage, m, mgr=live[k % len(live)])
+        # publishes are one-sided (no ack) and don't change the publish
+        # count, so the long-poll can't sync on a REPAIR — wait until the
+        # driver table visibly stops naming the dead slot, else a retry
+        # racing the in-flight republish reads the stale entry and burns
+        # its budget on the same failure
+        import time as time_mod
+
+        deadline = time_mod.monotonic() + 5.0
+        while time_mod.monotonic() < deadline:
+            entries = [self.driver.native.driver.map_entry(
+                failure.shuffle_id, m) for m in lost]
+            if any(e is None for e in entries):
+                break  # table gone = concurrent unregister/teardown; the
+                # torn-down signal handles the retry, don't hold
+                # _recover_lock for the full budget
+            if all(e[1] != dead for e in entries):
+                break
+            time_mod.sleep(0.005)
+        else:
+            log.warning("repair publishes for shuffle %d maps %s not "
+                        "visible within 5s; retries may re-fail",
+                        failure.shuffle_id, lost)
         for ex in self._live():
             try:
                 self._invalidate_on(ex, failure.shuffle_id)

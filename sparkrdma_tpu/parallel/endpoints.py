@@ -132,6 +132,17 @@ class DriverEndpoint:
         for conn, req_id, _, _ in waiters:
             self._answer_waiter(conn, M.FetchTableResp(req_id, -1, b""))
 
+    def map_entry(self, shuffle_id: int, map_id: int):
+        """Current (token, exec_index) for one map, or None. Lets an
+        in-process engine VERIFY a repair publish has landed: publishes
+        are one-sided (no ack, like the reference's RDMA WRITE into the
+        table), and the long-poll sync point only covers the publish
+        COUNT — a repair overwrite doesn't change the count, so recovery
+        must observe the entry itself."""
+        with self._tables_lock:
+            table = self._tables.get(shuffle_id)
+        return table.entry(map_id) if table is not None else None
+
     def members(self) -> List[ShuffleManagerId]:
         with self._members_lock:
             return list(self._members)
@@ -365,6 +376,11 @@ class ExecutorEndpoint:
         self._members_lock = threading.Lock()
         self._clients = ConnectionCache(self.conf, on_message=self._handle)
         self._table_cache: Dict[int, DriverTable] = {}
+        # invalidation generation per shuffle: a long-poll answered with a
+        # PRE-invalidation table must not re-memoize after the
+        # invalidation (stage recovery repaired the driver table; a stale
+        # re-cache would pin dead-slot locations for every later reader)
+        self._table_gen: Dict[int, int] = {}
         self._table_lock = threading.Lock()
         self.wire_bytes_in = 0  # compressed-on-the-wire fetch payload total
         self._wire_lock = threading.Lock()
@@ -573,6 +589,7 @@ class ExecutorEndpoint:
         call with a higher expectation never sees a stale partial table."""
         with self._table_lock:
             cached = self._table_cache.get(shuffle_id)
+            gen = self._table_gen.get(shuffle_id, 0)
         if cached is not None and cached.num_published >= expect_published:
             return cached
         tmo = (timeout if timeout is not None
@@ -592,7 +609,11 @@ class ExecutorEndpoint:
                 table = DriverTable.from_bytes(resp.table)
                 if table.num_published == table.num_maps:
                     with self._table_lock:
-                        self._table_cache[shuffle_id] = table
+                        # memoize only if no invalidation raced this poll
+                        # (recovery may have repaired the driver table
+                        # after our response was cut)
+                        if self._table_gen.get(shuffle_id, 0) == gen:
+                            self._table_cache[shuffle_id] = table
                 return table
             if resp.num_published < 0:
                 # driver doesn't know the shuffle (unregistered mid-poll or
@@ -607,10 +628,14 @@ class ExecutorEndpoint:
             # burst): re-arm the long-poll for the remaining budget
 
     def invalidate_shuffle(self, shuffle_id: int) -> None:
-        """Drop the memoized driver table (shuffle unregistered; ids can
-        be reused by the engine)."""
+        """Drop the memoized driver table (stage recovery repaired it, or
+        the shuffle unregistered; ids can be reused by the engine). Bumps
+        the generation so an in-flight long-poll answered with the
+        pre-invalidation table cannot re-memoize it."""
         with self._table_lock:
             self._table_cache.pop(shuffle_id, None)
+            self._table_gen[shuffle_id] = \
+                self._table_gen.get(shuffle_id, 0) + 1
 
     def fetch_output_range(self, peer: ShuffleManagerId, shuffle_id: int,
                            map_id: int, start: int, end: int):

@@ -124,7 +124,10 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
         raise ValueError("managers must include at least one executor role")
     table = endpoint_mgr.executor.get_driver_table(
         handle.shuffle_id, expect_published=handle.num_maps)
-    by_slot = {m.executor.exec_index(): m for m in managers
+    # exec_index with a wait budget: the hello/announce is async, and a
+    # KeyError here would kill this process before the collective and
+    # strand every peer in the allgather
+    by_slot = {m.executor.exec_index(timeout=5): m for m in managers
                if m.executor is not None and m.resolver is not None}
     all_keys, all_payloads = [], []
     staged = np.zeros(handle.num_maps, dtype=np.int64)

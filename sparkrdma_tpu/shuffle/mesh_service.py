@@ -186,6 +186,7 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
                              rows_per_round: int = 1 << 18,
                              out_factor: int = 2,
                              expect_maps: Optional[int] = None,
+                             pipeline_rounds: bool = True,
                              ) -> List[Tuple[np.ndarray, np.ndarray,
                                              np.ndarray]]:
     """``run_mesh_reduce`` for datasets beyond one exchange's device (or
@@ -195,6 +196,14 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
     device's key-sorted round outputs merge O(N log R) via the tournament
     merge (`shuffle/external.py`). Same contract as ``run_mesh_reduce``
     with ``sort_by_key=True``.
+
+    ``pipeline_rounds``: double-buffer — round r+1 is decoded from the
+    spills, padded, and DISPATCHED (jax dispatch is async) before round
+    r's results are pulled back and unpacked, so host staging overlaps
+    the device exchange. The same inter-round pipeline the reference gets
+    from serving straight out of mmap'd registered memory while fetches
+    are in flight (java/RdmaMappedFile.java:163-189,
+    scala/RdmaShuffleFetcherIterator.scala:264-276).
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -214,7 +223,8 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
 
     runs: List[list] = [[] for _ in range(n_dev)]
 
-    def run_round(rows_np: np.ndarray) -> None:
+    def dispatch(rows_np: np.ndarray):
+        """Stage one round and launch its exchange; no blocking."""
         dest = (np.asarray(partitioner(
             rows_np[:, :2].copy().view(np.uint64).reshape(-1)),
             dtype=np.int32) % n_dev)
@@ -223,10 +233,12 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
         rows_p[:len(rows_np)] = rows_np
         dest_p = np.full(total_cap, -1, np.int32)
         dest_p[:len(rows_np)] = dest
-        received, counts, _ = jax.block_until_ready(exchange(
-            jax.device_put(rows_p, sharding),
-            jax.device_put(dest_p, sharding)))
         exchange_mod.record_exchange(len(rows_np))
+        return exchange(jax.device_put(rows_p, sharding),
+                        jax.device_put(dest_p, sharding))
+
+    def collect(results) -> None:
+        received, counts, _ = results  # np.asarray blocks on the device
         received = np.asarray(received).reshape(n_dev, -1, pw)
         counts = np.asarray(counts)
         if (counts.sum(axis=1) > cap * out_factor).any():
@@ -237,24 +249,41 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
             keys = got[:, :2].copy().view(np.uint64).reshape(-1)
             runs[d].append(got[np.argsort(keys, kind="stable")].copy())
 
-    # stage in rounds: buffer decoded batches up to one round's capacity
-    pending: List[np.ndarray] = []
-    pending_rows = 0
-    per_round = cap * n_dev
-    delivered: set = set()
-    for k, p in _iter_committed_batches(managers, handle, delivered):
-        rows = _rows_to_u32(k, p)
-        while len(rows):
-            take = min(len(rows), per_round - pending_rows)
-            pending.append(rows[:take])
-            pending_rows += take
-            rows = rows[take:]
-            if pending_rows == per_round:
-                run_round(np.concatenate(pending))
-                pending, pending_rows = [], 0
-    _check_staging_complete(delivered, expect_maps, handle.shuffle_id)
-    if pending_rows:
-        run_round(np.concatenate(pending))
+    def round_chunks():
+        """Yield round-sized row blocks streamed off the committed spills
+        (plus the completeness check once staging is exhausted)."""
+        pending: List[np.ndarray] = []
+        pending_rows = 0
+        per_round = cap * n_dev
+        delivered: set = set()
+        for k, p in _iter_committed_batches(managers, handle, delivered):
+            rows = _rows_to_u32(k, p)
+            while len(rows):
+                take = min(len(rows), per_round - pending_rows)
+                pending.append(rows[:take])
+                pending_rows += take
+                rows = rows[take:]
+                if pending_rows == per_round:
+                    yield np.concatenate(pending)
+                    pending, pending_rows = [], 0
+        _check_staging_complete(delivered, expect_maps, handle.shuffle_id)
+        if pending_rows:
+            yield np.concatenate(pending)
+
+    if pipeline_rounds:
+        # round r's exchange runs on-device while round r+1 stages on the
+        # host (decode + pad + partition) — one round in flight
+        in_flight = None
+        for chunk in round_chunks():
+            nxt = dispatch(chunk)
+            if in_flight is not None:
+                collect(in_flight)
+            in_flight = nxt
+        if in_flight is not None:
+            collect(in_flight)
+    else:
+        for chunk in round_chunks():
+            collect(dispatch(chunk))
 
     results = []
     for d in range(n_dev):
