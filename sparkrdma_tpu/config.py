@@ -85,6 +85,15 @@ _KEYS = [
          doc="Control RPC segment size (ref recvWrSize=4k)."),
     _Key("sw_flow_control", True, "bool",
          doc="Enable credit-based backpressure on the control plane (ref swFlowControl)."),
+    _Key("serve_credit_bytes", "32m", "bytes", 1 << 16, 1 << 40,
+         doc="TPU-only shape of ref swFlowControl credits: per-connection "
+             "window of logical response bytes a block server will hold "
+             "built-but-unconsumed; serving parks past it until the "
+             "reader's CreditReport replenishes."),
+    _Key("serve_threads", 4, "int", 1, 256,
+         doc="TPU-only: block-serving worker threads per executor "
+             "endpoint (responses build/send off the connection reader "
+             "thread so credit reports are never blocked behind data)."),
     # --- control plane endpooints (reference: driverHost/Port, executorPort 124-131)
     _Key("driver_host", "", "str", doc="Control-plane driver bind host."),
     _Key("driver_port", 0, "int", 0, 65535, doc="Control-plane driver port (0=ephemeral)."),

@@ -692,14 +692,16 @@ class DAGEngine:
         import time as time_mod
 
         deadline = time_mod.monotonic() + 5.0
+        drv = self.driver.native.driver
         while time_mod.monotonic() < deadline:
-            entries = [self.driver.native.driver.map_entry(
-                failure.shuffle_id, m) for m in lost]
-            if any(e is None for e in entries):
+            if not drv.has_shuffle(failure.shuffle_id):
                 break  # table gone = concurrent unregister/teardown; the
                 # torn-down signal handles the retry, don't hold
                 # _recover_lock for the full budget
-            if all(e[1] != dead for e in entries):
+            entries = [drv.map_entry(failure.shuffle_id, m) for m in lost]
+            # None here = entry not yet (re)published — keep waiting; it
+            # is NOT the teardown case (has_shuffle covered that)
+            if all(e is not None and e[1] != dead for e in entries):
                 break
             time_mod.sleep(0.005)
         else:

@@ -133,15 +133,20 @@ class DriverEndpoint:
             self._answer_waiter(conn, M.FetchTableResp(req_id, -1, b""))
 
     def map_entry(self, shuffle_id: int, map_id: int):
-        """Current (token, exec_index) for one map, or None. Lets an
-        in-process engine VERIFY a repair publish has landed: publishes
-        are one-sided (no ack, like the reference's RDMA WRITE into the
-        table), and the long-poll sync point only covers the publish
-        COUNT — a repair overwrite doesn't change the count, so recovery
-        must observe the entry itself."""
+        """Current (token, exec_index) for one map, or None (unpublished
+        OR unknown shuffle — use :meth:`has_shuffle` to tell apart). Lets
+        an in-process engine VERIFY a repair publish has landed:
+        publishes are one-sided (no ack, like the reference's RDMA WRITE
+        into the table), and the long-poll sync point only covers the
+        publish COUNT — a repair overwrite doesn't change the count, so
+        recovery must observe the entry itself."""
         with self._tables_lock:
             table = self._tables.get(shuffle_id)
         return table.entry(map_id) if table is not None else None
+
+    def has_shuffle(self, shuffle_id: int) -> bool:
+        with self._tables_lock:
+            return shuffle_id in self._tables
 
     def members(self) -> List[ShuffleManagerId]:
         with self._members_lock:
@@ -353,6 +358,74 @@ class DriverEndpoint:
         self.server.stop()
 
 
+class ByteCredits:
+    """Per-connection serving window: logical response bytes the server
+    may hold built-and-undelivered (the receiver-driven flow control of
+    java/RdmaChannel.java:61-64, 744-787 — credits granted by the recv
+    window, replenished by the reader's CreditReport on receipt).
+
+    Parking is QUEUED, not blocking: a request that doesn't fit enqueues
+    a resume callback and frees its serving thread, so one stalled
+    connection can never head-of-line-block the shared serving pool.
+    ``release`` re-admits parked requests FIFO with their reservation
+    already taken. A single request larger than the whole window is
+    charged the full window, so one oversized block can never deadlock.
+    """
+
+    def __init__(self, budget: int):
+        self.budget = budget
+        self._avail = budget
+        self._lock = threading.Lock()
+        self._parked_q: list = []  # [(need, deadline, resume, expire)]
+        self.peak_reserved = 0  # audit: worst-case held bytes
+        self.parked = 0         # audit: requests that had to wait
+
+    def try_reserve(self, nbytes: int) -> bool:
+        need = min(nbytes, self.budget)
+        with self._lock:
+            # FIFO fairness: never jump a parked queue
+            if self._parked_q or self._avail < need:
+                return False
+            self._avail -= need
+            self.peak_reserved = max(self.peak_reserved,
+                                     self.budget - self._avail)
+        return True
+
+    def park(self, nbytes: int, deadline: float, resume, expire) -> None:
+        """``resume()`` fires (off this thread) once the reservation has
+        been taken on the request's behalf; ``expire()`` fires if the
+        deadline passes first (swept by the endpoint)."""
+        with self._lock:
+            self._parked_q.append((min(nbytes, self.budget), deadline,
+                                   resume, expire))
+            self.parked += 1
+
+    def release(self, nbytes: int) -> None:
+        resumes = []
+        with self._lock:
+            self._avail = min(self.budget,
+                              self._avail + min(nbytes, self.budget))
+            while self._parked_q and self._avail >= self._parked_q[0][0]:
+                need, _, resume, _ = self._parked_q.pop(0)
+                self._avail -= need
+                self.peak_reserved = max(self.peak_reserved,
+                                         self.budget - self._avail)
+                resumes.append(resume)
+        for resume in resumes:
+            resume()
+
+    def expire_stale(self, now: float) -> list:
+        """Pop parked entries past their deadline; returns their expire
+        callbacks for the caller to run."""
+        expired = []
+        with self._lock:
+            keep = []
+            for item in self._parked_q:
+                (expired if item[1] <= now else keep).append(item)
+            self._parked_q = keep
+        return [item[3] for item in expired]
+
+
 class ExecutorEndpoint:
     """Control-plane executor: serves peers, talks to the driver."""
 
@@ -376,11 +449,15 @@ class ExecutorEndpoint:
         self._members_lock = threading.Lock()
         self._clients = ConnectionCache(self.conf, on_message=self._handle)
         self._table_cache: Dict[int, DriverTable] = {}
-        # invalidation generation per shuffle: a long-poll answered with a
+        # invalidation generation: a long-poll answered with a
         # PRE-invalidation table must not re-memoize after the
         # invalidation (stage recovery repaired the driver table; a stale
-        # re-cache would pin dead-slot locations for every later reader)
-        self._table_gen: Dict[int, int] = {}
+        # re-cache would pin dead-slot locations for every later reader).
+        # One endpoint-wide counter: an invalidation of ANY shuffle skips
+        # memoizing concurrently-in-flight polls — at worst one extra
+        # table fetch later, and O(1) state instead of a per-shuffle-id
+        # dict that grows forever
+        self._table_gen = 0
         self._table_lock = threading.Lock()
         self.wire_bytes_in = 0  # compressed-on-the-wire fetch payload total
         self._wire_lock = threading.Lock()
@@ -392,6 +469,24 @@ class ExecutorEndpoint:
         # see sparkrdma_tpu/tasks.py)
         self._task_runner = None
         self._task_pool = None
+        # receiver-driven serving flow control: per-connection byte
+        # windows + a serving pool so data responses build/park OFF the
+        # reader thread (a parked reader could never receive the very
+        # CreditReport that would unpark it)
+        import weakref
+
+        self._serve_pool = None
+        self._serve_pool_lock = threading.Lock()
+        self._park_sweeper = None
+        self._conn_credits = weakref.WeakKeyDictionary()
+        self._credits_lock = threading.Lock()
+        self._credit_timeouts = 0
+        # client side: logical sizes of in-flight credited fetches, keyed
+        # by (conn identity, req_id) — consulted when a response arrives
+        # ORPHANED (its requester timed out) so its credits still get
+        # reported and the server's window heals
+        self._fetch_credit_pending: Dict[Tuple[int, int], int] = {}
+        self._fetch_credit_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -405,6 +500,8 @@ class ExecutorEndpoint:
     def stop(self) -> None:
         if self._task_pool is not None:
             self._task_pool.shutdown(wait=False, cancel_futures=True)
+        if self._serve_pool is not None:
+            self._serve_pool.shutdown(wait=False, cancel_futures=True)
         self._clients.close_all()
         self.server.stop()
 
@@ -463,7 +560,16 @@ class ExecutorEndpoint:
         if isinstance(msg, M.FetchOutputReq):
             return self._on_fetch_output(msg)
         if isinstance(msg, M.FetchBlocksReq):
-            return self._on_fetch_blocks(msg)
+            if not self.conf.sw_flow_control:
+                return self._on_fetch_blocks(msg)
+            self._serve_blocks_async(conn, msg)
+            return None
+        if isinstance(msg, M.CreditReport):
+            self._credits_of(conn).release(msg.consumed)
+            return None
+        if isinstance(msg, M.FetchBlocksResp):
+            self._on_orphan_blocks_resp(conn, msg)
+            return None
         if isinstance(msg, M.RunTaskReq):
             return self._on_run_task(conn, msg)
         log.warning("%s: unexpected %s", self.manager_id.executor_id.executor,
@@ -531,6 +637,115 @@ class ExecutorEndpoint:
     _MAX_RESP_PAYLOAD = 256 << 20
     _MAX_SINGLE_BLOCK = (1 << 30) - (1 << 20)
 
+    def _credits_of(self, conn: Connection) -> ByteCredits:
+        with self._credits_lock:
+            credits = self._conn_credits.get(conn)
+            if credits is None:
+                credits = ByteCredits(self.conf.serve_credit_bytes)
+                self._conn_credits[conn] = credits
+            return credits
+
+    def serve_stats(self) -> dict:
+        """Audit view of the serving windows (tests assert a stalled
+        consumer bounds server-held bytes; ops dashboards watch parking)."""
+        with self._credits_lock:
+            creds = list(self._conn_credits.values())
+        return {
+            "budget": self.conf.serve_credit_bytes,
+            "peak_reserved": max((c.peak_reserved for c in creds),
+                                 default=0),
+            "parked": sum(c.parked for c in creds),
+            "credit_timeouts": self._credit_timeouts,
+        }
+
+    def _serve_blocks_async(self, conn: Connection,
+                            msg: M.FetchBlocksReq) -> None:
+        if self._serve_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._serve_pool_lock:
+                if self._serve_pool is None:
+                    self._serve_pool = ThreadPoolExecutor(
+                        max_workers=self.conf.serve_threads,
+                        thread_name_prefix=(
+                            f"serve-{self.manager_id.executor_id.executor}"))
+        self._serve_pool.submit(self._serve_blocks, conn, msg)
+
+    def _serve_blocks(self, conn: Connection, msg: M.FetchBlocksReq) -> None:
+        """One data response under the connection's credit window: reserve
+        the response's logical size BEFORE building it, send, and let the
+        reader's CreditReport — sent on receipt — replenish. A request
+        that doesn't fit parks as a QUEUED continuation (the serving
+        thread is freed; a stalled connection can't head-of-line-block
+        other connections' serving), expiring with STATUS_ERROR after the
+        park timeout instead of growing server memory."""
+        credits = self._credits_of(conn)
+        total = sum(length for _, _, length in msg.blocks)
+        if credits.try_reserve(total):
+            self._serve_reserved(credits, conn, msg, total)
+            return
+
+        def resume():  # reservation already taken by release()
+            self._serve_pool.submit(self._serve_reserved, credits, conn,
+                                    msg, total)
+
+        def expire():
+            self._credit_timeouts += 1
+            log.warning("fetch parked past the credit window for %.1fs; "
+                        "failing it (consumer stalled?)",
+                        self.conf.connect_timeout_ms / 1000)
+            try:
+                conn.send(M.FetchBlocksResp(msg.req_id, M.STATUS_ERROR,
+                                            b""))
+            except TransportError:
+                pass
+
+        credits.park(total,
+                     time.monotonic() + self.conf.connect_timeout_ms / 1000,
+                     resume, expire)
+        self._ensure_park_sweeper()
+
+    def _serve_reserved(self, credits: ByteCredits, conn: Connection,
+                        msg: M.FetchBlocksReq, total: int) -> None:
+        try:
+            resp = self._on_fetch_blocks(msg)
+        except Exception:  # noqa: BLE001 — serving thread must not die
+            credits.release(total)
+            log.exception("block serving failed")
+            return
+        delivered = False
+        try:
+            conn.send(resp)
+            delivered = True
+        except TransportError:
+            pass
+        # non-OK responses carry no data (no report will come) and a dead
+        # connection never reports: hand those credits straight back
+        if resp.status != M.STATUS_OK or not delivered:
+            credits.release(total)
+
+    def _ensure_park_sweeper(self) -> None:
+        with self._serve_pool_lock:
+            if self._park_sweeper is None:
+                self._park_sweeper = threading.Thread(
+                    target=self._sweep_parked, daemon=True,
+                    name=f"park-sweep-"
+                         f"{self.manager_id.executor_id.executor}")
+                self._park_sweeper.start()
+
+    def _sweep_parked(self) -> None:
+        while not self.server.stopped:
+            time.sleep(0.2)
+            now = time.monotonic()
+            with self._credits_lock:
+                creds = list(self._conn_credits.values())
+            for credits in creds:
+                for expire in credits.expire_stale(now):
+                    try:
+                        expire()
+                    except Exception:  # noqa: BLE001 — sweeper must live
+                        log.exception("park expiry callback failed")
+
     def _on_fetch_blocks(self, msg: M.FetchBlocksReq) -> RpcMsg:
         """Serve a scatter data read (DCN fallback of the one-sided READ,
         scala/RdmaShuffleFetcherIterator.scala:119-180)."""
@@ -589,7 +804,7 @@ class ExecutorEndpoint:
         call with a higher expectation never sees a stale partial table."""
         with self._table_lock:
             cached = self._table_cache.get(shuffle_id)
-            gen = self._table_gen.get(shuffle_id, 0)
+            gen = self._table_gen
         if cached is not None and cached.num_published >= expect_published:
             return cached
         tmo = (timeout if timeout is not None
@@ -612,7 +827,7 @@ class ExecutorEndpoint:
                         # memoize only if no invalidation raced this poll
                         # (recovery may have repaired the driver table
                         # after our response was cut)
-                        if self._table_gen.get(shuffle_id, 0) == gen:
+                        if self._table_gen == gen:
                             self._table_cache[shuffle_id] = table
                 return table
             if resp.num_published < 0:
@@ -634,8 +849,7 @@ class ExecutorEndpoint:
         pre-invalidation table cannot re-memoize it."""
         with self._table_lock:
             self._table_cache.pop(shuffle_id, None)
-            self._table_gen[shuffle_id] = \
-                self._table_gen.get(shuffle_id, 0) + 1
+            self._table_gen += 1
 
     def fetch_output_range(self, peer: ShuffleManagerId, shuffle_id: int,
                            map_id: int, start: int, end: int):
@@ -646,6 +860,54 @@ class ExecutorEndpoint:
         if resp.status != M.STATUS_OK:
             raise TransportError(f"fetch_output status={resp.status}")
         return MapTaskOutput.locations_from_range(resp.entries)
+
+    def _credited_request(self, conn: Connection,
+                          req: "M.FetchBlocksReq", credited: bool) -> RpcMsg:
+        """``conn.request`` with receipt-credit accounting: on an OK
+        response, report the request's logical size so the server's
+        serving window replenishes (the server freed its copy the moment
+        we have ours). The pending entry is keyed by (conn, req_id) so a
+        response that arrives ORPHANED — our wait timed out but the
+        server's send succeeded — still gets its report from the
+        unsolicited-message path instead of leaking window forever.
+        Native block-server responses aren't credited (``credited=False``
+        there; that path has its own caps)."""
+        if not (credited and self.conf.sw_flow_control):
+            return conn.request(req)
+        total = sum(length for _, _, length in req.blocks)
+        key = (id(conn), req.req_id)
+        with self._fetch_credit_lock:
+            self._fetch_credit_pending[key] = total
+        try:
+            resp = conn.request(req)
+        except TransportError:
+            # conn is dead: no orphan will ever arrive, and the server
+            # releases on its own failed send
+            with self._fetch_credit_lock:
+                self._fetch_credit_pending.pop(key, None)
+            raise
+        with self._fetch_credit_lock:
+            pending = self._fetch_credit_pending.pop(key, None)
+        if pending is not None and resp.status == M.STATUS_OK:
+            try:
+                conn.send(M.CreditReport(pending))
+            except TransportError:
+                pass  # conn died post-response; server releases on its own
+        return resp
+
+    def _on_orphan_blocks_resp(self, conn: Connection,
+                               msg: "M.FetchBlocksResp") -> None:
+        """A data response whose requester gave up waiting: its Future is
+        gone, but the server is still holding window for it — report the
+        credits it carried."""
+        with self._fetch_credit_lock:
+            total = self._fetch_credit_pending.pop((id(conn), msg.req_id),
+                                                   None)
+        if total is not None and msg.status == M.STATUS_OK:
+            try:
+                conn.send(M.CreditReport(total))
+            except TransportError:
+                pass
 
     def fetch_blocks(self, peer: ShuffleManagerId, shuffle_id: int,
                      blocks) -> bytes:
@@ -660,7 +922,8 @@ class ExecutorEndpoint:
                 else peer.rpc_port)
         conn = self._clients.get(peer.rpc_host, port)
         req = M.FetchBlocksReq(conn.next_req_id(), shuffle_id, blocks)
-        resp = conn.request(req)
+        resp = self._credited_request(conn, req,
+                                      credited=port == peer.rpc_port)
         assert isinstance(resp, M.FetchBlocksResp)
         if resp.status == M.STATUS_BAD_RANGE and port != peer.rpc_port:
             # only the size-cap case is worth retrying: the native server
@@ -668,9 +931,10 @@ class ExecutorEndpoint:
             # Other statuses (unknown token/shuffle) would fail identically
             # on the control connection — retrying would just double the
             # failure-path load during an executor-loss storm
-            conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+            port = peer.rpc_port
+            conn = self._clients.get(peer.rpc_host, port)
             req = M.FetchBlocksReq(conn.next_req_id(), shuffle_id, blocks)
-            resp = conn.request(req)
+            resp = self._credited_request(conn, req, credited=True)
             assert isinstance(resp, M.FetchBlocksResp)
         if resp.status != M.STATUS_OK:
             raise TransportError(f"fetch_blocks status={resp.status}")

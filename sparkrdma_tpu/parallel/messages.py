@@ -236,6 +236,29 @@ class RunTaskResp(RpcMsg):
         return cls(req_id, status, payload[12:])
 
 
+@register(13)
+class CreditReport(RpcMsg):
+    """Reader -> server: ``consumed`` logical response bytes were drained
+    by the consumer — replenish that much of this connection's serving
+    credit window. The receiver-driven half of flow control: the server
+    reserves a response's logical size from the window before building it
+    and PARKS when the window is exhausted, so a stalled consumer bounds
+    the server's queued response bytes instead of growing them
+    (java/RdmaChannel.java:61-64, 744-787 — credits granted by recv queue
+    depth, replenished by credit reports every recvDepth/8 reclaims)."""
+
+    def __init__(self, consumed: int):
+        self.consumed = consumed
+
+    def payload(self) -> bytes:
+        return _Q.pack(self.consumed)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "CreditReport":
+        (consumed,) = _Q.unpack_from(payload, 0)
+        return cls(consumed)
+
+
 # Status codes shared by responses.
 STATUS_OK = 0
 STATUS_UNKNOWN_SHUFFLE = 1

@@ -1,0 +1,181 @@
+"""Receiver-driven serving flow control (java/RdmaChannel.java:61-64,
+744-787 re-design): the server reserves each data response's logical size
+from a per-connection credit window BEFORE building it, parks when the
+window is exhausted, and the reader's receipt CreditReport replenishes.
+A stalled consumer therefore BOUNDS server-held response bytes instead of
+growing them — audited here via the endpoint's serve_stats."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+
+BLOCK = 64 << 10          # per-partition block ~64 KiB
+WINDOW = 256 << 10        # tiny serving window: 4 blocks
+
+
+def _cluster(tmp_path, **conf_kw):
+    conf_kw.setdefault("connect_timeout_ms", 3000)
+    conf = TpuShuffleConf(**conf_kw)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(2)]
+    for ex in execs:
+        ex.executor.wait_for_members(2)
+    return driver, execs
+
+
+def _write_shuffle(driver, execs, shuffle_id, num_partitions=16,
+                   rows_per_map=None):
+    """One map output on executor 0 with ~BLOCK bytes per partition."""
+    payload_w = 96  # 8B key + 96B payload
+    rows_per_part = BLOCK // (8 + payload_w)
+    handle = driver.register_shuffle(shuffle_id, 1, num_partitions,
+                                     PartitionerSpec("modulo"),
+                                     row_payload_bytes=payload_w)
+    rng = np.random.default_rng(shuffle_id)
+    keys = np.repeat(np.arange(num_partitions, dtype=np.uint64),
+                     rows_per_part)
+    w = execs[0].get_writer(handle, 0)
+    w.write_batch(keys, rng.integers(0, 255, (len(keys), payload_w),
+                                     dtype=np.uint64).astype(np.uint8))
+    w.close()
+    return handle
+
+
+def test_stalled_consumers_bound_server_memory(tmp_path):
+    """Eight concurrent readers share the peer connection and all stall
+    (their consumers never drain): server-held response bytes are bounded
+    by the credit window — the ledger reserves BEFORE building, so
+    peak_reserved <= window is the memory bound — serving demonstrably
+    parks, and once consumers drain everything completes exactly."""
+    driver, execs = _cluster(
+        tmp_path, serve_credit_bytes=WINDOW,
+        # small grouped reads so many requests are needed
+        shuffle_read_block_size=BLOCK,
+        # a huge client-side gate so the CLIENT does not throttle — the
+        # server's own window must do the bounding
+        max_bytes_in_flight=1 << 30,
+        use_cpp_runtime=False)
+    try:
+        handle = _write_shuffle(driver, execs, 1, num_partitions=32)
+        n_readers = 8
+        iters, started = [], []
+        for r in range(n_readers):
+            reader = execs[1].get_reader(handle, 0, 32)
+            it = iter(reader.read())
+            iters.append(it)
+            t = threading.Thread(target=lambda i=it: next(i), daemon=True)
+            t.start()
+            started.append(t)
+        for t in started:
+            t.join(timeout=10)
+        time.sleep(1.0)  # all 8 stalled; their fetchers keep requesting
+        stats = execs[0].executor.serve_stats()
+        assert stats["peak_reserved"] <= WINDOW, stats  # THE memory bound
+        assert stats["parked"] > 0, \
+            f"window never exerted backpressure: {stats}"
+        # drain everyone — credits replenish and every row arrives
+        want = 32 * (BLOCK // (8 + 96))
+        rows_per_part = BLOCK // (8 + 96)
+        for it in iters:
+            got = sum(len(k) for k, _ in it)
+            assert got >= want - rows_per_part  # minus the batch next() ate
+        stats = execs[0].executor.serve_stats()
+        assert stats["credit_timeouts"] == 0
+        assert stats["peak_reserved"] <= WINDOW
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
+
+
+def test_credit_starved_fetch_fails_not_hangs(tmp_path):
+    """A consumer that NEVER replenishes (stall past the park timeout)
+    gets STATUS_ERROR on its excess fetches instead of wedging the server;
+    the failure surfaces as the ordinary retryable fetch error."""
+    driver, execs = _cluster(
+        tmp_path, serve_credit_bytes=BLOCK,  # window = ONE block
+        shuffle_read_block_size=BLOCK, max_bytes_in_flight=1 << 30,
+        connect_timeout_ms=1500, use_cpp_runtime=False)
+    try:
+        handle = _write_shuffle(driver, execs, 2, num_partitions=8)
+        # raw pipelined requests with NO credit reports: grab locations,
+        # then fire several block fetches through the wire layer directly
+        peer = execs[1].executor.member_at(
+            execs[0].executor.exec_index(timeout=2))
+        locs = execs[1].executor.fetch_output_range(peer, 2, 0, 0, 8)
+        conn = execs[1].executor._clients.get(peer.rpc_host, peer.rpc_port)
+        futures = []
+        from concurrent.futures import ThreadPoolExecutor
+
+        def raw_fetch(loc):
+            req = M.FetchBlocksReq(conn.next_req_id(), 2,
+                                   [(loc.buf, loc.offset, loc.length)])
+            return conn.request(req, timeout=10)
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futures = [pool.submit(raw_fetch, loc) for loc in locs]
+            statuses = [f.result().status for f in futures]
+        ok = statuses.count(M.STATUS_OK)
+        errs = statuses.count(M.STATUS_ERROR)
+        # exactly one window's worth can be served; the rest park until
+        # the timeout and fail cleanly
+        assert ok >= 1
+        assert errs >= 1, f"no credit starvation surfaced: {statuses}"
+        assert ok + errs == len(statuses)
+        stats = execs[0].executor.serve_stats()
+        assert stats["credit_timeouts"] >= errs
+        assert stats["peak_reserved"] <= BLOCK
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
+
+
+def test_healthy_peer_unaffected_by_stalled_peer(tmp_path):
+    """Credit windows are per connection: one stalled reader exhausting
+    its window must not slow a healthy reader on another connection."""
+    driver, execs = _cluster(
+        tmp_path, serve_credit_bytes=WINDOW,
+        shuffle_read_block_size=BLOCK, max_bytes_in_flight=1 << 30,
+        use_cpp_runtime=False)
+    try:
+        handle = _write_shuffle(driver, execs, 3, num_partitions=32)
+        stalled = execs[1].get_reader(handle, 0, 32)
+        it = iter(stalled.read())
+        next(it)  # start, then stall (don't drain)
+        time.sleep(0.3)
+        # the "healthy peer": executor 0 reading its own spills would be
+        # local; instead re-read from executor 1 via a FRESH manager whose
+        # connection (and window) is its own
+        healthy = TpuShuffleManager(
+            TpuShuffleConf(connect_timeout_ms=3000,
+                           serve_credit_bytes=WINDOW,
+                           shuffle_read_block_size=BLOCK,
+                           use_cpp_runtime=False),
+            driver_addr=driver.driver_addr, executor_id="h",
+            spill_dir=str(tmp_path / "h"))
+        healthy.executor.wait_for_members(3)
+        try:
+            t0 = time.monotonic()
+            keys, _ = healthy.get_reader(handle, 0, 32).read_all()
+            dt = time.monotonic() - t0
+            assert len(keys) == 32 * (BLOCK // (8 + 96))
+            assert dt < 5.0, f"healthy reader throttled by stalled peer ({dt:.1f}s)"
+        finally:
+            healthy.stop()
+        # drain the stalled reader so teardown is clean
+        for _ in it:
+            pass
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
