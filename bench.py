@@ -16,15 +16,19 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
 
 # Last hardware-verified number, for the fallback record when the TPU
-# tunnel is down (v5e single chip, TeraSort 1 GiB, round-1 commit 341318a).
-LAST_KNOWN_GOOD = {"value": 2.164, "unit": "GB/s/chip", "vs_baseline": 32.0,
+# tunnel is down (v5e single chip, TeraSort 1 GiB gather mode, measured
+# round 3 via scripts/tpu_probe_bench.py: 5 steps, best 0.495s, before
+# the tunnel wedged; phase breakdown: sort(key,iota) 8.5 ns/row + row
+# gather 28.8 ns/row, scripts/tpu_micro.py same session).
+LAST_KNOWN_GOOD = {"value": 2.169, "unit": "GB/s/chip", "vs_baseline": 32.0,
                    "platform": "tpu v5e single chip",
-                   "provenance": "round-1 commit 341318a"}
+                   "provenance": "round-3 scripts/tpu_probe_bench.py"}
 
 
 def _probe_device(timeout_s: int = 60) -> tuple[str | None, str]:
@@ -54,48 +58,114 @@ def _probe_device(timeout_s: int = 60) -> tuple[str | None, str]:
                      proc.stderr.decode(errors="replace")[-300:]))
 
 
+def _run_inner(env: dict, mode: str, timeout_s: int,
+               light: bool) -> tuple[Optional[dict], str]:
+    """One inner bench run pinned to a sort mode; returns (result, failure).
+
+    ``light`` strips everything the first mode's run already produced
+    (secondary workloads, the numpy CPU baseline) so the follow-up mode's
+    budget is spent on its own compile+steps, not duplicate work."""
+    env = dict(env)
+    env["BENCH_INNER"] = "1"
+    env["BENCH_SORT_MODE"] = mode
+    if light:
+        env["BENCH_LIGHT"] = "1"
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, capture_output=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"{mode}: timeout after {timeout_s}s"
+    line = next((ln for ln in proc.stdout.decode().splitlines()
+                 if ln.startswith("{")), None)
+    if proc.returncode == 0 and line:
+        return json.loads(line), ""
+    # a crash is a CODE problem, not hardware unavailability — keep the
+    # evidence distinguishable from a tunnel hang
+    return None, (f"{mode}: exit={proc.returncode}: "
+                  + proc.stderr.decode(errors="replace")[-400:])
+
+
 def _run_with_watchdog() -> int:
-    """Run the real bench in a subprocess with a hard timeout.
+    """Run the real bench in per-mode subprocesses with hard timeouts.
 
     The TPU tunnel can wedge in ways that hang the first device op forever
-    (observed: a prior OOM leaves even trivial jit calls blocking). A hung
-    bench would stall the whole evaluation pipeline; we fast-probe the
-    device first (<=60s) and, when it is wedged, emit the one JSON line
-    from a CPU-mesh fallback run immediately — clearly marked, carrying the
-    probe evidence and the last hardware-verified number — so the record
-    says 'hardware unavailable' in <2 min instead of after a 540s hang.
+    (observed: a prior OOM leaves even trivial jit calls blocking), and one
+    sort mode's compile can be pathologically slow (multisort's 26-operand
+    sort network: ~16s/operand cold — round 2 lost its whole hardware
+    record to that single compile). So: fast-probe the device (<=60s),
+    then run EACH sort mode in its own subprocess with its own budget —
+    one mode hanging costs its budget, not the record. The persistent XLA
+    compilation cache (enabled in main()) makes warm reruns cheap.
     """
     env = dict(os.environ)
-    env["BENCH_INNER"] = "1"
-    timeout_s = int(env.get("BENCH_TIMEOUT_S", "540"))
     probe_s = int(env.get("BENCH_PROBE_TIMEOUT_S", "60"))
+    mode_timeout_s = int(env.get("BENCH_TIMEOUT_S", "540"))
     platform, probe_failure = _probe_device(probe_s)
     if platform is None:
-        return _emit_cpu_fallback(env, timeout_s,
+        return _emit_cpu_fallback(env, mode_timeout_s,
                                   probe_failure + "; full bench skipped")
     if platform != "tpu":
         # live backend but no accelerator: the headline metric would be a
         # CPU number dressed as a hardware one — keep the record marked
         return _emit_cpu_fallback(
-            env, timeout_s,
+            env, mode_timeout_s,
             f"default jax backend is '{platform}' (no TPU); full-size "
             "hardware bench not applicable")
-    failure = "unknown"
-    try:
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, capture_output=True, timeout=timeout_s)
-        line = next((ln for ln in proc.stdout.decode().splitlines()
-                     if ln.startswith("{")), None)
-        if proc.returncode == 0 and line:
-            print(line)
-            return 0
-        # a crash is a CODE problem, not hardware unavailability — keep the
-        # evidence distinguishable from a tunnel hang
-        failure = (f"exit={proc.returncode}: "
-                   + proc.stderr.decode(errors="replace")[-400:])
-    except subprocess.TimeoutExpired:
-        failure = f"timeout after {timeout_s}s (tunnel hang)"
-    return _emit_cpu_fallback(env, timeout_s, failure)
+    results: dict = {}
+    failures = []
+    # multisort's 26-operand sort network never finished a cold compile
+    # within 900s on the XLA:TPU compiler; it is only worth attempting
+    # when the persistent cache already holds it (or the operator grants
+    # a bigger budget via BENCH_TIMEOUT_MULTISORT_S).
+    ms_timeout_s = int(env.get("BENCH_TIMEOUT_MULTISORT_S",
+                               str(mode_timeout_s)))
+    plan = [("gather", mode_timeout_s), ("multisort", ms_timeout_s)]
+    if env.get("BENCH_SORT_MODE"):
+        # operator pinned a mode: run exactly that one (e.g. skipping the
+        # multisort attempt entirely when its compile isn't cached yet),
+        # with the mode's own budget knob still honored
+        pinned = env["BENCH_SORT_MODE"]
+        plan = [(pinned,
+                 ms_timeout_s if pinned == "multisort" else mode_timeout_s)]
+    for i, (mode, budget) in enumerate(plan):
+        res, failure = _run_inner(env, mode, budget, light=(i > 0))
+        if res is not None:
+            results[mode] = res
+        else:
+            failures.append(failure)
+    if not results:
+        return _emit_cpu_fallback(env, mode_timeout_s, "; ".join(failures))
+    best_mode = max(results, key=lambda m: results[m]["value"])
+    result = results[best_mode]
+    detail = result["detail"]
+    # a light (follow-up) winner carries no baseline or secondary metrics
+    # of its own: merge them in from the full run's record so a multisort
+    # win doesn't silently drop the gather subprocess's measurements
+    full = next((r for r in results.values()
+                 if r["detail"].get("cpu_baseline_s")), None)
+    if full is not None and full is not result:
+        for key, val in full["detail"].items():
+            if detail.get(key) is None:  # missing or a light run's null
+                detail[key] = val
+        if not result.get("vs_baseline"):
+            result["vs_baseline"] = round(
+                detail["cpu_baseline_s"] / detail["tpu_step_s"], 3)
+    if full is None:
+        detail["secondary_missing"] = (
+            "secondary workloads run only in the first (full) mode's "
+            "subprocess, which did not produce a record")
+    detail["sort_mode"] = best_mode
+    detail["sort_mode_step_s"] = {
+        m: r["detail"]["sort_mode_step_s"][m] for m, r in results.items()}
+    detail["sort_mode_gbps"] = {m: r["value"] for m, r in results.items()}
+    for m, r in results.items():
+        lat = r["detail"].get("tpu_step_latency_s")
+        if lat is not None:
+            detail.setdefault("sort_mode_latency_s", {})[m] = lat
+    if failures:
+        detail["mode_failures"] = failures
+    print(json.dumps(result))
+    return 0
 
 
 def _emit_cpu_fallback(env: dict, timeout_s: int, failure: str) -> int:
@@ -158,6 +228,12 @@ def _bench_secondary(detail: dict, prefix: str, rate_key: str, build,
         detail[prefix + "_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
+def _progress(msg: str) -> None:
+    """Stall forensics: timestamped stderr milestones (stderr is surfaced
+    by the watchdog on timeout, so a hung phase names itself)."""
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
 def main() -> None:
     size_mb = int(os.environ.get("BENCH_SIZE_MB", "1024"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
@@ -168,6 +244,17 @@ def main() -> None:
         _pin_virtual_cpu(8)
 
     import jax
+
+    # Persistent compilation cache: the 26-operand multisort network costs
+    # ~400s to compile cold on the XLA:TPU compiler but replays from cache
+    # in seconds (verified across processes on the axon backend) — without
+    # this, one cold compile eats the whole per-mode budget.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from jax.sharding import Mesh
 
     from sparkrdma_tpu.models.terasort import (
@@ -194,7 +281,9 @@ def main() -> None:
     modes = ([env_mode] if env_mode
              else ["gather", "multisort"] if on_tpu else ["gather"])
     per_mode = {}
+    per_mode_latency = {}
     rows = rows_d = None
+    _progress(f"inner start: devices={n} platform={devs[0].platform} modes={modes}")
     for mode in modes:
         mode_cfg = TeraSortConfig(rows_per_device=rows_per_device,
                                   payload_words=24, out_factor=out_factor,
@@ -202,21 +291,44 @@ def main() -> None:
         if rows is None:
             rows = generate_rows(mode_cfg, n, seed=0)
             rows_d = jax.device_put(rows, NamedSharding(mesh, P("shuffle")))
+            _progress("device_put done")
         step = make_terasort_step(mesh, "shuffle", mode_cfg)
         # Warm until steady: under remote-compile backends the first
         # dispatch's block_until_ready can return before compilation
         # finishes, so warmup must materialize host-side, twice.
-        for _ in range(2):
+        for i in range(2):
             _, counts, _of = step(rows_d)
             np.asarray(counts)
+            _progress(f"{mode}: warmup {i} done")
+        # per-step latency: host-synced each step (includes one tunnel
+        # round trip — the single-round cost a caller sees)
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            out, counts, overflowed = jax.block_until_ready(step(rows_d))
+            out, counts, overflowed = step(rows_d)
+            np.asarray(counts)
             times.append(time.perf_counter() - t0)
+        # steady-state throughput: keep TWO steps in flight (double
+        # buffering), syncing step i-1 while step i runs — the per-step
+        # tunnel round trip amortizes away, exactly as it does in the
+        # pipelined streamed runs (run_terasort_streamed). Depth is capped
+        # at 2 on purpose: unbounded dispatch queues reps x (output +
+        # sort workspace) on the device at once, which OOMed the chip at
+        # the 1 GiB scale — and an OOM wedges the axon tunnel for good.
+        t0 = time.perf_counter()
+        prev = None
+        for _ in range(reps):
+            out, counts, overflowed = step(rows_d)
+            if prev is not None:
+                np.asarray(prev)
+            prev = counts
+        np.asarray(prev)
+        pipelined = (time.perf_counter() - t0) / reps
+        _progress(f"{mode}: timed latency={min(times):.3f}s pipelined={pipelined:.3f}s")
         assert not np.asarray(overflowed).any(), \
             "receive-buffer overflow in bench"
-        per_mode[mode] = min(times)
+        per_mode[mode] = pipelined
+        per_mode_latency[mode] = min(times)
     best_mode = min(per_mode, key=per_mode.get)
     tpu_dt = per_mode[best_mode]
     total_bytes = rows.nbytes
@@ -230,22 +342,32 @@ def main() -> None:
     s_out, s_counts, _ = jax.block_until_ready(
         small_step(jax.device_put(small_rows, NamedSharding(mesh, P("shuffle")))))
     verify_terasort(np.asarray(s_out), np.asarray(s_counts), small_rows, n)
+    _progress("verify done")
 
-    # CPU baseline: identical pipeline, numpy, same data
-    t0 = time.perf_counter()
-    _ = numpy_terasort(rows, max(n, 8))
-    cpu_dt = time.perf_counter() - t0
+    light = os.environ.get("BENCH_LIGHT") == "1"
+    if light:
+        # a follow-up mode run: the first mode's subprocess already timed
+        # the (mode-independent) numpy baseline; don't spend this mode's
+        # budget re-deriving it — the watchdog merges it back in
+        cpu_dt = None
+    else:
+        # CPU baseline: identical pipeline, numpy, same data
+        t0 = time.perf_counter()
+        _ = numpy_terasort(rows, max(n, 8))
+        cpu_dt = time.perf_counter() - t0
+        _progress(f"cpu baseline done ({cpu_dt:.1f}s)")
 
     gbps_per_chip = total_bytes / tpu_dt / 1e9 / n
     detail = {
         "data_bytes": total_bytes,
         "devices": n,
         "tpu_step_s": round(tpu_dt, 4),
-        "cpu_baseline_s": round(cpu_dt, 4),
+        "cpu_baseline_s": round(cpu_dt, 4) if cpu_dt else None,
         "platform": devs[0].platform,
         "device_kind": devs[0].device_kind,
         "sort_mode": best_mode,
         "sort_mode_step_s": {m: round(t, 4) for m, t in per_mode.items()},
+        "tpu_step_latency_s": round(per_mode_latency[best_mode], 4),
     }
 
     # Secondary workloads (BASELINE.md configs #3/#4): best-effort — they
@@ -282,15 +404,16 @@ def main() -> None:
                   jax.device_put(pad_to_devices(dim2, n), sh))
         return make_tpcds_step(mesh, "shuffle", tcfg), inputs, len(fact)
 
-    _bench_secondary(detail, "pagerank", "pagerank_edges_per_s", bench_pagerank, reps=5)
-    _bench_secondary(detail, "join", "join_rows_per_s", bench_join, reps=3)
-    _bench_secondary(detail, "tpcds", "tpcds_fact_rows_per_s", bench_tpcds, reps=3)
+    if not light and os.environ.get("BENCH_SKIP_SECONDARY") != "1":
+        _bench_secondary(detail, "pagerank", "pagerank_edges_per_s", bench_pagerank, reps=5)
+        _bench_secondary(detail, "join", "join_rows_per_s", bench_join, reps=3)
+        _bench_secondary(detail, "tpcds", "tpcds_fact_rows_per_s", bench_tpcds, reps=3)
 
     result = {
         "metric": "terasort_shuffle_throughput_per_chip",
         "value": round(gbps_per_chip, 3),
         "unit": "GB/s/chip",
-        "vs_baseline": round(cpu_dt / tpu_dt, 3),
+        "vs_baseline": round(cpu_dt / tpu_dt, 3) if cpu_dt else None,
         "detail": detail,
     }
     print(json.dumps(result))

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Watch for TPU tunnel recovery; on the first successful probe, run the
+# full bench and save the record. The axon tunnel wedges after a device
+# OOM (every jax.devices() call then hangs forever) and recovers on its
+# own schedule — this loop turns "try again later" into evidence.
+# Usage: scripts/bench_recovery_watch.sh [out_json] [max_hours]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-BENCH_SELF_r03.json}"
+MAX_HOURS="${2:-9}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 70 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp, numpy as np
+assert jax.devices()[0].platform == "tpu"
+np.asarray(jax.jit(lambda x: x + 1)(jnp.zeros(8)))
+EOF
+  then
+    echo "[$(date +%H:%M:%S)] tunnel live; running bench" >&2
+    BENCH_TIMEOUT_S="${BENCH_TIMEOUT_S:-700}" python bench.py > "$OUT.tmp" 2>/dev/null
+    if [ -s "$OUT.tmp" ] && grep -q '"platform": "tpu"' "$OUT.tmp"; then
+      mv "$OUT.tmp" "$OUT"
+      echo "[$(date +%H:%M:%S)] hardware bench recorded in $OUT" >&2
+      exit 0
+    fi
+    echo "[$(date +%H:%M:%S)] bench ran but no tpu record; retrying later" >&2
+    rm -f "$OUT.tmp"
+  else
+    echo "[$(date +%H:%M:%S)] tunnel still wedged" >&2
+  fi
+  sleep 480
+done
+echo "gave up after ${MAX_HOURS}h" >&2
+exit 1

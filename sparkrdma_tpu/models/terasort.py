@@ -191,6 +191,7 @@ def run_terasort(mesh: Mesh, cfg: TeraSortConfig, axis_name: str = "shuffle",
 def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
                           axis_name: str = "shuffle", impl: str = "auto",
                           pipeline_rounds: bool = True,
+                          phase_times: Optional[dict] = None,
                           ) -> Tuple[list, int]:
     """TeraSort a dataset LARGER than one round's device capacity.
 
@@ -207,6 +208,13 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
     device step overlap round r's host-side collection, at the cost of up
     to TWO rounds of device footprint resident at once. Pass False for
     the strict one-round footprint when a round is sized near HBM.
+
+    ``phase_times``, when a dict is passed, is filled with wall seconds per
+    phase — ``stage_s`` (host chunk prep + device_put + async dispatch),
+    ``collect_s`` (blocking device wait + host-side run splitting) and
+    ``merge_s`` (final per-device tournament merge) — the per-phase view
+    BASELINE config #2 rehearsals report (with pipelining on, stage and
+    collect overlap, so their sum can exceed end-to-end wall time).
 
     Returns ``(per_device_sorted_rows: [D] list of u32[*, 1+P], rounds)``.
     """
@@ -238,9 +246,11 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
                          "out_factor >= 2 (pad headroom)")
 
     runs: list = [[] for _ in range(n)]
+    times = {"stage_s": 0.0, "collect_s": 0.0, "merge_s": 0.0}
 
     def dispatch(r: int):
         """Stage + launch round r; returns (pads_for, async device results)."""
+        t0 = time.perf_counter()
         chunk = rows[r * per_round:(r + 1) * per_round]
         pads_for = np.zeros(n, dtype=np.int64)
         tail_pad = per_round - len(chunk)
@@ -250,9 +260,12 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
             pad[:, 0] = range_max[dests]
             np.add.at(pads_for, dests, 1)
             chunk = np.concatenate([chunk, pad])
-        return pads_for, step(jax.device_put(chunk, sharding))
+        result = pads_for, step(jax.device_put(chunk, sharding))
+        times["stage_s"] += time.perf_counter() - t0
+        return result
 
     def collect(pads_for, results):
+        t0 = time.perf_counter()
         out, counts, overflowed = results
         if np.asarray(overflowed).any():
             raise OverflowError("streamed round receive overflow; raise "
@@ -264,6 +277,7 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
             # .copy(): a view would pin the whole padded round buffer on the
             # host across all R rounds (~out_factor x dataset RSS)
             runs[d].append(out[d][:total - int(pads_for[d])].copy())
+        times["collect_s"] += time.perf_counter() - t0
 
     # Double-buffered rounds: round r+1's device work is dispatched (jax
     # dispatch is async) before round r's host-side collection, so staging
@@ -284,6 +298,7 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
 
     from sparkrdma_tpu.shuffle.external import merge_runs
 
+    t0 = time.perf_counter()
     merged = []
     for d in range(n):
         if not runs[d]:
@@ -295,6 +310,9 @@ def run_terasort_streamed(mesh: Mesh, cfg: TeraSortConfig, rows: np.ndarray,
         # the former stable re-sort's order exactly)
         _, out = merge_runs([(r[:, 0], r) for r in runs[d]])
         merged.append(out)
+    times["merge_s"] = time.perf_counter() - t0
+    if phase_times is not None:
+        phase_times.update(times, rounds=num_rounds)
     return merged, num_rounds
 
 

@@ -380,25 +380,29 @@ class ByteCredits:
         self.peak_reserved = 0  # audit: worst-case held bytes
         self.parked = 0         # audit: requests that had to wait
 
-    def try_reserve(self, nbytes: int) -> bool:
+    def reserve_or_park(self, nbytes: int, deadline: float,
+                        resume, expire) -> bool:
+        """Atomically reserve (returns True) or enqueue the continuation
+        (returns False). The availability check and the park happen under
+        ONE lock acquisition — with a separate check-then-park, a
+        ``release`` landing in the gap could drain the window and never
+        wake the request (lost wakeup: the request, and behind the FIFO
+        gate every later one, would sit parked against a fully-available
+        window until the sweeper failed them). ``resume()`` fires (off
+        this thread) once the reservation has been taken on the request's
+        behalf; ``expire()`` fires if the deadline passes first (swept by
+        the endpoint)."""
         need = min(nbytes, self.budget)
         with self._lock:
             # FIFO fairness: never jump a parked queue
-            if self._parked_q or self._avail < need:
-                return False
-            self._avail -= need
-            self.peak_reserved = max(self.peak_reserved,
-                                     self.budget - self._avail)
-        return True
-
-    def park(self, nbytes: int, deadline: float, resume, expire) -> None:
-        """``resume()`` fires (off this thread) once the reservation has
-        been taken on the request's behalf; ``expire()`` fires if the
-        deadline passes first (swept by the endpoint)."""
-        with self._lock:
-            self._parked_q.append((min(nbytes, self.budget), deadline,
-                                   resume, expire))
+            if not self._parked_q and self._avail >= need:
+                self._avail -= need
+                self.peak_reserved = max(self.peak_reserved,
+                                         self.budget - self._avail)
+                return True
+            self._parked_q.append((need, deadline, resume, expire))
             self.parked += 1
+        return False
 
     def release(self, nbytes: int) -> None:
         resumes = []
@@ -482,10 +486,14 @@ class ExecutorEndpoint:
         self._credits_lock = threading.Lock()
         self._credit_timeouts = 0
         # client side: logical sizes of in-flight credited fetches, keyed
-        # by (conn identity, req_id) — consulted when a response arrives
-        # ORPHANED (its requester timed out) so its credits still get
-        # reported and the server's window heals
-        self._fetch_credit_pending: Dict[Tuple[int, int], int] = {}
+        # by connection -> {req_id: size} — consulted when a response
+        # arrives ORPHANED (its requester timed out) so its credits still
+        # get reported and the server's window heals. Weak keys: entries
+        # whose response never arrives (conn died post-timeout) die with
+        # the connection instead of accumulating forever, and a recycled
+        # id() can never alias a new connection's req_ids.
+        self._fetch_credit_pending: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
         self._fetch_credit_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
@@ -681,9 +689,6 @@ class ExecutorEndpoint:
         park timeout instead of growing server memory."""
         credits = self._credits_of(conn)
         total = sum(length for _, _, length in msg.blocks)
-        if credits.try_reserve(total):
-            self._serve_reserved(credits, conn, msg, total)
-            return
 
         def resume():  # reservation already taken by release()
             self._serve_pool.submit(self._serve_reserved, credits, conn,
@@ -700,9 +705,11 @@ class ExecutorEndpoint:
             except TransportError:
                 pass
 
-        credits.park(total,
-                     time.monotonic() + self.conf.connect_timeout_ms / 1000,
-                     resume, expire)
+        if credits.reserve_or_park(
+                total, time.monotonic() + self.conf.connect_timeout_ms / 1000,
+                resume, expire):
+            self._serve_reserved(credits, conn, msg, total)
+            return
         self._ensure_park_sweeper()
 
     def _serve_reserved(self, credits: ByteCredits, conn: Connection,
@@ -875,19 +882,21 @@ class ExecutorEndpoint:
         if not (credited and self.conf.sw_flow_control):
             return conn.request(req)
         total = sum(length for _, _, length in req.blocks)
-        key = (id(conn), req.req_id)
         with self._fetch_credit_lock:
-            self._fetch_credit_pending[key] = total
+            self._fetch_credit_pending.setdefault(conn, {})[req.req_id] = \
+                total
         try:
             resp = conn.request(req)
         except TransportError:
             # conn is dead: no orphan will ever arrive, and the server
             # releases on its own failed send
             with self._fetch_credit_lock:
-                self._fetch_credit_pending.pop(key, None)
+                self._fetch_credit_pending.get(conn, {}).pop(req.req_id,
+                                                             None)
             raise
         with self._fetch_credit_lock:
-            pending = self._fetch_credit_pending.pop(key, None)
+            pending = self._fetch_credit_pending.get(conn, {}).pop(
+                req.req_id, None)
         if pending is not None and resp.status == M.STATUS_OK:
             try:
                 conn.send(M.CreditReport(pending))
@@ -901,8 +910,8 @@ class ExecutorEndpoint:
         gone, but the server is still holding window for it — report the
         credits it carried."""
         with self._fetch_credit_lock:
-            total = self._fetch_credit_pending.pop((id(conn), msg.req_id),
-                                                   None)
+            total = self._fetch_credit_pending.get(conn, {}).pop(
+                msg.req_id, None)
         if total is not None and msg.status == M.STATUS_OK:
             try:
                 conn.send(M.CreditReport(total))

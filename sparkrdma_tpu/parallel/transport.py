@@ -27,7 +27,7 @@ import itertools
 import logging
 import socket
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Callable, Dict, Optional, Tuple
 
 from sparkrdma_tpu.config import TpuShuffleConf
@@ -97,7 +97,21 @@ class Connection:
                 self._pending[req_id] = fut
             self.send(msg)
             tmo = timeout if timeout is not None else self._conf.connect_timeout_ms / 1000
-            return fut.result(timeout=tmo)
+            try:
+                return fut.result(timeout=tmo)
+            except TimeoutError:
+                # Claim the future back before giving up. cancel() failing
+                # means the reader won the race and a response already
+                # landed — return it rather than dropping a consumed
+                # message on the floor (a credited fetch would otherwise
+                # leak the server's window forever: the response never
+                # reaches the orphan path AND the requester never reports).
+                # cancel() succeeding poisons the future, so a late
+                # set_result in _dispatch raises and the response is
+                # re-routed to the unsolicited-message path.
+                if not fut.cancel():
+                    return fut.result(timeout=0)
+                raise
         finally:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
@@ -131,8 +145,14 @@ class Connection:
             with self._pending_lock:
                 fut = self._pending.pop(req_id, None)
             if fut is not None:
-                fut.set_result(msg)
-                return
+                try:
+                    fut.set_result(msg)
+                    return
+                except InvalidStateError:
+                    # the requester timed out and cancelled the future in
+                    # the race window — deliver as unsolicited instead
+                    # (the endpoint's orphan path reports its credits)
+                    pass
         if self._on_message is not None:
             try:
                 reply = self._on_message(self, msg)

@@ -179,3 +179,93 @@ def test_healthy_peer_unaffected_by_stalled_peer(tmp_path):
         for ex in execs:
             ex.stop()
         driver.stop()
+
+
+def test_reserve_or_park_no_lost_wakeup():
+    """The availability check and the park are ONE atomic operation: a
+    release draining concurrently with a failed check can no longer
+    strand a request (regression: a separate try_reserve-then-park pair
+    had a window where the last outstanding release slipped between the
+    two calls and nothing ever woke the parked queue). Hammered with
+    4x-oversubscribed concurrent requests; every one must serve."""
+    from sparkrdma_tpu.parallel.endpoints import ByteCredits
+
+    credits = ByteCredits(1024)
+    served = []
+    lock = threading.Lock()
+    n = 200
+
+    def work(i):
+        def resume():
+            with lock:
+                served.append(i)
+            credits.release(256)  # consume + replenish immediately
+
+        if credits.reserve_or_park(256, time.monotonic() + 30, resume,
+                                   lambda: None):
+            resume()
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with lock:
+            if len(served) == n:
+                break
+        time.sleep(0.01)
+    assert len(served) == n, f"lost wakeup: {len(served)}/{n} served"
+
+
+def test_timed_out_fetch_reports_credit_via_orphan(tmp_path):
+    """A fetch whose requester gives up waiting but whose response still
+    arrives (slow server) must not leak the serving window: either the
+    late response is returned by the request-race path, or it lands as an
+    orphan and the unsolicited handler sends the CreditReport. Proven by
+    a follow-up window-sized fetch succeeding (a leaked window would park
+    it until STATUS_ERROR)."""
+    driver, execs = _cluster(
+        tmp_path, serve_credit_bytes=BLOCK,  # window = ONE block
+        shuffle_read_block_size=BLOCK, max_bytes_in_flight=1 << 30,
+        connect_timeout_ms=900, use_cpp_runtime=False)
+    try:
+        handle = _write_shuffle(driver, execs, 4, num_partitions=4)
+        server_ep = execs[0].executor
+        orig = server_ep._on_fetch_blocks
+        slow_once = threading.Event()
+
+        def slow(msg):
+            if not slow_once.is_set():
+                slow_once.set()
+                time.sleep(2.0)  # outlive the client's 0.9s wait
+            return orig(msg)
+
+        server_ep._on_fetch_blocks = slow
+        client = execs[1].executor
+        peer = client.member_at(execs[0].executor.exec_index(timeout=2))
+        locs = client.fetch_output_range(peer, 4, 0, 0, 4)
+        conn = client._clients.get(peer.rpc_host, peer.rpc_port)
+        req = M.FetchBlocksReq(
+            conn.next_req_id(), 4,
+            [(locs[0].buf, locs[0].offset, locs[0].length)])
+        t0 = time.monotonic()
+        try:
+            client._credited_request(conn, req, credited=True)
+        except TimeoutError:
+            pass  # the expected outcome; a race-window return is also fine
+        # wait for the late response to land and its credits to be
+        # reported through whichever path won
+        time.sleep(max(0.0, 2.6 - (time.monotonic() - t0)))
+        req2 = M.FetchBlocksReq(
+            conn.next_req_id(), 4,
+            [(locs[1].buf, locs[1].offset, locs[1].length)])
+        resp2 = client._credited_request(conn, req2, credited=True)
+        assert resp2.status == M.STATUS_OK, \
+            "window leaked by the timed-out fetch"
+        assert server_ep.serve_stats()["credit_timeouts"] == 0
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
