@@ -119,7 +119,8 @@ def _run_with_watchdog() -> int:
     # a bigger budget via BENCH_TIMEOUT_MULTISORT_S).
     ms_timeout_s = int(env.get("BENCH_TIMEOUT_MULTISORT_S",
                                str(mode_timeout_s)))
-    plan = [("gather", mode_timeout_s), ("multisort", ms_timeout_s)]
+    plan = [("gather", mode_timeout_s), ("colsort", mode_timeout_s),
+            ("multisort", ms_timeout_s)]
     if env.get("BENCH_SORT_MODE"):
         # operator pinned a mode: run exactly that one (e.g. skipping the
         # multisort attempt entirely when its compile isn't cached yet),

@@ -17,7 +17,11 @@ np.asarray(jax.jit(lambda x: x + 1)(jnp.zeros(8)))
 EOF
   then
     echo "[$(date +%H:%M:%S)] tunnel live; running bench" >&2
-    BENCH_TIMEOUT_S="${BENCH_TIMEOUT_S:-700}" python bench.py > "$OUT.tmp" 2>/dev/null
+    # the big multisort budget funds its ONE cold compile; once cached
+    # (persistent XLA cache) later runs replay it in seconds
+    BENCH_TIMEOUT_S="${BENCH_TIMEOUT_S:-700}" \
+    BENCH_TIMEOUT_MULTISORT_S="${BENCH_TIMEOUT_MULTISORT_S:-2400}" \
+      python bench.py > "$OUT.tmp" 2>/dev/null
     if [ -s "$OUT.tmp" ] && grep -q '"platform": "tpu"' "$OUT.tmp"; then
       mv "$OUT.tmp" "$OUT"
       echo "[$(date +%H:%M:%S)] hardware bench recorded in $OUT" >&2

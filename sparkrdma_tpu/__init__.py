@@ -34,6 +34,7 @@ Subpackages
 ``models``    end-to-end workloads: TeraSort, PageRank, ALS, joins, TPC-DS.
 ``engine``    DAG/stage scheduler driving the drop-in SPI (DAGScheduler equiv).
 ``tasks``     cloudpickle task shipping to executor processes (task scheduler equiv).
+``shared_vars``  broadcasts + accumulators (Spark shared-variables equiv).
 """
 
 __version__ = "0.1.0"
@@ -53,6 +54,9 @@ def __getattr__(name):
     if name in ("DAGEngine", "MapStage", "ResultStage"):
         from sparkrdma_tpu import engine
         return getattr(engine, name)
+    if name in ("Broadcast", "Accumulator"):
+        from sparkrdma_tpu import shared_vars
+        return getattr(shared_vars, name)
     if name == "ShuffleDependency":
         from sparkrdma_tpu.shuffle.spark_compat import ShuffleDependency
         return ShuffleDependency

@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
+from sparkrdma_tpu import shared_vars
 from sparkrdma_tpu.shuffle.spark_compat import (
     CompatReader,
     CompatWriter,
@@ -212,6 +213,20 @@ class DAGEngine:
         self._handles: Dict[int, object] = {}      # stage_id -> ShuffleHandle
         self._stages: Dict[int, MapStage] = {}     # stage_id -> stage
         self._owners: Dict[int, Dict[int, int]] = {}  # stage_id -> map->slot
+        # shared variables (shared_vars): engine-created accumulators by
+        # id, and the first-success dedupe ledger — a task's deltas merge
+        # exactly once no matter how many attempts (speculation, retry,
+        # abandoned stragglers) eventually succeed. Keys carry a per-job
+        # GENERATION: a straggler that outlives its job (or lands after a
+        # later job reused its stage id) holds a gen that is no longer
+        # active, so its late deltas are dropped instead of re-applied
+        # against a purged ledger.
+        self._accs: Dict[int, "shared_vars.Accumulator"] = {}
+        self._acc_applied: set = set()  # (job_gen, stage_id, task_id)
+        self._acc_lock = threading.Lock()
+        self._job_gens = itertools.count(1)
+        self._active_gens: set = set()
+        self._gen_of_stage: Dict[int, int] = {}  # stage_id -> job gen
         # mesh mode: shuffle_id -> _MeshCell whose .value is the list of
         # per-partition (keys, payload) — ONE reduce per shuffle, shared
         # by every task reading it
@@ -220,10 +235,61 @@ class DAGEngine:
 
     # -- public ----------------------------------------------------------
 
+    def broadcast(self, value) -> "shared_vars.Broadcast":
+        """Register a read-only shared value with the driver; task
+        closures capturing the returned handle ship only its id, and each
+        executor process fetches + caches the value at most once
+        (Spark's sc.broadcast — which the reference's jobs lean on for
+        map-side joins; here it rides the same control plane as the
+        driver table)."""
+        return shared_vars.create_broadcast(value, self.driver.native.driver)
+
+    def accumulator(self, name: str, zero=0) -> "shared_vars.Accumulator":
+        """Create a driver-owned counter tasks can ``add`` to (Spark's
+        longAccumulator). Deltas merge on the driver exactly once per
+        task regardless of speculation or retries."""
+        acc = shared_vars.Accumulator(name, zero)
+        with self._acc_lock:
+            self._accs[acc.acc_id] = acc
+        return acc
+
+    def _apply_acc_deltas(self, stage_id: int, task_id: int,
+                          deltas: Dict[int, object],
+                          job_gen: Optional[int] = None) -> None:
+        """Merge one successful attempt's accumulator deltas, first
+        success only (Spark's exactly-once guarantee for actions). A
+        ``job_gen`` that is no longer active marks a straggler finishing
+        after its job ended: its winner already merged (or the job
+        failed), so the deltas are dropped, never double-counted."""
+        if not deltas:
+            return
+        with self._acc_lock:
+            if job_gen is None:
+                job_gen = self._gen_of_stage.get(stage_id)
+            if job_gen not in self._active_gens:
+                return
+            key = (job_gen, stage_id, task_id)
+            if key in self._acc_applied:
+                return
+            self._acc_applied.add(key)
+            accs = [(self._accs.get(acc_id), delta)
+                    for acc_id, delta in deltas.items()]
+        for acc, delta in accs:
+            if acc is None:
+                log.warning("dropping deltas for unknown accumulator "
+                            "(created outside this engine?)")
+            else:
+                acc._merge(delta)
+
     def run(self, final: ResultStage) -> List[object]:
         """Execute the DAG rooted at ``final``; returns its tasks' values."""
         order = self._topo_order(final)
         registered: List[MapStage] = []
+        with self._acc_lock:
+            job_gen = next(self._job_gens)
+            self._active_gens.add(job_gen)
+            for s in [*order, final]:
+                self._gen_of_stage[s.stage_id] = job_gen
         try:
             for stage in order:
                 registered.append(stage)  # before running: a mid-stage
@@ -234,6 +300,16 @@ class DAGEngine:
                                   tasks=final.num_tasks):
                 return self._run_stage_tasks(final)
         finally:
+            # close this job's accumulator generation: its ledger entries
+            # go, late stragglers carrying this gen are dropped at apply,
+            # and a reused stage_id maps cleanly onto the next job's gen
+            with self._acc_lock:
+                self._active_gens.discard(job_gen)
+                self._acc_applied = {k for k in self._acc_applied
+                                     if k[0] != job_gen}
+                for s in [*order, final]:
+                    if self._gen_of_stage.get(s.stage_id) == job_gen:
+                        del self._gen_of_stage[s.stage_id]
             for stage in registered:
                 handle = self._handles.pop(stage.stage_id, None)
                 self._stages.pop(stage.stage_id, None)
@@ -498,6 +574,12 @@ class DAGEngine:
     def _attempt_task(self, stage, task_id: int, target):
         from dataclasses import replace
 
+        # bind the accumulator generation NOW: an attempt abandoned by
+        # its job but still running must carry the OLD gen, so its late
+        # deltas drop instead of landing under a reused stage_id's new job
+        with self._acc_lock:
+            job_gen = self._gen_of_stage.get(stage.stage_id)
+
         # snapshot handles with .get: the job may tear down concurrently
         # (abandoned speculative losers / cancelled siblings) — a missing
         # handle means this attempt's outcome no longer matters
@@ -512,24 +594,33 @@ class DAGEngine:
         parent_handles = [replace(h, combiner=None) for h in raw_parents]
         if self._is_remote(target):
             if isinstance(stage, MapStage):
-                target.run_map_task(stage.task_fn, handle, parent_handles,
-                                    task_id)  # combiner rides the handle
+                _, deltas = target.run_map_task(
+                    stage.task_fn, handle, parent_handles,
+                    task_id)  # combiner rides the handle
                 self._record_owner(stage.stage_id, task_id, target)
+                self._apply_acc_deltas(stage.stage_id, task_id, deltas,
+                                       job_gen)
                 return None
-            return target.run_result_task(stage.task_fn, parent_handles,
-                                          task_id)
+            result, deltas = target.run_result_task(
+                stage.task_fn, parent_handles, task_id)
+            self._apply_acc_deltas(stage.stage_id, task_id, deltas, job_gen)
+            return result
         ctx = TaskContext(self, target, stage, task_id)
-        if isinstance(stage, MapStage):
-            writer = target.getWriter(handle, task_id)  # combiner on handle
-            try:
-                stage.task_fn(ctx, writer, task_id)
-            except BaseException:
-                writer.stop(False)
-                raise
-            writer.stop(True)
-            self._record_owner(stage.stage_id, task_id, target)
-            return None
-        return stage.task_fn(ctx, task_id)
+        with shared_vars.collecting() as deltas:
+            if isinstance(stage, MapStage):
+                writer = target.getWriter(handle, task_id)  # combiner on handle
+                try:
+                    stage.task_fn(ctx, writer, task_id)
+                except BaseException:
+                    writer.stop(False)
+                    raise
+                writer.stop(True)
+                self._record_owner(stage.stage_id, task_id, target)
+                result = None
+            else:
+                result = stage.task_fn(ctx, task_id)
+        self._apply_acc_deltas(stage.stage_id, task_id, deltas, job_gen)
+        return result
 
     def _record_owner(self, stage_id: int, task_id: int, target) -> None:
         owners = self._owners.get(stage_id)

@@ -44,15 +44,26 @@ class TeraSortConfig:
     payload_words: int = 24  # 4B key word + 24*4B payload ≈ the classic 100B row
     out_factor: int = 2      # receive headroom (uniform keys -> mild skew)
     # How payload follows its key through a local sort:
-    #   "gather"    — sort (key, iota) then ONE row gather. The gather costs
-    #                 ~43ns/row on v5e regardless of row width (measured:
-    #                 random-access bound, ~5x the key sort) — it is the
-    #                 step's bottleneck.
+    #   "gather"    — sort (key, iota) then ONE row gather. Measured on
+    #                 v5e: the gather runs at ~1 word/cycle (28.8 ns/row
+    #                 at width 25, ~3.4x the 8.5 ns/row key sort) — it is
+    #                 the step's bottleneck.
     #   "multisort" — every payload column rides the sort network as an
-    #                 extra lax.sort operand: no gather at all, but the sort
-    #                 moves width/8 more bytes per pass. Which wins is
-    #                 hardware-dependent (gather is latency-bound, the sort
-    #                 bandwidth-bound); bench A/Bs via BENCH_SORT_MODE.
+    #                 extra rank-1 lax.sort operand: no gather, but the
+    #                 XLA:TPU compile cost grows ~16s per operand and a
+    #                 26-operand network never finished a 900s cold
+    #                 compile — only usable behind a warm compilation
+    #                 cache.
+    #   "colsort"   — ONE variadic 2D sort along axis 0 of
+    #                 (broadcast keys [N,W], rows [N,W]) with
+    #                 is_stable=True: per-column comparators see identical
+    #                 keys, so the stable sort applies the SAME permutation
+    #                 to every lane and payload never leaves the sort
+    #                 network. Carries the key column W times (2x the
+    #                 multisort bytes) but compiles like a 2-operand sort
+    #                 and runs lane-parallel.
+    # Which wins is hardware-dependent (gather is latency-bound, the
+    # sorts bandwidth-bound); bench A/Bs via BENCH_SORT_MODE.
     sort_mode: str = "gather"
 
     @property
@@ -73,10 +84,10 @@ def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
     """
     n = mesh.shape[axis_name]
     impl = resolve_impl(mesh, impl, axis_name)
-    if cfg.sort_mode not in ("gather", "multisort"):
+    if cfg.sort_mode not in ("gather", "multisort", "colsort"):
         # a typo must not silently measure (and mislabel) the gather path
         raise ValueError(f"unknown sort_mode {cfg.sort_mode!r} "
-                         "(expected 'gather' or 'multisort')")
+                         "(expected 'gather', 'multisort' or 'colsort')")
     splitters = uniform_splitters(n, jnp.uint32)
     spec = P(axis_name)
 
@@ -85,12 +96,26 @@ def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
         side (see TeraSortConfig.sort_mode for the two strategies)."""
         if cfg.sort_mode == "multisort":
             cols = tuple(rows[:, j] for j in range(rows.shape[1]))
-            out = jax.lax.sort((keys,) + cols, num_keys=1)
+            # is_stable: all three modes must order duplicate keys
+            # identically (gather is stable via its iota tiebreak)
+            out = jax.lax.sort((keys,) + cols, num_keys=1, is_stable=True)
             sorted_keys = out[0]
             sorted_rows = jnp.stack(out[1:], axis=1)
+        elif cfg.sort_mode == "colsort":
+            # identical keys in every lane + a STABLE sort => every column
+            # receives the same permutation, so rows stay intact without a
+            # gather and without per-column operands
+            keys_b = jnp.broadcast_to(keys[:, None], rows.shape)
+            sorted_kb, sorted_rows = jax.lax.sort(
+                (keys_b, rows), dimension=0, num_keys=1, is_stable=True)
+            sorted_keys = sorted_kb[:, 0]
         else:
             iota = jnp.arange(rows.shape[0], dtype=jnp.int32)
-            sorted_keys, order = jax.lax.sort((keys, iota), num_keys=1)
+            # iota as a SECOND KEY makes the order total: duplicate keys
+            # order by original position with no reliance on sort
+            # stability (a value-operand iota under an unstable sort
+            # could permute ties arbitrarily)
+            sorted_keys, order = jax.lax.sort((keys, iota), num_keys=2)
             sorted_rows = jnp.take(rows, order, axis=0)
         # the key column already equals sorted_keys for valid rows; only
         # padding rows (sentinel keys) need the overwrite

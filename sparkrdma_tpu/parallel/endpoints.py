@@ -109,6 +109,10 @@ class DriverEndpoint:
         self._sweeper = threading.Thread(target=self._sweep_waiters,
                                          daemon=True, name="driver-sweeper")
         self._sweeper.start()
+        # broadcast blobs (shared_vars.Broadcast): id -> pickled value,
+        # served to executors on GetBroadcastReq
+        self._broadcasts: Dict[int, bytes] = {}
+        self._broadcasts_lock = threading.Lock()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -148,6 +152,16 @@ class DriverEndpoint:
         with self._tables_lock:
             return shuffle_id in self._tables
 
+    # -- broadcast registry (shared_vars) --------------------------------
+
+    def register_broadcast(self, bcast_id: int, blob: bytes) -> None:
+        with self._broadcasts_lock:
+            self._broadcasts[bcast_id] = blob
+
+    def unregister_broadcast(self, bcast_id: int) -> None:
+        with self._broadcasts_lock:
+            self._broadcasts.pop(bcast_id, None)
+
     def members(self) -> List[ShuffleManagerId]:
         with self._members_lock:
             return list(self._members)
@@ -178,6 +192,12 @@ class DriverEndpoint:
             return self._on_publish(msg)
         if isinstance(msg, M.FetchTableReq):
             return self._on_fetch_table(conn, msg)
+        if isinstance(msg, M.GetBroadcastReq):
+            with self._broadcasts_lock:
+                blob = self._broadcasts.get(msg.bcast_id)
+            if blob is None:
+                return M.GetBroadcastResp(msg.req_id, M.STATUS_ERROR, b"")
+            return M.GetBroadcastResp(msg.req_id, M.STATUS_OK, blob)
         log.warning("driver: unexpected %s", type(msg).__name__)
         return None
 

@@ -259,6 +259,45 @@ class CreditReport(RpcMsg):
         return cls(consumed)
 
 
+@register(14)
+class GetBroadcastReq(RpcMsg):
+    """Executor -> driver: fetch a broadcast blob by id (the delivery
+    half of shared_vars.Broadcast — once per executor PROCESS, cached
+    there, so N tasks cost one transfer like Spark's TorrentBroadcast
+    costs one fetch per executor)."""
+
+    def __init__(self, req_id: int, bcast_id: int):
+        self.req_id = req_id
+        self.bcast_id = bcast_id
+
+    def payload(self) -> bytes:
+        return struct.pack("<qq", self.req_id, self.bcast_id)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "GetBroadcastReq":
+        req_id, bcast_id = struct.unpack_from("<qq", payload, 0)
+        return cls(req_id, bcast_id)
+
+
+@register(15)
+class GetBroadcastResp(RpcMsg):
+    """status STATUS_OK with the pickled blob, or STATUS_ERROR when the
+    id is unknown (unpersisted or never registered)."""
+
+    def __init__(self, req_id: int, status: int, data: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.data = data
+
+    def payload(self) -> bytes:
+        return struct.pack("<qi", self.req_id, self.status) + self.data
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "GetBroadcastResp":
+        req_id, status = struct.unpack_from("<qi", payload, 0)
+        return cls(req_id, status, payload[12:])
+
+
 # Status codes shared by responses.
 STATUS_OK = 0
 STATUS_UNKNOWN_SHUFFLE = 1

@@ -67,37 +67,54 @@ class _RemoteTaskContext:
 
 def install_task_server(compat_mgr) -> None:
     """Serve shipped tasks on this executor (worker-side entry point)."""
+    from sparkrdma_tpu import shared_vars
+
+    def fetch_broadcast(bcast_id: int) -> bytes:
+        ep = compat_mgr.native.executor
+        conn = ep.driver_conn()
+        resp = conn.request(M.GetBroadcastReq(conn.next_req_id(), bcast_id))
+        assert isinstance(resp, M.GetBroadcastResp)
+        if resp.status != M.STATUS_OK:
+            raise TaskError(f"broadcast {bcast_id} unknown to the driver "
+                            "(unpersisted?)")
+        return resp.data
 
     def run(payload: bytes) -> Tuple[int, bytes]:
         try:
             desc = _cloudpickle().loads(payload)
             kind = desc["kind"]
-            if kind == "map":
-                ctx = _RemoteTaskContext(compat_mgr, desc["parents"],
-                                         desc["task_id"])
-                writer = compat_mgr.getWriter(desc["handle"],
-                                              desc["task_id"])
-                try:
-                    desc["fn"](ctx, writer, desc["task_id"])
-                except BaseException:
-                    writer.stop(False)
-                    raise
-                writer.stop(True)
-                result = None
-            elif kind == "result":
-                ctx = _RemoteTaskContext(compat_mgr, desc["parents"],
-                                         desc["task_id"])
-                result = desc["fn"](ctx, desc["task_id"])
-            elif kind == "invalidate":
-                compat_mgr.native.executor.invalidate_shuffle(
-                    desc["shuffle_id"])
-                result = None
-            elif kind == "unregister":
-                compat_mgr.unregisterShuffle(desc["shuffle_id"])
-                result = None
-            else:
-                return M.TASK_ERROR, f"unknown task kind {kind!r}".encode()
-            return M.TASK_OK, _cloudpickle().dumps(result)
+            with shared_vars.collecting() as acc_deltas, \
+                    shared_vars.serving(fetch_broadcast):
+                if kind == "map":
+                    ctx = _RemoteTaskContext(compat_mgr, desc["parents"],
+                                             desc["task_id"])
+                    writer = compat_mgr.getWriter(desc["handle"],
+                                                  desc["task_id"])
+                    try:
+                        desc["fn"](ctx, writer, desc["task_id"])
+                    except BaseException:
+                        writer.stop(False)
+                        raise
+                    writer.stop(True)
+                    result = None
+                elif kind == "result":
+                    ctx = _RemoteTaskContext(compat_mgr, desc["parents"],
+                                             desc["task_id"])
+                    result = desc["fn"](ctx, desc["task_id"])
+                elif kind == "invalidate":
+                    compat_mgr.native.executor.invalidate_shuffle(
+                        desc["shuffle_id"])
+                    result = None
+                elif kind == "unregister":
+                    compat_mgr.unregisterShuffle(desc["shuffle_id"])
+                    result = None
+                else:
+                    return (M.TASK_ERROR,
+                            f"unknown task kind {kind!r}".encode())
+            # v2 envelope: accumulator deltas ride back with the result
+            # (merged driver-side only for the first success per task)
+            return M.TASK_OK, _cloudpickle().dumps(
+                {"v": 2, "result": result, "acc": acc_deltas})
         except FetchFailedError as e:
             return M.TASK_FETCH_FAILED, pickle.dumps(
                 (e.shuffle_id, e.map_id, e.exec_index, str(e)))
@@ -125,11 +142,14 @@ class RemoteExecutor:
 
     # -- engine-facing ---------------------------------------------------
 
-    def run_map_task(self, fn, handle, parent_handles, task_id: int) -> None:
-        self._run({"kind": "map", "fn": fn, "handle": handle,
-                   "parents": list(parent_handles), "task_id": task_id})
+    def run_map_task(self, fn, handle, parent_handles, task_id: int):
+        """Returns (None, accumulator deltas)."""
+        return self._run({"kind": "map", "fn": fn, "handle": handle,
+                          "parents": list(parent_handles),
+                          "task_id": task_id})
 
     def run_result_task(self, fn, parent_handles, task_id: int):
+        """Returns (task value, accumulator deltas)."""
         return self._run({"kind": "result", "fn": fn,
                           "parents": list(parent_handles),
                           "task_id": task_id})
@@ -196,8 +216,10 @@ class RemoteExecutor:
                     "no task server (call tasks.install_task_server there)")
             time.sleep(0.05)
         if resp.status == M.TASK_OK:
-            return (_cloudpickle().loads(resp.data)
-                    if resp.data else None)
+            obj = _cloudpickle().loads(resp.data) if resp.data else None
+            if isinstance(obj, dict) and obj.get("v") == 2:
+                return obj["result"], obj.get("acc") or {}
+            return obj, {}
         if resp.status == M.TASK_FETCH_FAILED:
             shuffle_id, map_id, exec_index, cause = pickle.loads(resp.data)
             raise FetchFailedError(shuffle_id, map_id, exec_index,

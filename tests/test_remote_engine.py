@@ -211,3 +211,34 @@ def test_dependency_combiner_applies_in_shipped_tasks(cluster):
         ResultStage(4, red_fn, parents=[stage]))
     assert sum(r[0] for r in results) == 2, "combine did not collapse rows"
     assert sum(r[1] for r in results) == 2000
+
+
+def test_shared_vars_across_processes(cluster):
+    """Broadcast fetched over the control plane by worker PROCESSES (the
+    closure ships only the id) and accumulator deltas returned in the
+    task-result envelope, merged exactly once driver-side."""
+    driver, remotes, _ = cluster
+    engine = DAGEngine(driver, remotes)
+    lookup = engine.broadcast({k: 2 * k for k in range(100)})
+    seen = engine.accumulator("seen")
+    P, maps, rows = 4, 4, 200
+
+    def map_fn(ctx, writer, task_id):
+        keys = np.arange(rows, dtype=np.uint64) % 100
+        vals = keys.astype("<u4")
+        writer.write((keys, vals.view(np.uint8).reshape(rows, 4)))
+        seen.add(rows)
+
+    def reduce_fn(ctx, task_id):
+        table = lookup.value  # triggers the once-per-process fetch
+        total = 0
+        for keys, _ in ctx.read(0).readBatches():
+            total += sum(table[int(k)] for k in keys)
+        return total
+
+    stage = MapStage(maps, ShuffleDependency(
+        P, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn)
+    got = sum(engine.run(ResultStage(P, reduce_fn, parents=[stage])))
+    want = maps * int(sum(2 * (k % 100) for k in range(rows)))
+    assert got == want
+    assert seen.value == maps * rows

@@ -1,0 +1,159 @@
+"""Shared variables (broadcast + accumulators) through the DAG engine —
+the Spark-core features (sc.broadcast / longAccumulator) the reference's
+jobs rely on, provided in-tree by shared_vars.py: broadcast delivery once
+per executor process over the control plane, accumulator deltas merged
+driver-side exactly once per task across attempts."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from engine_helpers import make_cluster, payload_u32, u32_payload
+from sparkrdma_tpu import shared_vars
+from sparkrdma_tpu.engine import DAGEngine, MapStage, ResultStage
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec
+from sparkrdma_tpu.shuffle.spark_compat import ShuffleDependency
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    driver, execs = make_cluster(tmp_path)
+    yield driver, execs
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def test_broadcast_and_accumulator_in_process(cluster):
+    """An engine job joins against a broadcast lookup table and counts
+    matched rows in an accumulator; both exact."""
+    driver, execs = cluster
+    P, maps, rows = 4, 3, 300
+    engine = DAGEngine(driver, execs)
+    lookup = engine.broadcast({k: k * 10 for k in range(32)})
+    matched = engine.accumulator("matched")
+    row_count = engine.accumulator("rows")
+
+    def map_fn(ctx, writer, task_id):
+        rng = np.random.default_rng(task_id)
+        keys = rng.integers(0, 64, rows).astype(np.uint64)
+        writer.write((keys, u32_payload(keys.astype(np.uint32))))
+        row_count.add(len(keys))
+
+    def reduce_fn(ctx, task_id):
+        total = 0
+        table = lookup.value
+        for keys, payload in ctx.read(0).readBatches():
+            for k in keys:
+                if int(k) in table:
+                    matched.add(1)
+                    total += table[int(k)]
+        return total
+
+    stage = MapStage(maps, ShuffleDependency(
+        P, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn)
+    got = sum(engine.run(ResultStage(P, reduce_fn, parents=[stage])))
+
+    all_keys = np.concatenate([
+        np.random.default_rng(t).integers(0, 64, rows) for t in range(maps)])
+    want_matched = int((all_keys < 32).sum())
+    assert row_count.value == maps * rows
+    assert matched.value == want_matched
+    assert got == int(sum(k * 10 for k in all_keys if k < 32))
+
+
+def test_accumulator_first_success_dedupe(cluster):
+    """Duplicate successful attempts of the same task (speculation's
+    normal outcome) merge their deltas exactly once, and a straggler
+    whose job generation has closed is dropped entirely."""
+    driver, execs = cluster
+    engine = DAGEngine(driver, execs)
+    acc = engine.accumulator("a")
+    engine._active_gens.add(1)
+    engine._gen_of_stage[7] = 1
+    engine._apply_acc_deltas(7, 3, {acc.acc_id: 5}, job_gen=1)
+    engine._apply_acc_deltas(7, 3, {acc.acc_id: 5}, job_gen=1)  # losing twin
+    engine._apply_acc_deltas(7, 4, {acc.acc_id: 2}, job_gen=1)
+    assert acc.value == 7
+    # job closes; an abandoned straggler carrying gen 1 lands late
+    engine._active_gens.discard(1)
+    engine._acc_applied.clear()
+    engine._apply_acc_deltas(7, 5, {acc.acc_id: 100}, job_gen=1)
+    assert acc.value == 7, "closed-generation straggler double-counted"
+
+
+def test_ledger_cleared_between_jobs_with_reused_stage_ids(cluster):
+    """Two sequential jobs reusing the same stage ids must both count:
+    the first-success ledger is per job, not per engine lifetime."""
+    driver, execs = cluster
+    P, maps, rows = 2, 2, 50
+    engine = DAGEngine(driver, execs)
+    acc = engine.accumulator("n")
+
+    def make_job():
+        # fresh stage objects each run, SAME default stage ids
+        def map_fn(ctx, writer, task_id):
+            keys = np.arange(rows, dtype=np.uint64)
+            writer.write((keys, u32_payload(keys.astype(np.uint32))))
+
+        def reduce_fn(ctx, task_id):
+            for keys, _ in ctx.read(0).readBatches():
+                acc.add(len(keys))
+            return None
+
+        stage = MapStage(maps, ShuffleDependency(
+            P, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn,
+            stage_id=900)
+        return ResultStage(P, reduce_fn, parents=[stage], stage_id=901)
+
+    engine.run(make_job())
+    engine.run(make_job())
+    assert acc.value == 2 * maps * rows
+
+
+def test_accumulator_outside_task_adds_directly(cluster):
+    driver, execs = cluster
+    engine = DAGEngine(driver, execs)
+    acc = engine.accumulator("direct")
+    acc.add(4)
+    acc.add(1)
+    assert acc.value == 5
+
+
+def test_broadcast_pickles_as_id_only():
+    """The handle must ship tiny — a closure capturing a broadcast of a
+    large value serializes without the value's bytes."""
+    import cloudpickle
+
+    class _FakeEp:
+        def register_broadcast(self, *a):
+            pass
+
+        def unregister_broadcast(self, *a):
+            pass
+
+    big = np.arange(1 << 20, dtype=np.uint8)
+    b = shared_vars.create_broadcast(big, _FakeEp())
+    try:
+        blob = cloudpickle.dumps(lambda: b.value.sum())
+        assert len(blob) < 4096, len(blob)
+        # local round trip resolves to the original, no fetch needed
+        restored = cloudpickle.loads(blob)
+        assert restored() == big.sum()
+    finally:
+        b.unpersist()
+
+
+def test_broadcast_unpersist_then_unpickle_elsewhere_errors():
+    """After unpersist, a foreign process' proxy (no local original, no
+    task fetch channel) fails loudly, not with a silent None."""
+    proxy = shared_vars._BroadcastProxy(999_999)
+    with pytest.raises(RuntimeError, match="outside a task"):
+        _ = proxy.value
+
+
+def test_accumulator_proxy_value_is_driver_only():
+    proxy = shared_vars._AccumulatorProxy(1, "x")
+    with pytest.raises(RuntimeError, match="driver-only"):
+        _ = proxy.value

@@ -104,19 +104,28 @@ def test_streamed_terasort_sentinel_keys_survive(mesh):
     assert int((got[:, 0] == 0xFFFFFFFF).sum()) == n_max
 
 
-def test_multisort_mode_matches_gather(mesh):
-    """sort_mode='multisort' (payload through the sort network, no gather)
-    is bit-identical to the gather path."""
+def test_sort_modes_match_gather(mesh):
+    """sort_mode='multisort' (payload through the sort network as rank-1
+    operands) and 'colsort' (one stable 2D sort with broadcast keys) are
+    bit-identical to the gather path — the stable per-column permutation
+    argument colsort relies on is proven here, duplicate keys included
+    (payload_words=6, 4096 rows over a 2^32 key space has collisions
+    across devices; seed 9 also collides within)."""
     from sparkrdma_tpu.models.terasort import (TeraSortConfig, generate_rows,
                                                run_terasort, verify_terasort)
 
     rows = generate_rows(TeraSortConfig(rows_per_device=512, payload_words=6),
                          8, seed=9)
+    # force key duplicates so tie-handling differences would surface
+    # (quantize to the top 12 bits: ~4k distinct keys over 4k rows, still
+    # uniform across the device ranges)
+    rows[:, 0] &= 0xFFF00000
     outs = {}
-    for mode in ("gather", "multisort"):
+    for mode in ("gather", "multisort", "colsort"):
         cfg = TeraSortConfig(rows_per_device=512, payload_words=6,
                              out_factor=2, sort_mode=mode)
         out, counts, _ = run_terasort(mesh, cfg, rows=rows)
         verify_terasort(out, counts, rows, 8)
         outs[mode] = out
     np.testing.assert_array_equal(outs["gather"], outs["multisort"])
+    np.testing.assert_array_equal(outs["gather"], outs["colsort"])
