@@ -154,12 +154,52 @@ def _engine_demo(use_mesh: bool = False) -> int:
         driver.stop()
 
 
+def _shuffle_service() -> int:
+    """Standalone shuffle service: adopt a dead executor's spill
+    directory and serve its COMMITTED map outputs so reducers finish
+    without recomputation — the role Spark's external shuffle service
+    plays (which the reference notably does not support: its MR
+    registrations die with the executor JVM). Here committed spills are
+    plain files + sidecar indexes, so any process can re-register them.
+
+    Usage:
+      python -m sparkrdma_tpu shuffle-service DRIVER_HOST:PORT SPILL_DIR \
+          [SERVICE_ID]
+    """
+    import signal
+    import threading
+
+    from sparkrdma_tpu.config import TpuShuffleConf
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+    if len(sys.argv) < 4:
+        print(_shuffle_service.__doc__)
+        return 2
+    host, port = sys.argv[2].rsplit(":", 1)
+    spill_dir = sys.argv[3]
+    service_id = sys.argv[4] if len(sys.argv) > 4 else "shuffle-svc"
+    mgr = TpuShuffleManager(TpuShuffleConf(), driver_addr=(host, int(port)),
+                            executor_id=service_id, spill_dir=spill_dir)
+    recovered = mgr.recover_and_republish()
+    n_maps = sum(len(v) for v in recovered.values())
+    print(f"shuffle-service {service_id}: serving {n_maps} recovered map "
+          f"outputs across {len(recovered)} shuffles from {spill_dir}",
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    mgr.stop()
+    return 0
+
+
 def main() -> int:
     cmd = sys.argv[1] if len(sys.argv) > 1 else "info"
     handlers = {"info": _info, "config": _config,
                 "selftest": _selftest, "demo": _demo,
                 "engine-demo": _engine_demo,
-                "engine-mesh-demo": lambda: _engine_demo(use_mesh=True)}
+                "engine-mesh-demo": lambda: _engine_demo(use_mesh=True),
+                "shuffle-service": _shuffle_service}
     if cmd not in handlers:
         print(f"usage: python -m sparkrdma_tpu {{{' | '.join(handlers)}}}")
         return 2

@@ -28,6 +28,7 @@ import itertools
 import logging
 import pickle
 import threading
+import weakref
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Optional
 
@@ -37,15 +38,25 @@ _ids = itertools.count(1)
 _tl = threading.local()  # .sink: Dict[int, Any] | .fetch: Callable
 
 # worker-process broadcast cache, FIFO-capped so long-lived executors
-# hosting many jobs don't grow without bound
+# hosting many jobs don't grow without bound; _inflight serializes the
+# FIRST fetch per id so k concurrent tasks cost one transfer, not k
 _CACHE_CAP = 64
 _cache: Dict[int, Any] = {}
+_inflight: Dict[int, threading.Lock] = {}
 _cache_lock = threading.Lock()
 
 # originals living in THIS process (driver): unpickling a handle here
-# (in-process executors, local round-trips) resolves without any RPC
-_local: Dict[int, "Broadcast"] = {}
+# (in-process executors, local round-trips) resolves without any RPC.
+# WEAK values: dropping the last user reference to a Broadcast lets the
+# value be collected, and a finalizer drops the driver-endpoint blob too
+# (the ContextCleaner role in Spark) — a long-lived driver that never
+# calls unpersist() still doesn't grow without bound.
+_local: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
 _local_lock = threading.Lock()
+
+
+def _sink_add(sink: Dict[int, Any], acc_id: int, n: Any) -> None:
+    sink[acc_id] = (sink[acc_id] + n) if acc_id in sink else n
 
 
 class Broadcast:
@@ -84,16 +95,22 @@ class _BroadcastProxy:
         with _cache_lock:
             if self.bcast_id in _cache:
                 return _cache[self.bcast_id]
-        fetch = getattr(_tl, "fetch", None)
-        if fetch is None:
-            raise RuntimeError(
-                f"broadcast {self.bcast_id} accessed outside a task "
-                "context (no fetch channel to the driver)")
-        value = pickle.loads(fetch(self.bcast_id))
-        with _cache_lock:
-            while len(_cache) >= _CACHE_CAP:
-                _cache.pop(next(iter(_cache)))
-            _cache[self.bcast_id] = value
+            gate = _inflight.setdefault(self.bcast_id, threading.Lock())
+        with gate:  # concurrent first accesses: one fetch, losers wait
+            with _cache_lock:
+                if self.bcast_id in _cache:
+                    return _cache[self.bcast_id]
+            fetch = getattr(_tl, "fetch", None)
+            if fetch is None:
+                raise RuntimeError(
+                    f"broadcast {self.bcast_id} accessed outside a task "
+                    "context (no fetch channel to the driver)")
+            value = pickle.loads(fetch(self.bcast_id))
+            with _cache_lock:
+                while len(_cache) >= _CACHE_CAP:
+                    _cache.pop(next(iter(_cache)))
+                _cache[self.bcast_id] = value
+                _inflight.pop(self.bcast_id, None)
         return value
 
     def __reduce__(self):
@@ -107,10 +124,17 @@ def _load_broadcast(bcast_id: int):
 
 
 def create_broadcast(value: Any, driver_ep) -> Broadcast:
-    """Pickle once, register with the driver endpoint, return the handle."""
+    """Pickle once, register with the driver endpoint, return the handle.
+
+    Lifetime: the returned handle is the owner. When the caller drops its
+    last reference (and no in-flight task closure holds one), the value
+    becomes collectable and a finalizer unregisters the driver-side blob
+    — Spark's ContextCleaner role, so un-unpersisted broadcasts don't pin
+    driver memory forever."""
     bcast_id = next(_ids)
     driver_ep.register_broadcast(bcast_id, pickle.dumps(value))
     b = Broadcast(bcast_id, value, driver_ep)
+    weakref.finalize(b, driver_ep.unregister_broadcast, bcast_id)
     with _local_lock:
         _local[bcast_id] = b
     return b
@@ -130,8 +154,7 @@ class Accumulator:
     def add(self, n: Any) -> None:
         sink = getattr(_tl, "sink", None)
         if sink is not None:
-            sink[self.acc_id] = (sink[self.acc_id] + n
-                                 if self.acc_id in sink else n)
+            _sink_add(sink, self.acc_id, n)
         else:
             # driver code outside any task (Spark allows this too)
             with self._lock:
@@ -166,8 +189,7 @@ class _AccumulatorProxy:
         if sink is None:
             raise RuntimeError(
                 f"accumulator {self.name!r} add() outside a task context")
-        sink[self.acc_id] = (sink[self.acc_id] + n
-                             if self.acc_id in sink else n)
+        _sink_add(sink, self.acc_id, n)
 
     @property
     def value(self) -> Any:

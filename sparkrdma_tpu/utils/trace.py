@@ -14,11 +14,14 @@ module-level NULL tracer's span() is a no-op context manager).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from contextlib import contextmanager
 from typing import List, Optional
+
+log = logging.getLogger(__name__)
 
 
 class Tracer:
@@ -80,6 +83,34 @@ class Tracer:
             json.dump({"traceEvents": meta + events,
                        "displayTimeUnit": "ms"}, f)
         return len(events)
+
+
+@contextmanager
+def device_profile(log_dir: str):
+    """Capture an XLA device profile (TensorBoard/Perfetto format) around
+    a block: compiled-step timelines, HBM transfers and fusion names the
+    host-side span tracer cannot see. The TPU-native upgrade of the
+    reference's wall-clock logging — pair with ``Tracer`` spans to line
+    host orchestration up against device execution.
+
+    No-ops (with a warning) when jax.profiler is unavailable so callers
+    can leave it on unconditionally in tooling.
+    """
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # noqa: BLE001 — profiling must never break a job
+        log.warning("device profile unavailable: %s", e)
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            log.warning("device profile stop failed", exc_info=True)
 
 
 class _NullTracer(Tracer):

@@ -83,3 +83,72 @@ def test_recover_ignores_uncommitted(tmp_path):
     assert [m for m, _ in recovered[1]] == [1] and list(recovered) == [1]
     assert r.get_output_table(1, 1) is not None
     r.stop()
+
+
+def test_standalone_shuffle_service_process(tmp_path):
+    """The ``shuffle-service`` CLI as a real PROCESS: it adopts a dead
+    executor's spill directory, re-publishes the committed outputs, and
+    reducers complete without recomputation (the external-shuffle-service
+    role the reference cannot play — its MR registrations die with the
+    executor)."""
+    import os
+    import subprocess
+    import sys
+
+    driver = TpuShuffleManager(CONF, is_driver=True)
+    execs = [TpuShuffleManager(CONF, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(2)]
+    for ex in execs:
+        ex.executor.wait_for_members(2)
+    svc = None
+    try:
+        handle = driver.register_shuffle(5, num_maps=4, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        rng = np.random.default_rng(3)
+        truth = []
+        for m in range(4):
+            keys = rng.integers(0, 9999, 200).astype(np.uint64)
+            w = execs[m % 2].get_writer(handle, m)
+            w.write_batch(keys)
+            w.close()
+            truth.append(keys)
+        expect = np.sort(np.concatenate(truth))
+
+        lost = execs[1].executor.manager_id
+        execs[1].executor.stop()
+        if execs[1].block_server is not None:
+            execs[1].block_server.stop()
+        driver.driver.remove_member(lost)
+        time.sleep(0.3)
+
+        host, port = driver.driver_addr
+        env = dict(os.environ, PYTHONPATH=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        svc = subprocess.Popen(
+            [sys.executable, "-m", "sparkrdma_tpu", "shuffle-service",
+             f"{host}:{port}", str(tmp_path / "e1"), "svc1"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        # banner read with a deadline: a wedged service must FAIL the
+        # test, not hang the suite on a blocking readline
+        import queue
+        import threading
+
+        banner: "queue.Queue[str]" = queue.Queue()
+        threading.Thread(target=lambda: banner.put(svc.stdout.readline()),
+                         daemon=True).start()
+        line = banner.get(timeout=30)
+        assert "serving 2 recovered map outputs" in line, line
+
+        execs[0].executor.invalidate_shuffle(5)
+        keys, _ = execs[0].get_reader(handle, 0, 4).read_all()
+        np.testing.assert_array_equal(np.sort(keys), expect)
+    finally:
+        if svc is not None:
+            svc.terminate()
+            svc.wait(timeout=10)
+        for ex in execs:
+            ex.stop()
+        driver.stop()

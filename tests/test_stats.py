@@ -45,3 +45,19 @@ def test_mem_stats_diff_monotonic():
     assert d["peak_rss_kb"] > 0
     p = process_stats()
     assert p["pid"] > 0
+
+
+def test_device_profile_captures_xla_trace(tmp_path):
+    """utils.trace.device_profile wraps a jitted step and leaves an XLA
+    profile on disk (the device-side half of the observability story)."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparkrdma_tpu.utils.trace import device_profile
+
+    with device_profile(str(tmp_path)):
+        jax.block_until_ready(jax.jit(lambda x: x * 2 + 1)(jnp.ones(128)))
+    found = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
+    assert found, "no xplane profile written"

@@ -92,6 +92,26 @@ def test_terasort_multisort_compiles_for_tpu(tpu_mesh):
     _lower_compile(step, rows)
 
 
+def test_terasort_colsort_compiles_for_tpu(tpu_mesh):
+    """The broadcast-key stable 2D sort strategy passes the v5e compiler.
+    This is the mode built to dodge multisort's ~16s/operand compile
+    blowup, so its own compile must stay cheap — asserted with a bound
+    loose enough for CI noise but far under multisort's minutes."""
+    import time
+
+    from sparkrdma_tpu.models.terasort import TeraSortConfig, make_terasort_step
+
+    cfg = TeraSortConfig(rows_per_device=256, payload_words=24, out_factor=2,
+                         sort_mode="colsort")
+    step = make_terasort_step(tpu_mesh, AXIS, cfg)
+    rows = jax.ShapeDtypeStruct((8 * cfg.rows_per_device, 25), jnp.uint32,
+                                sharding=NamedSharding(tpu_mesh, P(AXIS)))
+    t0 = time.monotonic()
+    _lower_compile(step, rows)
+    assert time.monotonic() - t0 < 120, \
+        "colsort compile no longer cheap — its reason to exist"
+
+
 def test_ring_kernel_mosaic_compiles(tpu_mesh):
     """The hand-scheduled Pallas ring (remote DMAs + neighbor barrier)
     passes Mosaic in compiled mode — the barrier code interpret mode can't
