@@ -172,7 +172,8 @@ def _shuffle_service() -> int:
     from sparkrdma_tpu.config import TpuShuffleConf
     from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
 
-    if len(sys.argv) < 4:
+    if len(sys.argv) < 4 or ":" not in sys.argv[2] \
+            or not sys.argv[2].rsplit(":", 1)[1].isdigit():
         print(_shuffle_service.__doc__)
         return 2
     host, port = sys.argv[2].rsplit(":", 1)

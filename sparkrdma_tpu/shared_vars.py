@@ -96,22 +96,27 @@ class _BroadcastProxy:
             if self.bcast_id in _cache:
                 return _cache[self.bcast_id]
             gate = _inflight.setdefault(self.bcast_id, threading.Lock())
-        with gate:  # concurrent first accesses: one fetch, losers wait
+        try:
+            with gate:  # concurrent first accesses: one fetch, losers wait
+                with _cache_lock:
+                    if self.bcast_id in _cache:
+                        return _cache[self.bcast_id]
+                fetch = getattr(_tl, "fetch", None)
+                if fetch is None:
+                    raise RuntimeError(
+                        f"broadcast {self.bcast_id} accessed outside a task "
+                        "context (no fetch channel to the driver)")
+                value = pickle.loads(fetch(self.bcast_id))
+                with _cache_lock:
+                    while len(_cache) >= _CACHE_CAP:
+                        _cache.pop(next(iter(_cache)))
+                    _cache[self.bcast_id] = value
+            return value
+        finally:
+            # drop the gate on failure too: a driver that unpersisted the
+            # blob would otherwise leak one Lock per failed bcast_id forever
             with _cache_lock:
-                if self.bcast_id in _cache:
-                    return _cache[self.bcast_id]
-            fetch = getattr(_tl, "fetch", None)
-            if fetch is None:
-                raise RuntimeError(
-                    f"broadcast {self.bcast_id} accessed outside a task "
-                    "context (no fetch channel to the driver)")
-            value = pickle.loads(fetch(self.bcast_id))
-            with _cache_lock:
-                while len(_cache) >= _CACHE_CAP:
-                    _cache.pop(next(iter(_cache)))
-                _cache[self.bcast_id] = value
                 _inflight.pop(self.bcast_id, None)
-        return value
 
     def __reduce__(self):
         return (_load_broadcast, (self.bcast_id,))
