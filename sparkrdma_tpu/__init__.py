@@ -35,6 +35,8 @@ Subpackages
 ``engine``    DAG/stage scheduler driving the drop-in SPI (DAGScheduler equiv).
 ``tasks``     cloudpickle task shipping to executor processes (task scheduler equiv).
 ``shared_vars``  broadcasts + accumulators (Spark shared-variables equiv).
+``rdd``       RDD-style lazy API (map/filter/reduceByKey/join/sortByKey)
+              compiled onto the engine — the pyspark-shaped front half.
 """
 
 __version__ = "0.1.0"
@@ -57,6 +59,9 @@ def __getattr__(name):
     if name in ("Broadcast", "Accumulator"):
         from sparkrdma_tpu import shared_vars
         return getattr(shared_vars, name)
+    if name in ("EngineContext", "RDD"):
+        from sparkrdma_tpu import rdd
+        return getattr(rdd, name)
     if name == "ShuffleDependency":
         from sparkrdma_tpu.shuffle.spark_compat import ShuffleDependency
         return ShuffleDependency

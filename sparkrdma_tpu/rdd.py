@@ -1,0 +1,535 @@
+"""RDD-style high-level API compiled onto the DAG engine.
+
+The reference is only ever driven through Spark's RDD API — a user types
+``rdd.map(...).reduceByKey(...).collect()`` and Spark's DAGScheduler turns
+that into the stage graph that calls the shuffle SPI
+(scala/RdmaShuffleManager.scala:143-310). A standalone framework needs that
+front half too: this module is a lazy RDD planner that fuses narrow
+transformations (map/filter/flatMap run inside one task, Spark's stage
+pipelining) and places one :class:`engine.MapStage` per wide dependency
+(partitionBy / groupByKey / reduceByKey / sortByKey / cogroup), then runs
+the plan with :meth:`engine.DAGEngine.run` — so every RDD job exercises the
+exact register/getWriter/getReader/unregister sequence, stage retry,
+speculation, and (with a mesh) the ICI collective data plane underneath.
+
+Record model: this layer carries **arbitrary Python objects**. A shuffle
+serializes each map task's per-partition record list into one pickled blob,
+framed with a u64 length and chunked into fixed-width rows
+(``row_payload_bytes``), routed with the ``modulo`` partitioner (row key =
+destination partition). The vectorized (keys, payload-matrix) batch API of
+``shuffle/spark_compat.py`` remains the performance surface — the in-tree
+model drivers use it directly; this layer is the usability surface, like
+pyspark's RDDs over Spark's JVM core.
+
+Determinism contract: transformations must be deterministic (the engine
+recomputes lost partitions from lineage, exactly Spark's rule), and keys
+must hash stably across processes (``portable_hash`` below — ints, strs,
+bytes, tuples are stable; other types hash via their pickle bytes).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from sparkrdma_tpu.engine import DAGEngine, MapStage, ResultStage
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec
+from sparkrdma_tpu.shuffle.spark_compat import ShuffleDependency
+
+_LEN = struct.Struct("<Q")
+
+
+def portable_hash(key) -> int:
+    """Process-stable hash (builtin ``hash`` is salted per process for
+    strings — useless for routing records across executors; pyspark pins
+    PYTHONHASHSEED for the same reason)."""
+    import hashlib
+
+    # numeric cross-type equality (True == 1 == 1.0) must mean same
+    # partition, like builtin hash; bools and integral floats collapse to
+    # the int path before mixing
+    if isinstance(key, bool):
+        key = int(key)
+    elif isinstance(key, (float, np.floating)):
+        if float(key).is_integer():
+            key = int(key)
+    if isinstance(key, (int, np.integer)):
+        # splitmix-style mix so dense int keys spread over partitions
+        h = int(key) & 0xFFFFFFFFFFFFFFFF
+        h = (h ^ (h >> 30)) * 0xBF58476D1CE4E5B9 & 0xFFFFFFFFFFFFFFFF
+        h = (h ^ (h >> 27)) * 0x94D049BB133111EB & 0xFFFFFFFFFFFFFFFF
+        return (h ^ (h >> 31)) & 0x7FFFFFFFFFFFFFFF
+    if isinstance(key, (float, np.floating)):
+        data = struct.pack("<d", float(key))
+    elif isinstance(key, str):
+        data = key.encode()
+    elif isinstance(key, bytes):
+        data = key
+    elif isinstance(key, tuple):
+        return portable_hash(tuple(portable_hash(k) for k in key)
+                             .__repr__().encode())
+    else:
+        data = pickle.dumps(key, protocol=4)
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(),
+                          "little") & 0x7FFFFFFFFFFFFFFF
+
+
+def _encode_blob(obj, part: int, width: int) -> Tuple[np.ndarray, np.ndarray]:
+    """One partition's records -> (row keys, fixed-width rows): u64 length
+    + pickle bytes, zero-padded up to a whole number of ``width`` rows."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    total = _LEN.size + len(payload)
+    n = -(-total // width)
+    buf = np.zeros(n * width, dtype=np.uint8)
+    buf[:_LEN.size] = np.frombuffer(_LEN.pack(len(payload)), dtype=np.uint8)
+    buf[_LEN.size:total] = np.frombuffer(payload, dtype=np.uint8)
+    return np.full(n, part, dtype=np.uint64), buf.reshape(n, width)
+
+
+def _decode_blobs(batches) -> Iterator[object]:
+    """Invert :func:`_encode_blob` over reader batches.
+
+    Each map's blob occupies consecutive rows in write order (one
+    grouped fetch per (map, partition) — shuffle/fetcher.py groups at
+    partition granularity, so a blob is never split or interleaved);
+    batch boundaries may fall anywhere, so parse over a rolling buffer.
+    """
+    buf = b""
+    for _keys, rows in batches:
+        width = rows.shape[1]
+        buf = buf + rows.tobytes() if buf else rows.tobytes()
+        off = 0
+        while len(buf) - off >= _LEN.size:
+            (ln,) = _LEN.unpack_from(buf, off)
+            span = -(-(_LEN.size + ln) // width) * width
+            if len(buf) - off < span:
+                break
+            yield pickle.loads(buf[off + _LEN.size: off + _LEN.size + ln])
+            off += span
+        buf = buf[off:]
+    if buf:
+        raise ValueError(
+            f"{len(buf)} trailing shuffle bytes did not frame a blob — "
+            "corrupt stream or rows reordered within a map's partition")
+
+
+# -- plan nodes -----------------------------------------------------------
+#
+# An RDD is a lazy lineage DAG. Compilation walks it backwards: narrow
+# nodes fuse into their consumer's task function; each _Shuffled /
+# _CoGrouped node becomes one MapStage (memoized — shared lineage runs
+# once per job, like Spark's stage dedup within a job).
+
+
+@dataclass
+class _Source:
+    bcast: object           # Broadcast of the partition list
+    n: int                  # partition count
+
+    def num_partitions(self) -> int:
+        return self.n
+
+
+@dataclass
+class _Narrow:
+    parent: object
+    xform: Callable[[Iterator], Iterator]
+
+    def num_partitions(self) -> int:
+        return self.parent.num_partitions()
+
+
+@dataclass
+class _Shuffled:
+    """One wide dependency. ``mode``:
+
+    * ``records`` — reduce side replays the records (partitionBy)
+    * ``group``   — reduce side yields (k, [v, ...])     (groupByKey)
+    * ``reduce``  — map-side combine with ``merge``, reduce side merges
+      partial aggregates: yields (k, merged)             (reduceByKey)
+    """
+
+    parent: object
+    parts: int
+    mode: str = "records"
+    merge: Optional[Callable] = None
+    part_fn: Optional[Callable[[object], int]] = None  # default hash%P
+
+    def num_partitions(self) -> int:
+        return self.parts
+
+    def route(self, key) -> int:
+        if self.part_fn is not None:
+            return self.part_fn(key)
+        return portable_hash(key) % self.parts
+
+
+@dataclass
+class _CoGrouped:
+    """Two co-partitioned wide parents; yields (k, (left_vals, right_vals))."""
+
+    left: _Shuffled
+    right: _Shuffled
+    parts: int
+
+    def num_partitions(self) -> int:
+        return self.parts
+
+
+class RDD:
+    """Lazy distributed collection. Build lineage with transformations,
+    evaluate with an action. Spark's camelCase names are aliased so code
+    written against pyspark's RDD shapes ports mechanically."""
+
+    def __init__(self, ctx: "EngineContext", node):
+        self._ctx = ctx
+        self._node = node
+
+    # -- narrow transformations ------------------------------------------
+
+    def map(self, f) -> "RDD":
+        return self.map_partitions(lambda it, _f=f: (_f(x) for x in it))
+
+    def filter(self, f) -> "RDD":
+        return self.map_partitions(lambda it, _f=f: (x for x in it if _f(x)))
+
+    def flat_map(self, f) -> "RDD":
+        return self.map_partitions(
+            lambda it, _f=f: (y for x in it for y in _f(x)))
+
+    def map_partitions(self, f) -> "RDD":
+        """f(iterator) -> iterator, once per partition (the fusion unit)."""
+        return RDD(self._ctx, _Narrow(self._node, f))
+
+    def map_values(self, f) -> "RDD":
+        return self.map_partitions(
+            lambda it, _f=f: ((k, _f(v)) for k, v in it))
+
+    def keys(self) -> "RDD":
+        return self.map_partitions(lambda it: (k for k, _ in it))
+
+    def values(self) -> "RDD":
+        return self.map_partitions(lambda it: (v for _, v in it))
+
+    def glom(self) -> "RDD":
+        return self.map_partitions(lambda it: iter([list(it)]))
+
+    def distinct(self, num_partitions: Optional[int] = None) -> "RDD":
+        return (self.map(lambda x: (x, None))
+                .reduce_by_key(lambda a, b: None, num_partitions)
+                .keys())
+
+    # -- wide transformations --------------------------------------------
+
+    def partition_by(self, num_partitions: Optional[int] = None) -> "RDD":
+        """Hash-repartition (k, v) records (Spark's partitionBy)."""
+        return RDD(self._ctx, _Shuffled(self._node,
+                                        self._parts(num_partitions)))
+
+    def group_by_key(self, num_partitions: Optional[int] = None) -> "RDD":
+        return RDD(self._ctx, _Shuffled(self._node,
+                                        self._parts(num_partitions),
+                                        mode="group"))
+
+    def reduce_by_key(self, f, num_partitions: Optional[int] = None) -> "RDD":
+        """Map-side combined aggregation — each map task pre-merges its
+        records per key before the shuffle (the aggregator half Spark
+        applies before spilling), so shuffle bytes scale with distinct
+        keys, not records."""
+        return RDD(self._ctx, _Shuffled(self._node,
+                                        self._parts(num_partitions),
+                                        mode="reduce", merge=f))
+
+    def sort_by_key(self, num_partitions: Optional[int] = None,
+                    ascending: bool = True, sample_size: int = 512) -> "RDD":
+        """Global sort: a sampling pass picks P-1 range splitters (Spark's
+        RangePartitioner runs the same extra sampling job over the
+        lineage), records range-partition to ordered partitions, and each
+        partition sorts locally — partition i's keys all precede
+        partition i+1's (TeraSort's output contract)."""
+        parts = self._parts(num_partitions)
+        if parts > 1:
+            # splitters stay ASCENDING either way (bisect requires it);
+            # descending order flips the partition index instead
+            sample = self._sample_keys(sample_size)
+            idx = [round(len(sample) * i / parts) for i in range(1, parts)]
+            splitters = [sample[min(i, len(sample) - 1)] for i in idx] \
+                if sample else []
+        else:
+            splitters = []
+
+        def route(key, _s=splitters, _asc=ascending):
+            import bisect
+            if not _s:
+                return 0
+            i = bisect.bisect_right(_s, key)
+            return i if _asc else len(_s) - i
+
+        shuffled = RDD(self._ctx, _Shuffled(self._node, parts,
+                                            part_fn=route))
+        return shuffled.map_partitions(
+            lambda it, _asc=ascending: iter(
+                sorted(it, key=lambda kv: kv[0], reverse=not _asc)))
+
+    def cogroup(self, other: "RDD",
+                num_partitions: Optional[int] = None) -> "RDD":
+        parts = self._parts(num_partitions)
+        left = _Shuffled(self._node, parts)
+        right = _Shuffled(other._node, parts)
+        return RDD(self._ctx, _CoGrouped(left, right, parts))
+
+    def join(self, other: "RDD",
+             num_partitions: Optional[int] = None) -> "RDD":
+        """Inner equi-join -> (k, (v_left, v_right))."""
+        return self.cogroup(other, num_partitions).map_partitions(
+            lambda it: ((k, (a, b)) for k, (ls, rs) in it
+                        for a in ls for b in rs))
+
+    # -- actions ----------------------------------------------------------
+
+    def collect(self) -> list:
+        return [x for part in self._run(list) for x in part]
+
+    def count(self) -> int:
+        return sum(self._run(lambda it: sum(1 for _ in it)))
+
+    def first(self):
+        got = self.take(1)
+        if not got:
+            raise ValueError("RDD is empty")
+        return got[0]
+
+    def take(self, n: int) -> list:
+        import itertools
+        out: list = []
+        for part in self._run(
+                lambda it, _n=n: list(itertools.islice(it, _n))):
+            out.extend(part)
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def reduce(self, f):
+        import functools
+
+        def fold(it, _f=f):
+            acc, found = None, False
+            for x in it:
+                acc = x if not found else _f(acc, x)
+                found = True
+            return found, acc
+
+        vals = [v for found, v in self._run(fold) if found]
+        if not vals:
+            raise ValueError("reduce() of empty RDD")
+        return functools.reduce(f, vals)
+
+    # -- aliases (the pyspark-shaped surface) -----------------------------
+
+    flatMap = flat_map
+    mapPartitions = map_partitions
+    mapValues = map_values
+    partitionBy = partition_by
+    groupByKey = group_by_key
+    reduceByKey = reduce_by_key
+    sortByKey = sort_by_key
+
+    # -- internals --------------------------------------------------------
+
+    @property
+    def num_partitions(self) -> int:
+        return self._node.num_partitions()
+
+    def _parts(self, num_partitions: Optional[int]) -> int:
+        return num_partitions or self._node.num_partitions()
+
+    def _sample_keys(self, sample_size: int) -> list:
+        """Sampling job for sortByKey: up to ``sample_size`` keys per
+        partition, random but seeded per task (recompute-deterministic)."""
+        def sample(it, _n=sample_size):
+            import random
+            rng = random.Random(0x5EED)
+            seen: list = []
+            for i, (k, _v) in enumerate(it):
+                if len(seen) < _n:
+                    seen.append(k)
+                else:  # reservoir
+                    j = rng.randint(0, i)
+                    if j < _n:
+                        seen[j] = k
+            return seen
+
+        return sorted(k for part in self._run(sample) for k in part)
+
+    def _run(self, finalize: Callable[[Iterator], object]) -> List[object]:
+        """Compile the lineage into engine stages and run it."""
+        memo: dict = {}
+        builder, parents = _chain(self._node, memo, self._ctx)
+        _wire_slots(builder)
+
+        def task_fn(tc, task_id, _b=builder, _fin=finalize):
+            return _fin(_b(tc, task_id))
+
+        final = ResultStage(self._node.num_partitions(), task_fn,
+                            parents=parents)
+        return self._ctx.engine.run(final)
+
+
+def _chain(node, memo: dict, ctx: "EngineContext"):
+    """(iterator builder, direct parent MapStages) for ``node``.
+
+    Narrow chains fuse; each wide node becomes a memoized MapStage and a
+    reader slot (``tc.read(i)``) in the consuming stage."""
+    if isinstance(node, _Source):
+        bcast = node.bcast
+
+        def build(tc, task_id, _b=bcast):
+            return iter(_b.value[task_id])
+
+        build._boundary = None
+        return build, []
+
+    if isinstance(node, _Narrow):
+        inner, parents = _chain(node.parent, memo, ctx)
+
+        def build(tc, task_id, _inner=inner, _f=node.xform):
+            return _f(_inner(tc, task_id))
+
+        build._boundary = inner._boundary
+        return build, parents
+
+    if isinstance(node, _Shuffled):
+        stage = _shuffle_stage(node, memo, ctx)
+
+        def build(tc, task_id, _mode=node.mode, _merge=node.merge):
+            return _reduce_side(tc.read(build._slot).readBatches(),
+                                _mode, _merge)
+
+        build._slot = None  # wired by _wire_slots before the job runs
+        build._boundary = build
+        return build, [stage]
+
+    if isinstance(node, _CoGrouped):
+        lstage = _shuffle_stage(node.left, memo, ctx)
+        rstage = _shuffle_stage(node.right, memo, ctx)
+
+        def build(tc, task_id):
+            groups: dict = {}
+            for k, v in _reduce_side(
+                    tc.read(build._lslot).readBatches(), "records", None):
+                groups.setdefault(k, ([], []))[0].append(v)
+            for k, v in _reduce_side(
+                    tc.read(build._rslot).readBatches(), "records", None):
+                groups.setdefault(k, ([], []))[1].append(v)
+            return iter(groups.items())
+
+        build._lslot = build._rslot = None
+        build._boundary = build
+        return build, [lstage, rstage]
+
+    raise TypeError(f"unknown plan node {type(node).__name__}")
+
+
+def _reduce_side(batches, mode: str, merge) -> Iterator:
+    """Decode one partition's blobs and apply the wide op's semantics."""
+    if mode == "records":
+        for records in _decode_blobs(batches):
+            yield from records
+        return
+    acc: dict = {}
+    for records in _decode_blobs(batches):
+        if mode == "group":
+            for k, v in records:
+                acc.setdefault(k, []).append(v)
+        else:  # "reduce": records are map-side partial aggregates
+            for k, v in records:
+                acc[k] = merge(acc[k], v) if k in acc else v
+    yield from acc.items()
+
+
+def _shuffle_stage(node: _Shuffled, memo: dict, ctx: "EngineContext"):
+    """Memoized MapStage for one wide dependency."""
+    if id(node) in memo:
+        return memo[id(node)]
+    inner, parents = _chain(node.parent, memo, ctx)
+    _wire_slots(inner)
+    width = ctx.row_bytes
+    dep = ShuffleDependency(node.parts, PartitionerSpec("modulo"),
+                            row_payload_bytes=width)
+
+    def task_fn(tc, writer, task_id, _inner=inner, _node=node, _w=width):
+        buckets: dict = {}
+        if _node.mode == "reduce":
+            for k, v in _inner(tc, task_id):
+                b = buckets.setdefault(_node.route(k), {})
+                b[k] = _node.merge(b[k], v) if k in b else v
+            items = ((p, list(d.items())) for p, d in buckets.items())
+        else:
+            for k, v in _inner(tc, task_id):
+                buckets.setdefault(_node.route(k), []).append((k, v))
+            items = buckets.items()
+        for p, records in items:
+            writer.write(_encode_blob(records, p, _w))
+
+    stage = MapStage(node.parent.num_partitions(), dep, task_fn,
+                     parents=parents)
+    memo[id(node)] = stage
+    return stage
+
+
+def _wire_slots(builder) -> None:
+    """Wire a consuming chain's boundary builder to its tc.read() slots.
+
+    A fused chain reads at most one boundary node directly — a single
+    _Shuffled (slot 0) or one _CoGrouped pair (slots 0, 1); anything
+    further upstream is behind that boundary's own map stage. Narrow
+    wrappers propagate ``_boundary`` so the attribute is reachable from
+    the chain's outermost builder."""
+    b = builder._boundary
+    if b is None:
+        return
+    if hasattr(b, "_slot"):
+        b._slot = 0
+    if hasattr(b, "_lslot"):
+        b._lslot, b._rslot = 0, 1
+
+
+class EngineContext:
+    """The SparkContext analogue: makes RDDs, owns defaults.
+
+    ``engine`` is a :class:`sparkrdma_tpu.engine.DAGEngine`; every action
+    compiles to one ``engine.run`` job, so RDD jobs get stage retry,
+    speculation, shared variables, task shipping to executor processes,
+    and the mesh data plane exactly as hand-built stage graphs do.
+    """
+
+    def __init__(self, engine: DAGEngine, default_parallelism: int = 0,
+                 row_bytes: int = 1024):
+        self.engine = engine
+        self.default_parallelism = (default_parallelism
+                                    or max(2, len(engine.executors)))
+        # fixed row width for object-blob shuffles: 8B u64 key per row on
+        # the wire, zero-pad only in each blob's last row
+        self.row_bytes = row_bytes
+
+    def parallelize(self, data: Iterable, num_slices: int = 0) -> RDD:
+        """Distribute a local collection. The partition list rides the
+        driver's broadcast plane (one fetch per executor process), not
+        each task's closure."""
+        items = list(data)
+        n = max(1, min(num_slices or self.default_parallelism,
+                       max(1, len(items))))
+        step = -(-len(items) // n) or 1
+        # n slices exactly; trailing ones come out empty via short slices
+        parts = [items[i * step:(i + 1) * step] for i in range(n)]
+        return RDD(self, _Source(self.engine.broadcast(parts), n))
+
+    def broadcast(self, value):
+        return self.engine.broadcast(value)
+
+    def accumulator(self, name: str, zero=0):
+        return self.engine.accumulator(name, zero)
