@@ -194,12 +194,67 @@ def _shuffle_service() -> int:
     return 0
 
 
+def _rdd_demo() -> int:
+    """Word-count + global sort through the RDD API (the pyspark-shaped
+    front half) over a 3-executor in-process cluster: textFile ->
+    flatMap -> reduceByKey (map-side combine) -> sortByKey ->
+    saveAsTextFile, every shuffle through the full SPI underneath."""
+    import tempfile
+    import os
+
+    from sparkrdma_tpu.config import TpuShuffleConf
+    from sparkrdma_tpu.engine import DAGEngine
+    from sparkrdma_tpu.rdd import EngineContext
+    from sparkrdma_tpu.shuffle.spark_compat import SparkCompatShuffleManager
+
+    conf = TpuShuffleConf()
+    driver = SparkCompatShuffleManager(conf, isDriver=True)
+    execs = [SparkCompatShuffleManager(
+        conf, driverAddr=driver.driverAddr, executorId=str(i),
+        spill_dir=tempfile.mkdtemp()) for i in range(3)]
+    try:
+        for e in execs:
+            e.native.executor.wait_for_members(3)
+        workdir = tempfile.mkdtemp()
+        src = os.path.join(workdir, "input.txt")
+        vocab = ["shuffle", "exchange", "mesh", "ici", "spill", "stage"]
+        with open(src, "w") as f:
+            for i in range(5000):
+                f.write(vocab[i * 7 % len(vocab)] + " "
+                        + vocab[i * 3 % len(vocab)] + "\n")
+        ctx = EngineContext(DAGEngine(driver, execs))
+        out = os.path.join(workdir, "counts")
+        (ctx.text_file(src, 6)
+            .flat_map(str.split)
+            .map(lambda w: (w, 1))
+            .reduce_by_key(lambda a, b: a + b, 4)
+            .sort_by_key(2)
+            .map(lambda kv: f"{kv[0]}\t{kv[1]}")
+            .save_as_text_file(out))
+        lines = []
+        for part in sorted(os.listdir(out)):
+            if part.startswith("part-"):
+                lines += open(os.path.join(out, part)).read().splitlines()
+        total = sum(int(ln.split("\t")[1]) for ln in lines)
+        print(json.dumps({"demo": "rdd-wordcount", "distinct_words":
+                          len(lines), "total_words": total,
+                          "sorted": lines == sorted(lines),
+                          "verified": total == 10000
+                          and len(lines) == len(vocab)}))
+        return 0
+    finally:
+        for e in execs:
+            e.stop()
+        driver.stop()
+
+
 def main() -> int:
     cmd = sys.argv[1] if len(sys.argv) > 1 else "info"
     handlers = {"info": _info, "config": _config,
                 "selftest": _selftest, "demo": _demo,
                 "engine-demo": _engine_demo,
                 "engine-mesh-demo": lambda: _engine_demo(use_mesh=True),
+                "rdd-demo": _rdd_demo,
                 "shuffle-service": _shuffle_service}
     if cmd not in handlers:
         print(f"usage: python -m sparkrdma_tpu {{{' | '.join(handlers)}}}")

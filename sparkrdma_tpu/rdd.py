@@ -135,6 +135,39 @@ class _Source:
 
 
 @dataclass
+class _FileSource:
+    """Byte-range splits over text files (Hadoop input-split rule: a
+    split owns every line that STARTS inside [start, end); a reader
+    seeks to start and skips the partial first line, which the previous
+    split read past its own end). Splits are small metadata — they ride
+    the task closure, not the broadcast plane. Executors must share the
+    driver's filesystem (single-host clusters and the multi-process
+    tests here do; a distributed deployment needs a shared mount, the
+    same requirement Spark puts on file:// URIs)."""
+
+    splits: List[Tuple[str, int, int]]   # (path, start, end)
+
+    def num_partitions(self) -> int:
+        return len(self.splits)
+
+
+def _read_split(path: str, start: int, end: int) -> Iterator[str]:
+    with open(path, "rb") as f:
+        if start > 0:
+            f.seek(start - 1)
+            f.readline()  # the line straddling `start` belongs upstream
+        pos = f.tell()
+        while pos < end:
+            line = f.readline()
+            if not line:
+                break
+            pos = f.tell()
+            # \r\n is a terminator too (Hadoop's LineRecordReader rule):
+            # CRLF files must not yield keys with trailing \r
+            yield line.decode().rstrip("\r\n")
+
+
+@dataclass
 class _Narrow:
     parent: object
     xform: Callable[[Iterator], Iterator]
@@ -235,14 +268,35 @@ class RDD:
                                         self._parts(num_partitions),
                                         mode="group"))
 
-    def reduce_by_key(self, f, num_partitions: Optional[int] = None) -> "RDD":
+    def reduce_by_key(self, f, num_partitions: Optional[int] = None,
+                      salt: int = 0) -> "RDD":
         """Map-side combined aggregation — each map task pre-merges its
         records per key before the shuffle (the aggregator half Spark
         applies before spilling), so shuffle bytes scale with distinct
-        keys, not records."""
-        return RDD(self._ctx, _Shuffled(self._node,
-                                        self._parts(num_partitions),
-                                        mode="reduce", merge=f))
+        keys, not records.
+
+        ``salt > 1`` adds a two-stage tree: records first shuffle on
+        (key, record_hash % salt) so one hot key's partial aggregates
+        spread over up to ``salt`` reducers, then a second shuffle
+        merges the partials per key — the standard skew cure (requires
+        ``f`` associative+commutative, which reduceByKey already
+        assumes). Use when one key dominates (ALS-style power-law
+        data); the extra stage costs one pass over the aggregates."""
+        parts = self._parts(num_partitions)
+        if salt <= 1:
+            return RDD(self._ctx, _Shuffled(self._node, parts,
+                                            mode="reduce", merge=f))
+        salted = (self
+                  .map_partitions(lambda it, _s=salt: (
+                      ((k, i % _s), v) for i, (k, v) in enumerate(it)))
+                  .reduce_by_key(f, parts))
+        # round-robin salt by record index: deterministic (recomputes and
+        # speculative duplicates must yield identical bytes — the
+        # engine's idempotent-publish contract), and a hot key's run of
+        # records spreads evenly across its salt groups
+        return (salted
+                .map_partitions(lambda it: ((k, v) for (k, _r), v in it))
+                .reduce_by_key(f, parts))
 
     def sort_by_key(self, num_partitions: Optional[int] = None,
                     ascending: bool = True, sample_size: int = 512) -> "RDD":
@@ -292,10 +346,11 @@ class RDD:
     # -- actions ----------------------------------------------------------
 
     def collect(self) -> list:
-        return [x for part in self._run(list) for x in part]
+        return [x for part in self._run(lambda it, _t: list(it))
+                for x in part]
 
     def count(self) -> int:
-        return sum(self._run(lambda it: sum(1 for _ in it)))
+        return sum(self._run(lambda it, _t: sum(1 for _ in it)))
 
     def first(self):
         got = self.take(1)
@@ -304,19 +359,63 @@ class RDD:
         return got[0]
 
     def take(self, n: int) -> list:
+        """First ``n`` records (partition order). Runs the lineage as ONE
+        full job — islice bounds per-partition materialization, not the
+        scan itself (Spark's incremental partition scale-up is a
+        possible future optimization)."""
         import itertools
         out: list = []
         for part in self._run(
-                lambda it, _n=n: list(itertools.islice(it, _n))):
+                lambda it, _t, _n=n: list(itertools.islice(it, _n))):
             out.extend(part)
             if len(out) >= n:
                 break
         return out[:n]
 
+    def save_as_text_file(self, path: str) -> None:
+        """One ``part-NNNNN`` file per partition + a ``_SUCCESS`` marker
+        (the Hadoop output contract). Parts write to an attempt-unique
+        temp name and rename-commit — the crash-safe discipline of the
+        resolver's spill commit, which also makes concurrent speculative
+        attempts of one task harmless (each writes its own temp; the
+        rename is atomic, last commit wins with complete contents).
+
+        A previous run's ``part-*``/``_SUCCESS`` files in ``path`` are
+        removed first: a shrinking partition count must not leave stale
+        parts under a fresh ``_SUCCESS`` (Spark refuses the directory
+        outright; here re-runs are expected, so clear exactly the files
+        this writer owns and never anything else)."""
+        import glob as _glob
+        import os
+        os.makedirs(path, exist_ok=True)
+        for stale in _glob.glob(os.path.join(path, "part-[0-9]*")) + \
+                _glob.glob(os.path.join(path, ".tmp-part-*")) + \
+                [os.path.join(path, "_SUCCESS")]:
+            try:
+                os.remove(stale)
+            except FileNotFoundError:
+                pass
+
+        def save(it, task_id, _p=path):
+            import os
+            import threading
+            tmp = os.path.join(
+                _p, f".tmp-part-{task_id:05d}.{os.getpid()}."
+                    f"{threading.get_ident()}")
+            with open(tmp, "w") as f:
+                for x in it:
+                    f.write(str(x))
+                    f.write("\n")
+            os.replace(tmp, os.path.join(_p, f"part-{task_id:05d}"))
+
+        self._run(save)
+        with open(os.path.join(path, "_SUCCESS"), "w"):
+            pass
+
     def reduce(self, f):
         import functools
 
-        def fold(it, _f=f):
+        def fold(it, _task_id, _f=f):
             acc, found = None, False
             for x in it:
                 acc = x if not found else _f(acc, x)
@@ -337,6 +436,7 @@ class RDD:
     groupByKey = group_by_key
     reduceByKey = reduce_by_key
     sortByKey = sort_by_key
+    saveAsTextFile = save_as_text_file
 
     # -- internals --------------------------------------------------------
 
@@ -350,7 +450,7 @@ class RDD:
     def _sample_keys(self, sample_size: int) -> list:
         """Sampling job for sortByKey: up to ``sample_size`` keys per
         partition, random but seeded per task (recompute-deterministic)."""
-        def sample(it, _n=sample_size):
+        def sample(it, _task_id, _n=sample_size):
             import random
             rng = random.Random(0x5EED)
             seen: list = []
@@ -365,14 +465,16 @@ class RDD:
 
         return sorted(k for part in self._run(sample) for k in part)
 
-    def _run(self, finalize: Callable[[Iterator], object]) -> List[object]:
-        """Compile the lineage into engine stages and run it."""
+    def _run(self, finalize: Callable[[Iterator, int], object]
+             ) -> List[object]:
+        """Compile the lineage into engine stages and run it;
+        ``finalize(iterator, task_id)`` folds each partition."""
         memo: dict = {}
         builder, parents = _chain(self._node, memo, self._ctx)
         _wire_slots(builder)
 
         def task_fn(tc, task_id, _b=builder, _fin=finalize):
-            return _fin(_b(tc, task_id))
+            return _fin(_b(tc, task_id), task_id)
 
         final = ResultStage(self._node.num_partitions(), task_fn,
                             parents=parents)
@@ -389,6 +491,13 @@ def _chain(node, memo: dict, ctx: "EngineContext"):
 
         def build(tc, task_id, _b=bcast):
             return iter(_b.value[task_id])
+
+        build._boundary = None
+        return build, []
+
+    if isinstance(node, _FileSource):
+        def build(tc, task_id, _s=node.splits):
+            return _read_split(*_s[task_id])
 
         build._boundary = None
         return build, []
@@ -527,6 +636,30 @@ class EngineContext:
         # n slices exactly; trailing ones come out empty via short slices
         parts = [items[i * step:(i + 1) * step] for i in range(n)]
         return RDD(self, _Source(self.engine.broadcast(parts), n))
+
+    def text_file(self, path: str, num_slices: int = 0) -> RDD:
+        """Lines of the file(s) at ``path`` (a path or glob), split into
+        byte ranges at line granularity — the lazy, scan-parallel entry
+        point (Spark's sc.textFile)."""
+        import glob as _glob
+        import os
+
+        files = sorted(_glob.glob(path)) if _glob.has_magic(path) \
+            else [path]
+        sizes = [os.path.getsize(f) for f in files]  # missing file raises
+        if not files:
+            raise FileNotFoundError(f"no files match {path!r}")
+        n = num_slices or self.default_parallelism
+        target = max(1, -(-sum(sizes) // n))
+        splits: List[Tuple[str, int, int]] = []
+        for f, size in zip(files, sizes):
+            k = max(1, -(-size // target))
+            step = -(-size // k) or 1
+            splits.extend((f, i * step, min((i + 1) * step, size))
+                          for i in range(k))
+        return RDD(self, _FileSource(splits))
+
+    textFile = text_file
 
     def broadcast(self, value):
         return self.engine.broadcast(value)

@@ -88,6 +88,18 @@ def test_reduce_by_key_word_count(ctx):
     assert sum(counts.values()) == len(words)
 
 
+def test_salted_reduce_by_key_skewed(ctx):
+    """One dominant key (ALS-style power law): the salted two-stage tree
+    gives the same totals, with the hot key's partials spread first."""
+    pairs = [("hot", 1)] * 500 + [(f"k{i}", 1) for i in range(20)]
+    plain = dict(ctx.parallelize(pairs, 4)
+                 .reduce_by_key(lambda a, b: a + b, 4).collect())
+    salted = dict(ctx.parallelize(pairs, 4)
+                  .reduce_by_key(lambda a, b: a + b, 4, salt=8).collect())
+    assert salted == plain
+    assert salted["hot"] == 500 and salted["k3"] == 1
+
+
 def test_group_by_key_and_partitioning(ctx):
     pairs = [(i % 5, i) for i in range(50)]
     grouped = ctx.parallelize(pairs, 4).group_by_key(5).collect()
@@ -174,6 +186,63 @@ def test_distinct_and_chained_wide_ops(ctx):
            .sort_by_key(2)
            .collect())
     assert out == [(k, 10) for k in range(6)]
+
+
+def test_text_file_split_boundaries_exact(ctx, tmp_path):
+    """Byte-range splits at line granularity: every line exactly once,
+    whatever the split points land on (the Hadoop input-split rule)."""
+    lines = [f"line-{i:04d}-{'x' * (i % 23)}" for i in range(500)]
+    p = tmp_path / "in.txt"
+    p.write_text("\n".join(lines) + "\n")
+    for slices in (1, 3, 7, 16):
+        got = ctx.text_file(str(p), slices).collect()
+        assert sorted(got) == sorted(lines), f"slices={slices}"
+    assert ctx.text_file(str(p), 4).count() == 500
+
+
+def test_text_file_glob_and_empty(ctx, tmp_path):
+    (tmp_path / "a.txt").write_text("alpha\nbeta\n")
+    (tmp_path / "b.txt").write_text("gamma\n")
+    (tmp_path / "c.txt").write_text("")  # empty file contributes nothing
+    got = sorted(ctx.text_file(str(tmp_path / "*.txt"), 4).collect())
+    assert got == ["alpha", "beta", "gamma"]
+    with pytest.raises(FileNotFoundError):
+        ctx.text_file(str(tmp_path / "missing.txt"), 2).count()
+
+
+def test_save_as_text_file_roundtrip(ctx, tmp_path):
+    out = tmp_path / "out"
+    (ctx.parallelize(range(100), 4)
+     .map(lambda x: (x % 10, x))
+     .reduce_by_key(lambda a, b: a + b, 3)
+     .sort_by_key(3)
+     .map(lambda kv: f"{kv[0]}\t{kv[1]}")
+     .save_as_text_file(str(out)))
+    assert (out / "_SUCCESS").exists()
+    parts = sorted(out.glob("part-*"))
+    assert len(parts) == 3
+    back = [ln for p in parts for ln in p.read_text().splitlines()]
+    assert back == [f"{k}\t{sum(range(k, 100, 10))}" for k in range(10)]
+
+
+def test_save_as_text_file_clears_stale_parts(ctx, tmp_path):
+    """A re-run with fewer partitions must not leave a previous run's
+    extra part files under a fresh _SUCCESS."""
+    out = tmp_path / "out"
+    ctx.parallelize(range(8), 4).save_as_text_file(str(out))
+    assert len(list(out.glob("part-*"))) == 4
+    ctx.parallelize(range(4), 2).save_as_text_file(str(out))
+    parts = sorted(out.glob("part-*"))
+    assert len(parts) == 2
+    got = sorted(int(x) for p in parts for x in p.read_text().split())
+    assert got == [0, 1, 2, 3]
+
+
+def test_text_file_crlf_terminators(ctx, tmp_path):
+    p = tmp_path / "crlf.txt"
+    p.write_bytes(b"alpha\r\nbeta\r\ngamma\n")
+    assert sorted(ctx.text_file(str(p), 2).collect()) == \
+        ["alpha", "beta", "gamma"]
 
 
 def test_accumulator_and_broadcast_through_rdd(ctx):
