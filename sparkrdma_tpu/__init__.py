@@ -59,7 +59,7 @@ def __getattr__(name):
     if name in ("Broadcast", "Accumulator"):
         from sparkrdma_tpu import shared_vars
         return getattr(shared_vars, name)
-    if name in ("EngineContext", "RDD"):
+    if name in ("EngineContext", "RDD", "BatchRDD"):
         from sparkrdma_tpu import rdd
         return getattr(rdd, name)
     if name == "ShuffleDependency":

@@ -357,6 +357,11 @@ class CachedPartitionReader:
         order = np.argsort(keys, kind="stable")
         return keys[order], payload[order]
 
+    def read_aggregated(self, combine) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized sorted-run reduction (TpuShuffleReader parity)."""
+        keys, payload = self.read_sorted()
+        return combine(keys, payload)
+
     def read_sorted_spilled(self, memory_budget_bytes: int = 64 << 20,
                             spill_dir: Optional[str] = None):
         # data is already resident (mesh results live on the driver); the

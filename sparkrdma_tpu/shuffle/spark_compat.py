@@ -150,6 +150,15 @@ class CompatReader:
         ExternalSorter delegation, scala/RdmaShuffleReader.scala:100-114)."""
         return self._r.read_sorted_spilled(memory_budget_bytes=memoryBudgetBytes)
 
+    def readAggregated(self, combine):
+        """Vectorized combine over the sorted partition (the aggregator's
+        merge half Spark applies on the read side)."""
+        return self._r.read_aggregated(combine)
+
+    def readAll(self):
+        """The whole partition range as one (keys, payload) batch."""
+        return self._r.read_all()
+
     @property
     def metrics(self):
         return self._r.metrics
