@@ -693,10 +693,17 @@ class BatchRDD:
         local sort (TeraSort's shape, driven from the RDD surface).
         Under a mesh engine the local sort is a no-op check: the
         collective reduce already returns each partition key-sorted."""
-        sample = self._sample_keys(sample_per_part)
-        qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
-        splitters = tuple(int(v) for v in np.quantile(sample, qs)) \
-            if len(sample) else ()
+        # splitters come straight from the sorted integer sample —
+        # np.quantile would interpolate in float64, which rounds keys
+        # near 2**64 past the uint64 range and overflows the partitioner
+        sample = np.sort(self._sample_keys(sample_per_part))
+        if len(sample):
+            idx = [round(len(sample) * i / num_partitions)
+                   for i in range(1, num_partitions)]
+            splitters = tuple(int(sample[min(i, len(sample) - 1)])
+                              for i in idx)
+        else:
+            splitters = ()
         shuffled = BatchRDD(self._ctx, _BShuffle(
             self._node, num_partitions,
             PartitionerSpec("range", splitters)))

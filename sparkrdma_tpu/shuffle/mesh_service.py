@@ -358,8 +358,12 @@ class CachedPartitionReader:
         return keys[order], payload[order]
 
     def read_aggregated(self, combine) -> Tuple[np.ndarray, np.ndarray]:
-        """Vectorized sorted-run reduction (TpuShuffleReader parity)."""
+        """Vectorized sorted-run reduction (TpuShuffleReader parity).
+        Combiners never see zero rows — the writer-side contract
+        (shuffle/writer.py skips empty inputs) holds on the read side."""
         keys, payload = self.read_sorted()
+        if not len(keys):
+            return keys, payload
         return combine(keys, payload)
 
     def read_sorted_spilled(self, memory_budget_bytes: int = 64 << 20,

@@ -88,8 +88,12 @@ class TpuShuffleReader:
 
     def read_aggregated(self, combine: Callable[[np.ndarray, np.ndarray], Batch]
                         ) -> Batch:
-        """Aggregate with a vectorized combiner (sorted-run reduction)."""
+        """Aggregate with a vectorized combiner (sorted-run reduction).
+        Combiners never see zero rows — the same contract the writer's
+        map-side combine keeps (an empty partition short-circuits)."""
         keys, payload = self.read_sorted()
+        if not len(keys):
+            return keys, payload
         return combine(keys, payload)
 
     def read_to_device(self, pool, device=None):

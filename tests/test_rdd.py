@@ -258,13 +258,9 @@ def test_accumulator_and_broadcast_through_rdd(ctx):
     assert acc.value == 20
 
 
-def _sum_combiner(keys, payload):
-    """Dependency-combiner contract: sorted (keys, u32 rows) -> per-key
-    sums, payload back as uint8 row bytes."""
-    vals = payload.view(np.uint32)[:, 0].astype(np.uint64)
-    starts = np.flatnonzero(np.r_[True, keys[1:] != keys[:-1]])
-    sums = np.add.reduceat(vals, starts).astype(np.uint32)
-    return keys[starts], sums[:, None].view(np.uint8)
+from sparkrdma_tpu.shuffle.writer import make_sum_combiner
+
+_sum_combiner = make_sum_combiner("<u4")  # the shipped per-key-sum combiner
 
 
 @pytest.fixture
@@ -331,6 +327,30 @@ def test_batch_rdd_map_batches_width_change(ctx, batch_data):
              .repartition(2).collect_batches())
     got = np.concatenate([p.view(np.uint64)[:, 0] for _, p in parts])
     assert sorted(got.tolist()) == sorted((vals * 2).tolist())
+
+
+def test_batch_rdd_combiner_empty_partitions(ctx):
+    """More partitions than distinct keys: empty reduce partitions must
+    not feed the combiner zero rows (the writer-side contract)."""
+    keys = np.array([1, 1, 2, 2, 3], np.uint64)
+    vals = np.arange(5, dtype=np.uint32)
+    parts = (ctx.from_arrays(keys, vals[:, None], 2)
+             .reduce_by_key(_sum_combiner, 8).collect_batches())
+    got = {int(k): int(s) for kk, p in parts
+           for k, s in zip(kk, p.view(np.uint32)[:, 0])}
+    assert got == {1: 1, 2: 5, 3: 4}
+
+
+def test_batch_rdd_sort_keys_near_u64_max(ctx):
+    """Range splitters must come from the integer sample — float64
+    quantiles round keys near 2**64 out of the uint64 range."""
+    keys = np.array([2**64 - 1, 2**64 - 2, 5, 2**63, 2**64 - 3, 1],
+                    np.uint64)
+    vals = np.arange(6, dtype=np.uint32)
+    parts = (ctx.from_arrays(keys, vals[:, None], 2)
+             .sort_by_key(3).collect_batches())
+    allk = np.concatenate([k for k, _ in parts])
+    assert allk.tolist() == sorted(keys.tolist())
 
 
 def test_batch_rdd_1d_payload(ctx):
@@ -403,6 +423,93 @@ def test_rdd_through_remote_executors(tmp_path):
                       .reduce_by_key(lambda a, b: a + b, 3)
                       .collect())
         assert counts == {0: 10, 1: 10, 2: 10}
+    finally:
+        for p in procs:
+            p.kill()
+        for r in remotes:
+            r.stop()
+        driver.stop()
+
+
+def test_rdd_pagerank_matches_oracle(ctx):
+    """PageRank written in ~15 lines of RDD code (the classic Spark
+    program, and BASELINE config #3's shape) agrees with the in-tree
+    dense numpy oracle."""
+    from sparkrdma_tpu.models.pagerank import numpy_pagerank
+
+    rng = np.random.default_rng(5)
+    V, E, iters, damping = 64, 400, 5, 0.85
+    edges = np.stack([rng.integers(0, V, E), rng.integers(0, V, E)],
+                     axis=1).astype(np.int32)
+    want = numpy_pagerank(edges, V, damping, iters)
+
+    links = (ctx.parallelize([(int(s), int(d)) for s, d in edges], 4)
+             .group_by_key(4))  # (src, [dsts]) — stays partitioned
+    ranks = {v: 1.0 / V for v in range(V)}
+    for _ in range(iters):
+        rb = ctx.broadcast(ranks)
+        contribs = links.flat_map(
+            lambda kv, _r=rb: [(d, _r.value[kv[0]] / len(kv[1]))
+                               for d in kv[1]])
+        sums = dict(contribs.reduce_by_key(lambda a, b: a + b, 4).collect())
+        ranks = {v: (1 - damping) / V + damping * sums.get(v, 0.0)
+                 for v in range(V)}
+    got = np.array([ranks[v] for v in range(V)], dtype=np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_rdd_recovers_from_executor_process_loss(tmp_path):
+    """Kill an executor PROCESS mid-RDD-job: lineage recomputation must
+    rebuild the lost map outputs and the word counts stay exact — the
+    Spark recompute story driven from the RDD surface."""
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    from test_remote_engine import _WORKER, CONF
+    from sparkrdma_tpu.shuffle.spark_compat import SparkCompatShuffleManager
+    from sparkrdma_tpu.tasks import remote_executors
+
+    driver = SparkCompatShuffleManager(CONF, isDriver=True)
+    host, port = driver.driverAddr
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, host, str(port), f"w{i}",
+         str(tmp_path / f"w{i}")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    remotes = []
+    try:
+        remotes = remote_executors(driver, CONF, expect=2, timeout=30)
+        sentinel = tmp_path / "reduce-running"
+        spath = str(sentinel)
+
+        def slow_identity(it, _s=spath):
+            got = list(it)
+            open(_s, "a").write("x")
+            time.sleep(1.5)  # window for the kill
+            return iter(got)
+
+        victim = remotes[1]
+        victim_proc = procs[int(victim.manager_id.executor_id.executor[1:])]
+
+        def killer():
+            deadline = time.monotonic() + 30
+            while not sentinel.exists() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            victim_proc.kill()
+            driver.native.driver.remove_member(victim.manager_id)
+
+        k = threading.Thread(target=killer, daemon=True)
+        k.start()
+        ctx = EngineContext(DAGEngine(driver, remotes))
+        counts = dict(ctx.parallelize([(i % 5, 1) for i in range(200)], 4)
+                      .reduce_by_key(lambda a, b: a + b, 3)
+                      .map_partitions(slow_identity)
+                      .collect())
+        k.join(timeout=10)
+        assert sentinel.exists(), "failure injection never armed"
+        assert counts == {k: 40 for k in range(5)}
     finally:
         for p in procs:
             p.kill()
