@@ -139,6 +139,27 @@ class TaskContext:
         return self.manager.getReader(handle, self.task_id, self.task_id + 1)
 
 
+def _make_dist_collective(handle, axis: str, impl: str):
+    """The closure shipped to every executor process in distributed mesh
+    mode: stage local spills, enter the global-mesh exchange, cache the
+    received partitions in this process, report ownership."""
+
+    def collective(ctx, task_id, _h=handle, _axis=axis, _impl=impl):
+        import jax
+
+        from sparkrdma_tpu.parallel.multihost import (
+            global_mesh, run_multihost_mesh_reduce)
+        from sparkrdma_tpu.shuffle import dist_cache
+
+        mesh = global_mesh(_axis)
+        results = run_multihost_mesh_reduce(
+            [ctx.manager.native], _h, mesh, axis_name=_axis, impl=_impl)
+        parts = dist_cache.store(_h.shuffle_id, results)
+        return (jax.process_index(), jax.process_count(), parts)
+
+    return collective
+
+
 class DAGEngine:
     """Schedules stage DAGs over a cluster of compat shuffle managers.
 
@@ -162,7 +183,8 @@ class DAGEngine:
                  speculation: bool = False,
                  speculation_multiplier: float = 1.5,
                  mesh=None, mesh_axis: str = "shuffle",
-                 mesh_impl: str = "auto", mesh_rows_per_round: int = 0):
+                 mesh_impl: str = "auto", mesh_rows_per_round: int = 0,
+                 dist_mesh_axis: Optional[str] = None):
         self.driver = driver
         self.executors = list(executors)
         self.max_stage_retries = max_stage_retries
@@ -179,8 +201,29 @@ class DAGEngine:
         if mesh is not None and any(self._is_remote(ex) for ex in executors):
             raise ValueError(
                 "mesh data plane needs in-process executors (their "
-                "resolvers stage straight to the mesh); cross-process "
-                "meshes go through parallel.multihost")
+                "resolvers stage straight to the mesh); for executor "
+                "PROCESSES over a jax.distributed mesh pass "
+                "dist_mesh_axis instead")
+        # Distributed mesh mode: executor PROCESSES form a jax.distributed
+        # group (each calls multihost.init_multihost at startup, one
+        # engine executor per jax process); per parent shuffle the engine
+        # ships ONE collective closure to every process — each stages its
+        # local spills and enters the global-mesh exchange
+        # (parallel/multihost.py), keeps its received partitions in
+        # shuffle/dist_cache.py, and reduce tasks are placed on the
+        # partition's owner (misplacement falls back to the TCP fetcher).
+        # Collectives serialize driver-side: two in flight would enter in
+        # different orders on different processes and deadlock the group.
+        self.dist_mesh_axis = dist_mesh_axis
+        if dist_mesh_axis is not None:
+            if mesh is not None:
+                raise ValueError("mesh and dist_mesh_axis are exclusive")
+            if not all(self._is_remote(ex) for ex in executors):
+                raise ValueError(
+                    "dist_mesh_axis requires every executor to be a "
+                    "RemoteExecutor (one per jax.distributed process)")
+        self._dist_lock = threading.RLock()
+        self._dist_owner: Dict[int, Dict[int, object]] = {}
         # Speculative execution (Spark's spark.speculation): once half a
         # stage's tasks have finished, a task running longer than
         # multiplier x their median gets a backup attempt on a different
@@ -319,6 +362,7 @@ class DAGEngine:
                                        if k[0] != handle.shuffle_id}
                     with self._mesh_lock:
                         self._mesh_cache.pop(handle.shuffle_id, None)
+                    self._dist_owner.pop(handle.shuffle_id, None)
                     self.driver.unregisterShuffle(handle.shuffle_id)
                     # executor-side too: drops the resolver's spill data and
                     # the memoized driver table, not just the driver entry —
@@ -409,8 +453,15 @@ class DAGEngine:
     def _run_stage_tasks(self, stage) -> List[object]:
         """All of a stage's tasks, up to max_parallel_tasks in flight
         (ordered results)."""
+        if self.dist_mesh_axis is not None:
+            for p in stage.parents:
+                h = self._handles.get(p.stage_id)
+                if h is not None:
+                    self._dist_mesh_reduce(h)
         if self.max_parallel_tasks <= 1 or stage.num_tasks <= 1:
-            return [self._run_task(stage, t) for t in range(stage.num_tasks)]
+            return [self._run_task(stage, t,
+                                   mgr=self._dist_preferred(stage, t))
+                    for t in range(stage.num_tasks)]
         from concurrent.futures import ThreadPoolExecutor
 
         pool = ThreadPoolExecutor(
@@ -419,7 +470,8 @@ class DAGEngine:
         try:
             if self.speculation:
                 return self._collect_speculative(stage, pool)
-            futures = [pool.submit(self._run_task, stage, t)
+            futures = [pool.submit(self._run_task, stage, t,
+                                   self._dist_preferred(stage, t))
                        for t in range(stage.num_tasks)]
             return [f.result() for f in futures]
         except BaseException:
@@ -452,7 +504,8 @@ class DAGEngine:
 
         def timed(t: int):
             start[t] = time_mod.monotonic()
-            return self._run_task(stage, t)
+            return self._run_task(stage, t,
+                                  mgr=self._dist_preferred(stage, t))
 
         meta = {pool.submit(timed, t): t for t in range(n)}
         speculated: set = set()  # tasks that got their ONE backup
@@ -496,8 +549,12 @@ class DAGEngine:
                                  "after %.2fs (median %.2fs)",
                                  stage.stage_id, t, now - start[t],
                                  statistics.median(durations))
-                        try:  # keep the backup off the primary's node
-                            avoid = self._pick_live(t)
+                        try:  # keep the backup off the primary's node —
+                            # the owner-preferred executor when placement
+                            # used one (dist mesh mode), else the
+                            # round-robin pick the primary got
+                            avoid = (self._dist_preferred(stage, t)
+                                     or self._pick_live(t))
                         except RuntimeError:
                             avoid = None
                         b = backup_pool.submit(
@@ -630,6 +687,94 @@ class DAGEngine:
 
     # -- mesh data plane (shuffle/mesh_service.py) -----------------------
 
+    def _dist_preferred(self, stage, task_id: int):
+        """The executor whose process received task_id's partition in the
+        distributed mesh reduce, if any — placement there makes the
+        reduce read a local cache hit instead of a TCP fetch."""
+        if self.dist_mesh_axis is None:
+            return None
+        for p in stage.parents:
+            h = self._handles.get(p.stage_id)
+            if h is None:
+                continue
+            ex = self._dist_owner.get(h.shuffle_id, {}).get(task_id)
+            if ex is not None and getattr(ex, "alive", True):
+                return ex
+        return None
+
+    def _dist_mesh_reduce(self, handle) -> None:
+        """One global-mesh collective for ``handle``'s shuffle across all
+        executor processes (memoized per shuffle; serialized — see
+        __init__). Every process stages its committed local spills and
+        enters ``run_multihost_mesh_reduce`` together; a FetchFailed is
+        raised consistently group-wide, so recovery + a collective
+        re-entry is an ordinary stage retry."""
+        from concurrent.futures import ThreadPoolExecutor
+        from dataclasses import replace
+
+        with self._dist_lock:
+            if handle.shuffle_id in self._dist_owner:
+                return
+            fn = _make_dist_collective(replace(handle, combiner=None),
+                                       self.dist_mesh_axis, self.mesh_impl)
+            for attempt in range(self.max_stage_retries + 1):
+                # the collective needs EVERY jax process: excluding a
+                # dead-marked proxy would strand the rest of the group in
+                # the allgather until the task timeout — fail fast with
+                # the real problem instead
+                dead = [ex for ex in self.executors
+                        if not getattr(ex, "alive", True)]
+                if dead:
+                    raise RuntimeError(
+                        f"distributed mesh group incomplete: "
+                        f"{len(dead)}/{len(self.executors)} executors "
+                        "marked dead; the collective needs every jax "
+                        "process — restart the process group")
+                execs = list(self.executors)
+                failure = None
+                results = {}
+                with self.tracer.span("engine.dist_reduce", "engine",
+                                      shuffle=handle.shuffle_id,
+                                      attempt=attempt), \
+                        ThreadPoolExecutor(
+                            max_workers=len(execs),
+                            thread_name_prefix="dist-mesh") as pool:
+                    futs = {pool.submit(ex.run_result_task, fn, [], 0): ex
+                            for ex in execs}
+                    for f, ex in futs.items():
+                        try:
+                            res, _deltas = f.result()
+                            results[ex] = res
+                        except FetchFailedError as e:
+                            failure = e
+                if failure is None:
+                    owner: Dict[int, object] = {}
+                    seen: Dict[int, object] = {}
+                    nproc = 0
+                    for ex, (pidx, np_, parts) in results.items():
+                        nproc = np_
+                        if pidx in seen:
+                            raise RuntimeError(
+                                f"jax process {pidx} served by two engine "
+                                "executors — distributed mesh mode needs "
+                                "exactly one executor per process")
+                        seen[pidx] = ex
+                        for part in parts:
+                            owner[part] = ex
+                    if len(seen) != nproc:
+                        raise RuntimeError(
+                            f"collective covered {len(seen)}/{nproc} jax "
+                            "processes; every process must host exactly "
+                            "one engine executor")
+                    self._dist_owner[handle.shuffle_id] = owner
+                    return
+                if attempt >= self.max_stage_retries:
+                    raise failure
+                log.warning("distributed mesh reduce of shuffle %d: %s; "
+                            "recovering (%d)", handle.shuffle_id, failure,
+                            attempt + 1)
+                self._recover_shuffle(failure)
+
     def _mesh_read(self, handle, partition: int) -> CompatReader:
         """A reader over ``partition`` served from the collective reduce."""
         from sparkrdma_tpu.shuffle.mesh_service import CachedPartitionReader
@@ -738,6 +883,11 @@ class DAGEngine:
                                 for slot in owners)):
                 return
             self._recover_shuffle_locked(failure)
+            if self.dist_mesh_axis is not None:
+                # worker caches were invalidated by the recovery ship;
+                # drop the driver's ownership memo too so the next stage
+                # re-enters the collective over the repaired table
+                self._dist_owner.pop(failure.shuffle_id, None)
             if failure.exec_index >= 0:
                 self._recovered.add(key)
 

@@ -1,0 +1,76 @@
+"""Worker-process cache of distributed-mesh-reduce results.
+
+In the engine's distributed mesh mode each executor PROCESS enters one
+global-mesh collective per parent shuffle (`engine._dist_mesh_reduce`
+ships the collective closure; `parallel/multihost.py` is the data plane).
+The rows a process receives are ITS partitions — this module keeps them
+until the shuffle is invalidated or unregistered, and the worker-side
+task context serves reduce reads from here (falling back to the TCP
+fetcher for partitions another process owns).
+
+The per-shuffle granularity mirrors the driver's `_MeshCell` cache for
+the in-process mesh mode; cross-process, the cache must live in the
+worker because the driver never holds these rows at all (that is the
+point — the data plane is device-to-device over the collective,
+reference README.md:11-31's NIC-to-NIC redistribution).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_lock = threading.Lock()
+# shuffle_id -> partition -> (keys u64[N], payload u8[N, W])
+_cache: Dict[int, Dict[int, Tuple[np.ndarray, np.ndarray]]] = {}
+
+
+def store(shuffle_id: int, device_results: List[tuple]) -> List[int]:
+    """Split a collective's per-device results by partition and cache.
+
+    ``device_results``: ``[(keys, payload, partition_ids), ...]`` per
+    local mesh device (``run_multihost_mesh_reduce``'s return shape).
+    Each partition lives on exactly one device (owner = partition %
+    mesh size), so segments never merge across devices. Returns the
+    sorted partition ids this process now serves.
+    """
+    by_part: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+    for keys, payload, parts in device_results:
+        if not len(keys):
+            continue
+        order = np.argsort(parts, kind="stable")  # stable: key order
+        keys, payload, parts = keys[order], payload[order], parts[order]
+        starts = np.flatnonzero(np.r_[True, parts[1:] != parts[:-1]])
+        bounds = np.r_[starts, len(parts)]
+        for i, s in enumerate(starts):
+            seg = slice(int(s), int(bounds[i + 1]))
+            by_part[int(parts[s])] = (keys[seg].copy(),
+                                      payload[seg].copy())
+    with _lock:
+        _cache[shuffle_id] = by_part
+    return sorted(by_part)
+
+
+def get(shuffle_id: int, partition: int
+        ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """This process's rows for ``partition``, or None if it does not
+    hold that partition (or the shuffle was never reduced here)."""
+    with _lock:
+        parts = _cache.get(shuffle_id)
+        if parts is None:
+            return None
+        return parts.get(partition)
+
+
+def has_shuffle(shuffle_id: int) -> bool:
+    with _lock:
+        return shuffle_id in _cache
+
+
+def drop(shuffle_id: int) -> None:
+    """Invalidate on recovery/unregister: stale collective results must
+    not serve after a map recomputes."""
+    with _lock:
+        _cache.pop(shuffle_id, None)

@@ -31,6 +31,7 @@ from typing import List, Optional, Tuple
 
 from sparkrdma_tpu.parallel import messages as M
 from sparkrdma_tpu.parallel.transport import ConnectionCache, TransportError
+from sparkrdma_tpu.shuffle import dist_cache
 from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
 
 log = logging.getLogger(__name__)
@@ -53,7 +54,12 @@ class ExecutorLostError(RuntimeError):
 
 
 class _RemoteTaskContext:
-    """Worker-side TaskContext: reads parents through the local manager."""
+    """Worker-side TaskContext: reads parents through the local manager —
+    or straight from this process's distributed-mesh-reduce cache when
+    the engine ran the collective here (the ICI-received rows ARE the
+    partition; no TCP re-fetch). A partition another process owns falls
+    back to the ordinary fetcher, so misplacement costs latency, never
+    correctness."""
 
     def __init__(self, mgr, parent_handles, task_id: int):
         self.manager = mgr
@@ -62,6 +68,14 @@ class _RemoteTaskContext:
 
     def read(self, parent_index: int = 0):
         handle = self._parents[parent_index]
+        cached = dist_cache.get(handle.shuffle_id, self.task_id)
+        if cached is not None:
+            from sparkrdma_tpu.shuffle.mesh_service import CachedPartitionReader
+            from sparkrdma_tpu.shuffle.spark_compat import CompatReader
+
+            return CompatReader(CachedPartitionReader(
+                {self.task_id: cached}, self.task_id, self.task_id + 1,
+                handle.row_payload_bytes))
         return self.manager.getReader(handle, self.task_id, self.task_id + 1)
 
 
@@ -104,9 +118,13 @@ def install_task_server(compat_mgr) -> None:
                 elif kind == "invalidate":
                     compat_mgr.native.executor.invalidate_shuffle(
                         desc["shuffle_id"])
+                    # recovery republishes maps: collective results built
+                    # from the old table must not serve stale rows
+                    dist_cache.drop(desc["shuffle_id"])
                     result = None
                 elif kind == "unregister":
                     compat_mgr.unregisterShuffle(desc["shuffle_id"])
+                    dist_cache.drop(desc["shuffle_id"])
                     result = None
                 else:
                     return (M.TASK_ERROR,
