@@ -139,12 +139,15 @@ class TaskContext:
         return self.manager.getReader(handle, self.task_id, self.task_id + 1)
 
 
-def _make_dist_collective(handle, axis: str, impl: str):
+def _make_dist_collective(handle, axis: str, impl: str,
+                          rows_per_round: int = 0):
     """The closure shipped to every executor process in distributed mesh
-    mode: stage local spills, enter the global-mesh exchange, cache the
-    received partitions in this process, report ownership."""
+    mode: stage local spills, enter the global-mesh exchange (in bounded
+    device rounds when ``rows_per_round`` is set), cache the received
+    partitions in this process, report ownership."""
 
-    def collective(ctx, task_id, _h=handle, _axis=axis, _impl=impl):
+    def collective(ctx, task_id, _h=handle, _axis=axis, _impl=impl,
+                   _rpr=rows_per_round):
         import jax
 
         from sparkrdma_tpu.parallel.multihost import (
@@ -153,7 +156,8 @@ def _make_dist_collective(handle, axis: str, impl: str):
 
         mesh = global_mesh(_axis)
         results = run_multihost_mesh_reduce(
-            [ctx.manager.native], _h, mesh, axis_name=_axis, impl=_impl)
+            [ctx.manager.native], _h, mesh, axis_name=_axis, impl=_impl,
+            rows_per_round=_rpr)
         parts = dist_cache.store(_h.shuffle_id, results)
         return (jax.process_index(), jax.process_count(), parts)
 
@@ -184,7 +188,8 @@ class DAGEngine:
                  speculation_multiplier: float = 1.5,
                  mesh=None, mesh_axis: str = "shuffle",
                  mesh_impl: str = "auto", mesh_rows_per_round: int = 0,
-                 dist_mesh_axis: Optional[str] = None):
+                 dist_mesh_axis: Optional[str] = None,
+                 dist_rows_per_round: int = 0):
         self.driver = driver
         self.executors = list(executors)
         self.max_stage_retries = max_stage_retries
@@ -215,6 +220,7 @@ class DAGEngine:
         # Collectives serialize driver-side: two in flight would enter in
         # different orders on different processes and deadlock the group.
         self.dist_mesh_axis = dist_mesh_axis
+        self.dist_rows_per_round = dist_rows_per_round
         if dist_mesh_axis is not None:
             if mesh is not None:
                 raise ValueError("mesh and dist_mesh_axis are exclusive")
@@ -716,7 +722,8 @@ class DAGEngine:
             if handle.shuffle_id in self._dist_owner:
                 return
             fn = _make_dist_collective(replace(handle, combiner=None),
-                                       self.dist_mesh_axis, self.mesh_impl)
+                                       self.dist_mesh_axis, self.mesh_impl,
+                                       self.dist_rows_per_round)
             for attempt in range(self.max_stage_retries + 1):
                 # the collective needs EVERY jax process: excluding a
                 # dead-marked proxy would strand the rest of the group in

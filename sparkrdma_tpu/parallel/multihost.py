@@ -79,7 +79,8 @@ def shard_local_rows(mesh, axis_name: str, local_rows: np.ndarray,
 def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
                               axis_name: str = "shuffle",
                               impl: str = "auto", out_factor: int = 2,
-                              sort_by_key: bool = True):
+                              sort_by_key: bool = True,
+                              rows_per_round: int = 0):
     """Cross-process mesh reduce: committed spills on N hosts -> ONE
     global-mesh exchange — the reference's whole multi-node pipeline
     (README.md:11-31: map outputs on every node's disks, NICs carry the
@@ -97,6 +98,14 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
     Returns this process's ADDRESSABLE results: a list of
     ``(keys u64[*], payload u8[*, W], partition_ids i64[*])`` per local
     mesh device (remote shards belong to their own processes).
+
+    ``rows_per_round > 0`` bounds DEVICE memory: the exchange runs in R
+    rounds of at most ``rows_per_round`` rows per device per round (R is
+    agreed group-wide from the same metadata allgather, so every process
+    enters the same number of collectives; one compile serves all
+    rounds). Host staging is unchanged — what streaming bounds is the
+    device-resident working set, the discipline
+    ``run_mesh_reduce_streamed`` applies in-process.
     """
     import jax
     from jax.experimental import multihost_utils
@@ -167,6 +176,34 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
     # per-process ceil(rows_i / n_local_i) so the global shape agrees
     cap = max(1, int(max(-(-int(r) // max(1, int(nl)))
                          for r, nl in meta[:, :2])))
+    rounds = 1
+    round_order = None
+    if rows_per_round > 0 and cap > rows_per_round:
+        # bounded device rounds: same derivation on every process from
+        # the shared metadata, so the group agrees on R with no extra
+        # collective
+        rounds = -(-cap // rows_per_round)
+        # staged rows are key-sorted per map (the writer's spill order),
+        # so CONTIGUOUS slices concentrate each round on few destination
+        # devices and overflow the per-round receive budget. Assign each
+        # destination's rows evenly across rounds instead — monotone
+        # within a destination (round = floor(j*R/m_d)), so per-dest
+        # order is preserved — and pad cap by the ±1-per-dest rounding.
+        counts_d = np.bincount(dest, minlength=n_global) \
+            if len(dest) else np.zeros(n_global, np.int64)
+        grouped = np.argsort(dest, kind="stable") if len(dest) else \
+            np.zeros(0, np.int64)
+        starts = np.r_[0, np.cumsum(counts_d)[:-1]]
+        within = (np.arange(len(grouped), dtype=np.int64)
+                  - np.repeat(starts, counts_d))
+        m_rep = np.repeat(np.maximum(counts_d, 1), counts_d)
+        round_of = (within * rounds) // m_rep
+        round_order = [grouped[round_of == r] for r in range(rounds)]
+        # pad slack for the ±1-per-destination rounding: derived from the
+        # ALLGATHERED device counts — every process must compute the same
+        # global array shape, and local n_local values differ
+        min_nl = max(1, int(meta[:, 1].min()))
+        cap = rows_per_round + -(-n_global // min_nl)
     staged_global = meta[:, 2:].sum(axis=0)
     unstaged = np.flatnonzero(staged_global == 0)
     if len(unstaged):
@@ -181,37 +218,55 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
             "processes; recompute and re-enter collectively")
 
     width = 2 + (handle.row_payload_bytes + 3) // 4
-    rows_p = np.zeros((n_local * cap, width), dtype=np.uint32)
-    rows_p[:len(rows)] = rows
-    dest_p = np.full(n_local * cap, -1, dtype=np.int32)
-    dest_p[:len(rows)] = dest
-
     sharding = NamedSharding(mesh, P(axis_name))
-    rows_g = jax.make_array_from_process_local_data(
-        sharding, rows_p, (n_global * cap, width))
-    dest_g = jax.make_array_from_process_local_data(
-        sharding, dest_p, (n_global * cap,))
-
-    # 3. the one shared jitted exchange over the GLOBAL mesh
+    # 3. the shared jitted exchange over the GLOBAL mesh — one compile
+    # serves every round (shapes are identical by construction)
     exchange = make_shuffle_exchange(mesh, axis_name, impl=impl,
                                      out_factor=out_factor)
-    received, counts, _ = jax.block_until_ready(exchange(rows_g, dest_g))
+    per_round = n_local * cap
+    got_rows: list = [[] for _ in range(n_local)]
+    for r in range(rounds):
+        if round_order is not None:
+            idx = round_order[r]
+            if len(idx) > per_round:  # ±1-per-dest rounding blew the pad
+                raise OverflowError(
+                    f"round {r} holds {len(idx)} rows > send budget "
+                    f"{per_round}; raise rows_per_round")
+            chunk, cdest = rows[idx], dest[idx]
+        else:
+            chunk = rows[r * per_round:(r + 1) * per_round]
+            cdest = dest[r * per_round:(r + 1) * per_round]
+        rows_p = np.zeros((per_round, width), dtype=np.uint32)
+        rows_p[:len(chunk)] = chunk
+        dest_p = np.full(per_round, -1, dtype=np.int32)
+        dest_p[:len(chunk)] = cdest
+        rows_g = jax.make_array_from_process_local_data(
+            sharding, rows_p, (n_global * cap, width))
+        dest_g = jax.make_array_from_process_local_data(
+            sharding, dest_p, (n_global * cap,))
+        received, counts, _ = jax.block_until_ready(
+            exchange(rows_g, dest_g))
+        recv_by_dev = {s.device: np.asarray(s.data)
+                       for s in received.addressable_shards}
+        counts_by_dev = {s.device: np.asarray(s.data)
+                         for s in counts.addressable_shards}
+        for i, dev in enumerate(local_mesh_devices):
+            got = recv_by_dev[dev].reshape(-1, width)
+            cnt = counts_by_dev[dev].reshape(-1)
+            total = int(cnt.sum())
+            if total > cap * out_factor:
+                raise OverflowError(
+                    "multihost mesh reduce receive overflow; raise "
+                    "out_factor or lower rows_per_round skew exposure")
+            got_rows[i].append(got[:total].copy())
     exchange_mod.record_exchange(int(meta[:, 0].sum()))
 
-    # 4. unpack this process's addressable shards
+    # 4. assemble this process's addressable results across rounds
     results = []
-    recv_by_dev = {s.device: np.asarray(s.data)
-                   for s in received.addressable_shards}
-    counts_by_dev = {s.device: np.asarray(s.data)
-                     for s in counts.addressable_shards}
-    for dev in local_mesh_devices:
-        got = recv_by_dev[dev].reshape(-1, width)
-        cnt = counts_by_dev[dev].reshape(-1)
-        total = int(cnt.sum())
-        if total > cap * out_factor:
-            raise OverflowError("multihost mesh reduce receive overflow; "
-                                "raise out_factor")
-        k, p = _u32_to_rows(got[:total], handle.row_payload_bytes)
+    for segs in got_rows:
+        allrows = (np.concatenate(segs) if segs
+                   else np.zeros((0, width), np.uint32))
+        k, p = _u32_to_rows(allrows, handle.row_payload_bytes)
         parts = np.asarray(partitioner(k), dtype=np.int64)
         if sort_by_key:
             order = np.argsort(k, kind="stable")

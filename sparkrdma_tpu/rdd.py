@@ -78,43 +78,66 @@ def portable_hash(key) -> int:
                           "little") & 0x7FFFFFFFFFFFFFFF
 
 
-def _encode_blob(obj, part: int, width: int) -> Tuple[np.ndarray, np.ndarray]:
-    """One partition's records -> (row keys, fixed-width rows): u64 length
-    + pickle bytes, zero-padded up to a whole number of ``width`` rows."""
+_TAG = 8  # per-row u64 tag: (map_id << 32) | row_seq
+
+
+def _encode_blob(obj, part: int, width: int, map_id: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """One (map, partition) blob -> (row keys, fixed-width rows).
+
+    Layout per row: ``[u64 (map_id << 32 | seq)] [width-8 chunk bytes]``;
+    the chunk stream is ``u64 length + pickle bytes`` zero-padded to
+    whole rows. The tag makes decoding ORDER-INDEPENDENT: rows may
+    arrive interleaved across maps and rounds in any sequence (mesh
+    collectives sort by key; bounded-round exchanges split a map's rows
+    across rounds) and still reassemble exactly — no transport-ordering
+    assumption anywhere. Costs 8 bytes per ``width``-byte row.
+    """
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    chunk = width - _TAG
     total = _LEN.size + len(payload)
-    n = -(-total // width)
-    buf = np.zeros(n * width, dtype=np.uint8)
-    buf[:_LEN.size] = np.frombuffer(_LEN.pack(len(payload)), dtype=np.uint8)
-    buf[_LEN.size:total] = np.frombuffer(payload, dtype=np.uint8)
-    return np.full(n, part, dtype=np.uint64), buf.reshape(n, width)
+    n = -(-total // chunk)
+    body = np.zeros(n * chunk, dtype=np.uint8)
+    body[:_LEN.size] = np.frombuffer(_LEN.pack(len(payload)), dtype=np.uint8)
+    body[_LEN.size:total] = np.frombuffer(payload, dtype=np.uint8)
+    rows = np.empty((n, width), dtype=np.uint8)
+    tags = ((np.uint64(map_id) << np.uint64(32))
+            | np.arange(n, dtype=np.uint64))
+    # explicit little-endian: the decoder reads "<u8" regardless of host
+    rows[:, :_TAG] = tags.astype("<u8")[:, None].view(np.uint8)
+    rows[:, _TAG:] = body.reshape(n, chunk)
+    return np.full(n, part, dtype=np.uint64), rows
 
 
 def _decode_blobs(batches) -> Iterator[object]:
-    """Invert :func:`_encode_blob` over reader batches.
+    """Invert :func:`_encode_blob` over reader batches, in any row order:
+    rows sort by their (map_id, seq) tag, then blobs parse sequentially
+    (each map writes exactly one blob per partition).
 
-    Each map's blob occupies consecutive rows in write order (one
-    grouped fetch per (map, partition) — shuffle/fetcher.py groups at
-    partition granularity, so a blob is never split or interleaved);
-    batch boundaries may fall anywhere, so parse over a rolling buffer.
+    Order-independence inherently needs the partition's rows resident
+    once (sorting is global); beyond that single buffer, only the tag
+    argsort indices and one blob's gathered rows are materialized — no
+    full reordered copy of the row matrix.
     """
-    buf = b""
-    for _keys, rows in batches:
-        width = rows.shape[1]
-        buf = buf + rows.tobytes() if buf else rows.tobytes()
-        off = 0
-        while len(buf) - off >= _LEN.size:
-            (ln,) = _LEN.unpack_from(buf, off)
-            span = -(-(_LEN.size + ln) // width) * width
-            if len(buf) - off < span:
-                break
-            yield pickle.loads(buf[off + _LEN.size: off + _LEN.size + ln])
-            off += span
-        buf = buf[off:]
-    if buf:
-        raise ValueError(
-            f"{len(buf)} trailing shuffle bytes did not frame a blob — "
-            "corrupt stream or rows reordered within a map's partition")
+    chunks = [rows for _keys, rows in batches if len(rows)]
+    if not chunks:
+        return
+    rows = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
+    chunks.clear()
+    tags = np.ascontiguousarray(rows[:, :_TAG]).view("<u8").ravel()
+    order = np.argsort(tags, kind="stable")
+    chunk = rows.shape[1] - _TAG
+    i = 0
+    while i < len(order):
+        (ln,) = _LEN.unpack_from(rows[order[i], _TAG:].tobytes(), 0)
+        span = -(-(_LEN.size + ln) // chunk)
+        if i + span > len(order):
+            raise ValueError(
+                f"blob at row {i} claims {span} rows but only "
+                f"{len(order) - i} remain — corrupt or truncated stream")
+        blob = rows[order[i:i + span], _TAG:].tobytes()
+        yield pickle.loads(blob[_LEN.size:_LEN.size + ln])
+        i += span
 
 
 # -- plan nodes -----------------------------------------------------------
@@ -582,7 +605,7 @@ def _shuffle_stage(node: _Shuffled, memo: dict, ctx: "EngineContext"):
                 buckets.setdefault(_node.route(k), []).append((k, v))
             items = buckets.items()
         for p, records in items:
-            writer.write(_encode_blob(records, p, _w))
+            writer.write(_encode_blob(records, p, _w, task_id))
 
     stage = MapStage(node.parent.num_partitions(), dep, task_fn,
                      parents=parents)
@@ -831,8 +854,12 @@ class EngineContext:
         self.engine = engine
         self.default_parallelism = (default_parallelism
                                     or max(2, len(engine.executors)))
-        # fixed row width for object-blob shuffles: 8B u64 key per row on
-        # the wire, zero-pad only in each blob's last row
+        # fixed row width for object-blob shuffles: 8B u64 key + 8B
+        # (map, seq) tag per row on the wire, zero-pad only in each
+        # blob's last row
+        if row_bytes < 64:
+            raise ValueError("row_bytes must be >= 64 (8B row tag + "
+                             "8B length header + payload)")
         self.row_bytes = row_bytes
 
     def parallelize(self, data: Iterable, num_slices: int = 0) -> RDD:

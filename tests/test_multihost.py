@@ -116,6 +116,13 @@ for (k, p, parts), dev in zip(results, local_devs):
         f"device {dev} mismatch"
     got_rows += len(k)
 
+# streamed rounds (rows_per_round bounds device memory; cap=1000 here, so
+# 64/round = 16 collective rounds) must produce identical results
+streamed = run_multihost_mesh_reduce([mgr], handle, mesh, rows_per_round=64)
+for (k1, p1, pa1), (k2, p2, pa2) in zip(results, streamed):
+    assert np.array_equal(canon(k1, p1), canon(k2, p2)), "streamed mismatch"
+    assert np.array_equal(np.sort(pa1), np.sort(pa2))
+
 from jax.experimental import multihost_utils
 multihost_utils.sync_global_devices("done")  # driver outlives readers
 print(f"MESHREDUCE_OK {pid} rows={got_rows}", flush=True)

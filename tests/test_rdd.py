@@ -25,27 +25,42 @@ def ctx(tmp_path):
 def test_blob_roundtrip_various_sizes():
     for size in (0, 1, 7, 1016, 1017, 5000):
         obj = list(range(size))
-        keys, rows = _encode_blob(obj, part=3, width=1024)
+        keys, rows = _encode_blob(obj, part=3, width=1024, map_id=9)
         assert rows.shape[1] == 1024 and (keys == 3).all()
         [back] = list(_decode_blobs([(keys, rows)]))
         assert back == obj
 
 
-def test_blob_decode_across_batch_boundaries():
-    """A blob split across reader batches must reassemble."""
-    obj = {"k": list(range(4000))}
-    keys, rows = _encode_blob(obj, part=0, width=256)
-    assert len(rows) > 3
-    batches = [(keys[:2], rows[:2]), (keys[2:5], rows[2:5]),
-               (keys[5:], rows[5:])]
-    [back] = list(_decode_blobs(batches))
-    assert back == obj
+def test_blob_decode_order_independent():
+    """Rows from several maps, split across batches, in a SHUFFLED
+    order, must reassemble exactly — transports may interleave maps and
+    rounds arbitrarily (mesh sorts by key; bounded rounds split maps)."""
+    import random
+    objs = {m: {"m": m, "data": list(range(1500 * (m + 1)))}
+            for m in range(4)}
+    all_rows = []
+    for m, obj in objs.items():
+        keys, rows = _encode_blob(obj, part=0, width=256, map_id=m)
+        all_rows += [rows[i] for i in range(len(rows))]
+    rng = random.Random(3)
+    rng.shuffle(all_rows)
+    # deliver as 3 odd-sized batches of interleaved rows
+    n = len(all_rows)
+    cuts = [0, n // 3, 2 * n // 3, n]
+    batches = [(np.zeros(cuts[i + 1] - cuts[i], np.uint64),
+                np.stack(all_rows[cuts[i]:cuts[i + 1]]))
+               for i in range(3)]
+    back = list(_decode_blobs(batches))
+    assert sorted(b["m"] for b in back) == [0, 1, 2, 3]
+    for b in back:
+        assert b == objs[b["m"]]
 
 
 def test_blob_decode_rejects_corrupt_stream():
-    keys, rows = _encode_blob([1, 2, 3], part=0, width=128)
-    with pytest.raises(ValueError, match="trailing"):
-        list(_decode_blobs([(keys[:1], rows[:1] + 1)]))  # truncated+garbled
+    keys, rows = _encode_blob(list(range(400)), part=0, width=128, map_id=0)
+    assert len(rows) > 1
+    with pytest.raises(ValueError, match="corrupt|truncated"):
+        list(_decode_blobs([(keys[:1], rows[:1])]))  # truncated
 
 
 def test_portable_hash_stability_and_spread():
