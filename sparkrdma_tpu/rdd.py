@@ -395,6 +395,18 @@ class RDD:
                 break
         return out[:n]
 
+    def materialize(self) -> "RDD":
+        """Evaluate once, return an RDD over the results (the cache()
+        role). Partition data collects to the driver and redistributes
+        through the broadcast plane, so later actions skip the whole
+        upstream lineage — recovery-safe (the driver owns the bytes;
+        executor loss costs nothing) at the price of driver memory, like
+        a collect + parallelize that keeps partitioning. Use before
+        multi-action reuse or sort_by_key's extra sampling pass."""
+        parts = self._run(lambda it, _t: list(it))
+        return RDD(self._ctx,
+                   _Source(self._ctx.engine.broadcast(parts), len(parts)))
+
     def save_as_text_file(self, path: str) -> None:
         """One ``part-NNNNN`` file per partition + a ``_SUCCESS`` marker
         (the Hadoop output contract). Parts write to an attempt-unique

@@ -446,6 +446,23 @@ def test_rdd_through_remote_executors(tmp_path):
         driver.stop()
 
 
+def test_materialize_caches_lineage(ctx):
+    """materialize() evaluates once; downstream actions replay the
+    cached partitions, not the upstream lineage."""
+    evals = ctx.accumulator("evals")
+
+    def counting(x, _a=evals):
+        _a.add(1)
+        return (x % 4, x)
+
+    cached = ctx.parallelize(range(40), 4).map(counting).materialize()
+    assert evals.value == 40
+    assert cached.num_partitions == 4
+    assert sorted(cached.values().collect()) == list(range(40))
+    assert cached.reduce_by_key(lambda a, b: a + b, 2).count() == 4
+    assert evals.value == 40  # lineage never re-ran
+
+
 def test_rdd_pagerank_matches_oracle(ctx):
     """PageRank written in ~15 lines of RDD code (the classic Spark
     program, and BASELINE config #3's shape) agrees with the in-tree
