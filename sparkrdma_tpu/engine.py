@@ -189,7 +189,8 @@ class DAGEngine:
                  mesh=None, mesh_axis: str = "shuffle",
                  mesh_impl: str = "auto", mesh_rows_per_round: int = 0,
                  dist_mesh_axis: Optional[str] = None,
-                 dist_rows_per_round: int = 0):
+                 dist_rows_per_round: int = 0,
+                 dist_fail_grace_s: float = 5.0):
         self.driver = driver
         self.executors = list(executors)
         self.max_stage_retries = max_stage_retries
@@ -221,6 +222,7 @@ class DAGEngine:
         # different orders on different processes and deadlock the group.
         self.dist_mesh_axis = dist_mesh_axis
         self.dist_rows_per_round = dist_rows_per_round
+        self.dist_fail_grace_s = dist_fail_grace_s
         if dist_mesh_axis is not None:
             if mesh is not None:
                 raise ValueError("mesh and dist_mesh_axis are exclusive")
@@ -738,22 +740,33 @@ class DAGEngine:
                         "marked dead; the collective needs every jax "
                         "process — restart the process group")
                 execs = list(self.executors)
-                failure = None
                 results = {}
-                with self.tracer.span("engine.dist_reduce", "engine",
-                                      shuffle=handle.shuffle_id,
-                                      attempt=attempt), \
-                        ThreadPoolExecutor(
-                            max_workers=len(execs),
-                            thread_name_prefix="dist-mesh") as pool:
-                    futs = {pool.submit(ex.run_result_task, fn, [], 0): ex
-                            for ex in execs}
-                    for f, ex in futs.items():
-                        try:
-                            res, _deltas = f.result()
-                            results[ex] = res
-                        except FetchFailedError as e:
-                            failure = e
+                pool = ThreadPoolExecutor(max_workers=len(execs),
+                                          thread_name_prefix="dist-mesh")
+                try:
+                    clean = self._dist_collect(pool, fn, execs, handle,
+                                               attempt, results)
+                except BaseException:
+                    # unexpected escape (KeyboardInterrupt, tracer error):
+                    # never leave non-daemon threads joined-at-exit behind
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    raise
+                failure, hard = clean
+                if hard is not None:
+                    # don't join threads blocked on wedged survivors
+                    # (shutdown(wait=True) would stall the driver for
+                    # their full task budget); they unwind on their own
+                    # RPC timeout
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    lost_ex, lost_e = hard
+                    raise RuntimeError(
+                        f"executor "
+                        f"{lost_ex.manager_id.executor_id.executor} lost "
+                        f"mid-collective ({lost_e!r}); the distributed "
+                        "mesh group cannot recover around a dead jax "
+                        "process — restart the process group"
+                    ) from lost_e
+                pool.shutdown(wait=True)
                 if failure is None:
                     owner: Dict[int, object] = {}
                     seen: Dict[int, object] = {}
@@ -781,6 +794,50 @@ class DAGEngine:
                             "recovering (%d)", handle.shuffle_id, failure,
                             attempt + 1)
                 self._recover_shuffle(failure)
+
+    def _dist_collect(self, pool, fn, execs, handle, attempt, results):
+        """Dispatch ``fn`` to every executor and collect in COMPLETION
+        order: a peer lost mid-collective raises within its
+        connect/transport window while survivors block in the allgather —
+        the loss must surface first or the driver waits a full task
+        budget on a wedged survivor and blames IT.
+
+        Returns ``(failure, hard)``: ``failure`` is a group-consistent
+        FetchFailedError (recoverable via stage retry), ``hard`` is
+        ``(executor, exc)`` for a peer lost/broken mid-collective (the
+        jax.distributed group cannot re-form around the hole).
+        """
+        from concurrent.futures import as_completed, wait as fwait
+
+        failure = None
+        hard = None
+        with self.tracer.span("engine.dist_reduce", "engine",
+                              shuffle=handle.shuffle_id, attempt=attempt):
+            futs = {pool.submit(ex.run_result_task, fn, [], 0): ex
+                    for ex in execs}
+            for f in as_completed(futs):
+                ex = futs[f]
+                try:
+                    res, _deltas = f.result()
+                    results[ex] = res
+                except FetchFailedError as e:
+                    failure = e
+                except Exception as e:
+                    # ExecutorLostError / task error: the process is gone
+                    # or broken mid-dispatch. alive is NOT forced false
+                    # here: transport-flavored losses already cleared it
+                    # (tasks.py), while timeout-flavored ones deliberately
+                    # keep the process alive so job cleanup still reaches
+                    # its shuffle data.
+                    hard = (ex, e)
+                    break
+            if hard is not None:
+                # survivors can never complete; grant a short grace (not
+                # each future's full task budget) for any in-flight
+                # completions, then fail the group
+                fwait([f for f in futs if not f.done()],
+                      timeout=self.dist_fail_grace_s)
+        return failure, hard
 
     def _mesh_read(self, handle, partition: int) -> CompatReader:
         """A reader over ``partition`` served from the collective reduce."""

@@ -419,7 +419,14 @@ class RDD:
         removed first: a shrinking partition count must not leave stale
         parts under a fresh ``_SUCCESS`` (Spark refuses the directory
         outright; here re-runs are expected, so clear exactly the files
-        this writer owns and never anything else)."""
+        this writer owns and never anything else).
+
+        ``path`` must be on a filesystem shared by driver and executors
+        (same requirement as ``_FileSource`` reads): tasks write parts on
+        THEIR machine, and the driver verifies every expected part exists
+        locally before committing ``_SUCCESS`` — with remote executors on
+        unshared disks that verification fails loudly instead of leaving
+        a ``_SUCCESS`` next to missing parts."""
         import glob as _glob
         import os
         os.makedirs(path, exist_ok=True)
@@ -443,7 +450,16 @@ class RDD:
                     f.write("\n")
             os.replace(tmp, os.path.join(_p, f"part-{task_id:05d}"))
 
-        self._run(save)
+        n_parts = len(self._run(save))
+        missing = [i for i in range(n_parts)
+                   if not os.path.exists(os.path.join(path,
+                                                      f"part-{i:05d}"))]
+        if missing:
+            raise IOError(
+                f"save_as_text_file({path!r}): tasks reported success but "
+                f"parts {missing} are absent on the driver's filesystem — "
+                f"executors are writing to an unshared disk; point `path` "
+                f"at a mount shared by driver and executors")
         with open(os.path.join(path, "_SUCCESS"), "w"):
             pass
 
@@ -470,8 +486,16 @@ class RDD:
     partitionBy = partition_by
     groupByKey = group_by_key
     reduceByKey = reduce_by_key
-    sortByKey = sort_by_key
     saveAsTextFile = save_as_text_file
+
+    def sortByKey(self, ascending: bool = True,
+                  numPartitions: Optional[int] = None) -> "RDD":
+        """pyspark's argument order — (ascending, numPartitions) — NOT
+        sort_by_key's (num_partitions, ascending); a plain alias would
+        silently absorb ``sortByKey(False)`` as num_partitions=False and
+        sort ascending."""
+        return self.sort_by_key(num_partitions=numPartitions,
+                                ascending=ascending)
 
     # -- internals --------------------------------------------------------
 
@@ -480,7 +504,26 @@ class RDD:
         return self._node.num_partitions()
 
     def _parts(self, num_partitions: Optional[int]) -> int:
-        return num_partitions or self._node.num_partitions()
+        if num_partitions is None:
+            return self._node.num_partitions()
+        import operator
+        try:
+            if isinstance(num_partitions, bool):
+                # the classic misuse is pyspark's sortByKey(False); only
+                # THAT hint fits a bool — other methods just got a bad arg
+                raise ValueError(
+                    f"num_partitions must be a positive int, got "
+                    f"{num_partitions!r} (pyspark-style calls belong on "
+                    f"sortByKey(ascending, numPartitions))")
+            n = operator.index(num_partitions)  # int-likes incl. np.int64
+        except TypeError:
+            raise ValueError(
+                f"num_partitions must be a positive int, got "
+                f"{num_partitions!r}") from None
+        if n < 1:
+            raise ValueError(
+                f"num_partitions must be >= 1, got {n}")
+        return n
 
     def _sample_keys(self, sample_size: int) -> list:
         """Sampling job for sortByKey: up to ``sample_size`` keys per

@@ -186,6 +186,46 @@ def test_sort_by_key_descending_balanced(ctx):
         f"descending sort degenerated to {sizes}"
 
 
+def test_sortByKey_pyspark_signature(ctx):
+    """``sortByKey(False)`` is pyspark's ascending flag, not a partition
+    count — a plain alias would absorb it as num_partitions=False and
+    silently sort ascending."""
+    pairs = [(i, i) for i in range(100)]
+    keys = [k for k, _ in
+            ctx.parallelize(pairs, 4).sortByKey(False).collect()]
+    assert keys == sorted(range(100), reverse=True)
+    keys = [k for k, _ in
+            ctx.parallelize(pairs, 4)
+            .sortByKey(True, numPartitions=3).collect()]
+    assert keys == list(range(100))
+
+
+def test_num_partitions_validated(ctx):
+    rdd = ctx.parallelize([(1, 1)], 2)
+    for bad in (0, -1, False, True, 2.0):
+        with pytest.raises(ValueError, match="num_partitions"):
+            rdd.sort_by_key(bad)
+
+
+def test_save_as_text_file_missing_part_blocks_success(ctx, tmp_path,
+                                                       monkeypatch):
+    """_SUCCESS must not commit when a task's part file is absent on the
+    driver's filesystem (the unshared-mount failure mode)."""
+    import os
+    out = tmp_path / "out"
+    real_replace = os.replace
+
+    def drop_part_2(src, dst, _r=real_replace):
+        _r(src, dst)
+        if dst.endswith("part-00002"):
+            os.remove(dst)
+
+    monkeypatch.setattr(os, "replace", drop_part_2)
+    with pytest.raises(IOError, match="unshared"):
+        ctx.parallelize(list(range(40)), 4).save_as_text_file(str(out))
+    assert not (out / "_SUCCESS").exists()
+
+
 def test_first_on_empty_rdd_raises_value_error(ctx):
     with pytest.raises(ValueError, match="empty"):
         ctx.parallelize([], 2).first()
