@@ -63,14 +63,14 @@ def make_join_step(mesh: Mesh, axis_name: str, cfg: JoinConfig,
         dest = jnp.where(valid, hash_partition(keys, n), -1)
         output = jnp.zeros((rows.shape[0] * capacity_factor, rows.shape[1]),
                            rows.dtype)
-        received, recv_counts, _ = shuffle_shard(
+        received, recv_counts, _, overflowed = shuffle_shard(
             rows, dest, axis_name, n, output=output, impl=impl)
         total = recv_counts.sum()
         rvalid = jnp.arange(received.shape[0], dtype=jnp.int32) < total
         rkeys = jnp.where(rvalid, received[:, 0], PAD)
         order = jnp.argsort(rkeys, stable=True)
         return (jnp.sort(rkeys), jnp.take(received[:, 1], order),
-                total, recv_counts.sum() > output.shape[0])
+                total, overflowed)
 
     @jax.jit
     @functools.partial(jax.shard_map, mesh=mesh,

@@ -80,10 +80,9 @@ def make_pagerank_step(mesh: Mesh, axis_name: str, cfg: PageRankConfig,
                               contrib.astype(jnp.float32), jnp.uint32)], axis=1)
         dest_dev = jnp.where(valid, dst // v_local, -1)
         output = jnp.zeros((rows.shape[0] * cfg.out_factor, 2), jnp.uint32)
-        received, recv_counts, _ = shuffle_shard(
+        received, recv_counts, _, overflowed = shuffle_shard(
             rows, dest_dev, axis_name, n, output=output, impl=impl)
         total = recv_counts.sum()
-        overflowed = total > output.shape[0]
         rvalid = jnp.arange(received.shape[0], dtype=jnp.int32) < total
         rdst = jnp.where(rvalid,
                          received[:, 0].astype(jnp.int32) - me * v_local, 0)

@@ -144,10 +144,9 @@ def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
 
         output = jnp.zeros((rows.shape[0] * cfg.out_factor, rows.shape[1]),
                            dtype=rows.dtype)
-        received, recv_counts, _ = ragged_exchange_shard(
+        received, recv_counts, _, overflowed = ragged_exchange_shard(
             grouped, counts, axis_name, output=output, impl=impl)
         total = recv_counts.sum()
-        overflowed = total > output.shape[0]
         valid = jnp.arange(received.shape[0], dtype=jnp.int32) < total
         sentinel = jnp.uint32(0xFFFFFFFF)
         sort_keys = jnp.where(valid, received[:, 0], sentinel)

@@ -110,11 +110,11 @@ def make_tpcds_step(mesh: Mesh, axis_name: str, cfg: TpcdsConfig,
 
     def exchange(rows, dest, capacity):
         output = jnp.zeros((capacity, rows.shape[1]), rows.dtype)
-        received, recv_counts, _ = shuffle_shard(
+        received, recv_counts, _, overflowed = shuffle_shard(
             rows, dest, axis_name, n, output=output, impl=impl)
         total = recv_counts.sum()
         valid = jnp.arange(capacity, dtype=jnp.int32) < total
-        return received, valid, total > capacity
+        return received, valid, overflowed
 
     def dim_lookup(dim_rows, dim_valid, query_keys):
         """Unique-key join: sorted dim + one searchsorted per probe."""
@@ -166,11 +166,10 @@ def make_tpcds_step(mesh: Mesh, axis_name: str, cfg: TpcdsConfig,
         dest3 = jnp.where(live2, (group % n).astype(jnp.int32), -1)
         agg_cap = F * cfg.out_factor
         out3 = jnp.zeros((agg_cap, 2), rows3.dtype)
-        recv3, rc3, _ = shuffle_shard(rows3, dest3, axis_name, n,
-                                      output=out3, impl=impl)
+        recv3, rc3, _, of5 = shuffle_shard(rows3, dest3, axis_name, n,
+                                           output=out3, impl=impl)
         total3 = rc3.sum()
         v3 = jnp.arange(agg_cap, dtype=jnp.int32) < total3
-        of5 = total3 > agg_cap
         g3 = jnp.where(v3 & (recv3[:, 0] != pad), recv3[:, 0], jnp.uint32(G))
         counts = jnp.bincount(g3, length=G + 1)[:G].astype(jnp.int32)
         sums = jnp.bincount(

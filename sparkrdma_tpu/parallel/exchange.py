@@ -119,7 +119,8 @@ def ragged_exchange_shard(data: jnp.ndarray, send_counts: jnp.ndarray,
                           axis_name: str,
                           output: Optional[jnp.ndarray] = None,
                           impl: str = "native",
-                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                     jnp.ndarray]:
     """Per-shard ragged all-to-all. Call inside ``shard_map``.
 
     Args:
@@ -139,10 +140,15 @@ def ragged_exchange_shard(data: jnp.ndarray, send_counts: jnp.ndarray,
         whenever dense's slots fit.
 
     Returns:
-      ``(received, recv_counts, recv_offsets)`` where ``received`` is packed
-      grouped-by-source, ``recv_counts[j]`` is rows received from device j,
-      and ``recv_offsets`` is their exclusive prefix (start of each source's
-      segment in ``received``).
+      ``(received, recv_counts, recv_offsets, overflowed)`` where
+      ``received`` is packed grouped-by-source, ``recv_counts[j]`` is rows
+      received from device j, ``recv_offsets`` is their exclusive prefix
+      (start of each source's segment in ``received``), and ``overflowed``
+      is a bool scalar: True when this shard's receive exceeded
+      ``out_capacity`` OR (dense transport) some pair exceeded its fixed
+      slot. When it is set, ``received`` is truncated — counts/offsets stay
+      real, but callers MUST check the flag before trusting the rows
+      (remedy: raise ``out_factor`` / chunk into rounds).
     """
     send_counts = send_counts.astype(jnp.int32)
     # 1. size exchange: full D x D count matrix; mat[j, i] = j sends to i.
@@ -164,18 +170,20 @@ def ragged_exchange_shard(data: jnp.ndarray, send_counts: jnp.ndarray,
         # gather handles any capacity; static shapes make this a
         # trace-time branch
         impl = "gather"
+    pair_overflow = jnp.bool_(False)
     if impl == "native":
         received = lax.ragged_all_to_all(
             data, output, input_offsets, send_sizes, output_offsets, recv_sizes,
             axis_name=axis_name)
     elif impl == "dense":
-        received, recv_sizes = _dense_exchange(data, mat, my, output,
-                                               axis_name)
+        received, recv_sizes, pair_overflow = _dense_exchange(
+            data, mat, my, output, axis_name)
     elif impl == "gather":
         received = _gather_exchange(data, mat, my, output, axis_name)
     else:
         raise ValueError(f"unknown exchange impl {impl!r}")
-    return received, recv_sizes, _exclusive_cumsum(recv_sizes)
+    overflowed = pair_overflow | (jnp.sum(recv_sizes) > output.shape[0])
+    return received, recv_sizes, _exclusive_cumsum(recv_sizes), overflowed
 
 
 def _dense_exchange(data: jnp.ndarray, mat: jnp.ndarray, my: jnp.ndarray,
@@ -185,12 +193,13 @@ def _dense_exchange(data: jnp.ndarray, mat: jnp.ndarray, my: jnp.ndarray,
     remainder rows are unused headroom).
 
     Exact (bit-identical to native/gather) whenever no pair exceeds its
-    slot; a pair overflow is surfaced by inflating the reported receive
-    counts past the output capacity, so every caller's existing
-    ``total > capacity`` overflow check fires (remedy is the same:
-    raise ``out_factor``, which grows Q). Unlike ragged-all-to-all this
-    lowers on every topology (plain all-to-all) and on XLA:CPU, so the
-    path is executable in CI.
+    slot; a pair overflow is reported as an explicit bool (third return
+    value) that ``ragged_exchange_shard`` folds into its ``overflowed``
+    flag — receive counts are always the TRUE per-source counts (remedy
+    for an overflow is the same as for capacity: raise ``out_factor``,
+    which grows Q). Unlike ragged-all-to-all this lowers on every
+    topology (plain all-to-all) and on XLA:CPU, so the path is
+    executable in CI.
     """
     n = mat.shape[0]
     out_cap = output.shape[0]
@@ -202,12 +211,9 @@ def _dense_exchange(data: jnp.ndarray, mat: jnp.ndarray, my: jnp.ndarray,
 
     recv_true = mat[:, my]
     received = _pack_by_source(got, jnp.minimum(recv_true, q), output)
-    # pair overflow (anyone sent me more than a slot): poison the count
-    # total past out_cap so the callers' overflow flag fires
-    overflowed = (recv_true > q).any()
-    recv_report = recv_true.at[0].add(
-        jnp.where(overflowed, jnp.int32(out_cap + 1), 0))
-    return received, recv_report
+    # pair overflow (anyone sent me more than a slot): explicit flag;
+    # counts stay true so offsets derived from them are never garbage
+    return received, recv_true, (recv_true > q).any()
 
 
 def _gather_exchange(data: jnp.ndarray, mat: jnp.ndarray, my: jnp.ndarray,
@@ -272,7 +278,8 @@ def shuffle_shard(data: jnp.ndarray, dest: jnp.ndarray, axis_name: str,
                   output: Optional[jnp.ndarray] = None,
                   impl: str = "native"):
     """Full per-shard shuffle step: group locally by destination device,
-    then ragged-exchange. Returns (received, recv_counts, recv_offsets)."""
+    then ragged-exchange. Returns (received, recv_counts, recv_offsets,
+    overflowed) — see ``ragged_exchange_shard``."""
     grouped, counts = group_by_destination(data, dest, num_devices)
     return ragged_exchange_shard(grouped, counts, axis_name, output, impl)
 
@@ -434,7 +441,10 @@ def _chunked_round_shard(grouped, counts, round_idx, axis_name: str, n: int,
     # collide harmlessly on the last slot, then get overwritten only by
     # at most one valid row — counts guarantee compact positions unique)
     send_buf = send_buf.at[compact_idx].set(filled)
-    received, recv_counts, _ = ragged_exchange_shard(
+    # overflow is impossible by construction here: per-pair send_counts
+    # <= quota and the output capacity is exactly n * quota (= dense's
+    # slot size), so the flag is statically dead — dropped
+    received, recv_counts, _, _ = ragged_exchange_shard(
         send_buf, send_counts, axis_name, impl=impl_resolved)
     return received, recv_counts
 
@@ -544,8 +554,10 @@ def make_shuffle_exchange(mesh: Mesh, axis_name: str, impl: str = "auto",
 
     The returned callable takes globally-sharded arrays
     ``(data[D*capacity, ...], dest[D*capacity])`` (sharded on the leading
-    axis) and returns ``(received, recv_counts[D, D], recv_offsets[D, D])``
-    with the same leading-axis sharding.
+    axis) and returns ``(received, recv_counts[D, D], recv_offsets[D, D],
+    overflowed[D])`` with the same leading-axis sharding; ``overflowed[d]``
+    is device d's explicit receive-overflow flag (capacity or dense pair
+    slot) — check it before trusting ``received``.
 
     ``out_factor`` scales each device's receive capacity relative to its send
     capacity: a receiver may legitimately net-gain rows (skew). Callers bound
@@ -560,12 +572,13 @@ def make_shuffle_exchange(mesh: Mesh, axis_name: str, impl: str = "auto",
     @jax.jit
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(spec, spec), out_specs=(spec, spec, spec))
+        in_specs=(spec, spec), out_specs=(spec, spec, spec, spec))
     def exchange(data, dest):
         output = jnp.zeros((data.shape[0] * out_factor,) + data.shape[1:],
                            dtype=data.dtype)
-        received, recv_counts, recv_offsets = shuffle_shard(
+        received, recv_counts, recv_offsets, overflowed = shuffle_shard(
             data, dest, axis_name, n, output=output, impl=impl)
-        return received, recv_counts[None], recv_offsets[None]
+        return received, recv_counts[None], recv_offsets[None], \
+            overflowed[None]
 
     return exchange

@@ -244,17 +244,19 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
             sharding, rows_p, (n_global * cap, width))
         dest_g = jax.make_array_from_process_local_data(
             sharding, dest_p, (n_global * cap,))
-        received, counts, _ = jax.block_until_ready(
+        received, counts, _, overflowed = jax.block_until_ready(
             exchange(rows_g, dest_g))
         recv_by_dev = {s.device: np.asarray(s.data)
                        for s in received.addressable_shards}
         counts_by_dev = {s.device: np.asarray(s.data)
                          for s in counts.addressable_shards}
+        of_by_dev = {s.device: np.asarray(s.data)
+                     for s in overflowed.addressable_shards}
         for i, dev in enumerate(local_mesh_devices):
             got = recv_by_dev[dev].reshape(-1, width)
             cnt = counts_by_dev[dev].reshape(-1)
             total = int(cnt.sum())
-            if total > cap * out_factor:
+            if of_by_dev[dev].any():
                 raise OverflowError(
                     "multihost mesh reduce receive overflow; raise "
                     "out_factor or lower rows_per_round skew exposure")

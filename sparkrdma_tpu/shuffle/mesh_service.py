@@ -110,14 +110,14 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     exchange = make_shuffle_exchange(mesh, axis_name, impl=impl,
                                      out_factor=out_factor)
     sharding = NamedSharding(mesh, P(axis_name))
-    received, counts, _ = jax.block_until_ready(exchange(
+    received, counts, _, overflowed = jax.block_until_ready(exchange(
         jax.device_put(rows_p, sharding), jax.device_put(dest_p, sharding)))
     exchange_mod.record_exchange(len(rows))
 
     # 3. unpack per device (host-side view of the device results)
     received = np.asarray(received).reshape(n_dev, -1, width)
     counts = np.asarray(counts)
-    if (counts.sum(axis=1) > cap * out_factor).any():
+    if np.asarray(overflowed).any():
         raise OverflowError("mesh reduce receive overflow")
     results = []
     for d in range(n_dev):
@@ -238,10 +238,11 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
                         jax.device_put(dest_p, sharding))
 
     def collect(results) -> None:
-        received, counts, _ = results  # np.asarray blocks on the device
+        # np.asarray blocks on the device
+        received, counts, _, overflowed = results
         received = np.asarray(received).reshape(n_dev, -1, pw)
         counts = np.asarray(counts)
-        if (counts.sum(axis=1) > cap * out_factor).any():
+        if np.asarray(overflowed).any():
             raise OverflowError("mesh reduce receive overflow; raise "
                                 "out_factor or shrink rows_per_round")
         for d in range(n_dev):

@@ -49,13 +49,16 @@ def _run_exchange(mesh, data, dest, capacity, out_factor=1):
     sharding = jax.NamedSharding(mesh, P("shuffle"))
     data_d = jax.device_put(data, sharding)
     dest_d = jax.device_put(dest, sharding)
-    received, counts, offsets = jax.block_until_ready(exchange(data_d, dest_d))
+    received, counts, offsets, overflowed = jax.block_until_ready(
+        exchange(data_d, dest_d))
     return (np.asarray(received).reshape(D, capacity * out_factor, *data.shape[1:]),
-            np.asarray(counts), np.asarray(offsets))
+            np.asarray(counts), np.asarray(offsets), np.asarray(overflowed))
 
 
 def _check(mesh, data, dest, capacity, out_factor=1):
-    received, counts, offsets = _run_exchange(mesh, data, dest, capacity, out_factor)
+    received, counts, offsets, overflowed = _run_exchange(
+        mesh, data, dest, capacity, out_factor)
+    assert not overflowed.any(), "unexpected overflow flag"
     expect = _numpy_oracle(data, dest, capacity)
     for i in range(D):
         total = counts[i].sum()
@@ -99,7 +102,7 @@ def test_empty_senders(mesh):
     data = np.arange(D * capacity, dtype=np.int32)
     dest = np.full(D * capacity, -1, dtype=np.int32)  # -1 = padding
     dest[:capacity] = np.repeat(np.arange(D, dtype=np.int32), capacity // D)
-    received, counts, _ = _run_exchange(mesh, data, dest, capacity)
+    received, counts, _, _ = _run_exchange(mesh, data, dest, capacity)
     for i in range(D):
         assert counts[i].sum() == capacity // D
         # all received rows come from device 0
@@ -116,7 +119,7 @@ def test_all_traffic_to_one_device(mesh):
     dest = np.full(D * capacity, -1, dtype=np.int32)
     for j in range(D):
         dest[j * capacity: j * capacity + capacity // D] = 0
-    received, counts, _ = _run_exchange(mesh, data, dest, capacity)
+    received, counts, _, _ = _run_exchange(mesh, data, dest, capacity)
     assert counts[0].sum() == capacity  # exactly fills device 0's buffer
     for i in range(1, D):
         assert counts[i].sum() == 0
@@ -201,12 +204,12 @@ def _run_impl(mesh, data, dest, capacity, out_factor, impl):
     exchange = make_shuffle_exchange(mesh, "shuffle", impl=impl,
                                      out_factor=out_factor)
     sharding = jax.NamedSharding(mesh, P("shuffle"))
-    received, counts, _ = jax.block_until_ready(
+    received, counts, _, overflowed = jax.block_until_ready(
         exchange(jax.device_put(data, sharding),
                  jax.device_put(dest, sharding)))
     return (np.asarray(received).reshape(D, capacity * out_factor,
                                          *data.shape[1:]),
-            np.asarray(counts))
+            np.asarray(counts), np.asarray(overflowed))
 
 
 def test_dense_bit_identical_to_gather(mesh):
@@ -215,10 +218,11 @@ def test_dense_bit_identical_to_gather(mesh):
     rng = np.random.default_rng(7)
     data = rng.integers(0, 2**31, size=(D * capacity, 3), dtype=np.int32)
     dest = rng.integers(0, D, size=D * capacity).astype(np.int32)
-    dr, dc = _run_impl(mesh, data, dest, capacity, 2, "dense")
-    gr, gc = _run_impl(mesh, data, dest, capacity, 2, "gather")
+    dr, dc, dof = _run_impl(mesh, data, dest, capacity, 2, "dense")
+    gr, gc, gof = _run_impl(mesh, data, dest, capacity, 2, "gather")
     np.testing.assert_array_equal(dc, gc)
     np.testing.assert_array_equal(dr, gr)
+    assert not dof.any() and not gof.any()
     expect = _numpy_oracle(data, dest, capacity)
     for i in range(D):
         np.testing.assert_array_equal(dr[i][:dc[i].sum()], expect[i])
@@ -229,21 +233,24 @@ def test_dense_empty_and_one_hot(mesh):
     data = np.arange(D * capacity, dtype=np.int32)
     # nobody sends anything
     dest = np.full(D * capacity, -1, np.int32)
-    dr, dc = _run_impl(mesh, data, dest, capacity, 2, "dense")
-    assert dc.sum() == 0
+    dr, dc, dof = _run_impl(mesh, data, dest, capacity, 2, "dense")
+    assert dc.sum() == 0 and not dof.any()
     # everyone sends everything to device 5; per-pair cap rows need
     # out_factor >= D for the slots to fit
     dest = np.full(D * capacity, 5, np.int32)
-    dr, dc = _run_impl(mesh, data, dest, capacity, D, "dense")
+    dr, dc, dof = _run_impl(mesh, data, dest, capacity, D, "dense")
     assert dc[5].sum() == D * capacity
+    assert not dof.any()
     np.testing.assert_array_equal(
         np.sort(dr[5][:D * capacity].ravel()), data)
     assert all(dc[i].sum() == 0 for i in range(D) if i != 5)
 
 
-def test_dense_pair_overflow_poisons_counts(mesh):
-    """A single (src, dst) pair exceeding its slot must trip the callers'
-    total>capacity overflow check even though the total fits."""
+def test_dense_pair_overflow_sets_flag_counts_stay_true(mesh):
+    """A single (src, dst) pair exceeding its slot must set the explicit
+    overflow flag for the receiver ONLY, while reported counts stay the
+    TRUE per-source counts (no poisoning — offsets derived from them
+    remain meaningful)."""
     capacity, out_factor = 64, 2
     q = capacity * out_factor // D  # 16 per pair
     data = np.arange(D * capacity, dtype=np.int32)
@@ -251,7 +258,24 @@ def test_dense_pair_overflow_poisons_counts(mesh):
     # device 3 sends q+4 rows to device 0 (pair overflow); total to 0 is
     # far under out_cap
     dest[3 * capacity: 3 * capacity + q + 4] = 0
-    dr, dc = _run_impl(mesh, data, dest, capacity, out_factor, "dense")
-    assert dc[0].sum() > capacity * out_factor, "pair overflow not flagged"
+    dr, dc, dof = _run_impl(mesh, data, dest, capacity, out_factor, "dense")
+    assert dof[0], "pair overflow flag not set on receiver"
+    assert not dof[1:].any(), "overflow flag leaked to clean receivers"
+    # counts are the true sent totals, not a poisoned sentinel
+    assert dc[0].sum() == q + 4
+    assert dc[0][3] == q + 4
     # unaffected devices stay exact (nothing was sent to them)
     assert all(dc[i].sum() == 0 for i in range(1, D))
+
+
+def test_capacity_overflow_sets_flag(mesh):
+    """Aggregate receive past out_capacity sets the flag on native/gather
+    paths too (here gather on CPU): every device sends its full buffer to
+    device 0 with out_factor 1."""
+    capacity = 16
+    data = np.arange(D * capacity, dtype=np.int32)
+    dest = np.zeros(D * capacity, np.int32)
+    _r, dc, dof = _run_impl(mesh, data, dest, capacity, 1, "gather")
+    assert dof[0], "capacity overflow flag not set"
+    assert not dof[1:].any()
+    assert dc[0].sum() == D * capacity  # true counts still reported

@@ -149,8 +149,8 @@ def test_2d_mesh_exchange_compiles(tpu_mesh):
                        in_specs=(P("dp", AXIS),) * 2,
                        out_specs=P("dp", AXIS))
     def exchange2d(data, dest):
-        received, _, _ = shuffle_shard(data[0], dest[0], AXIS, 4,
-                                       impl="native")
+        received, _, _, _ = shuffle_shard(data[0], dest[0], AXIS, 4,
+                                          impl="native")
         return received[None]
 
     sh = NamedSharding(mesh2, P("dp", AXIS))
