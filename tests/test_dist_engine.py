@@ -192,6 +192,93 @@ def test_rdd_over_distributed_mesh(tmp_path):
         driver.stop()
 
 
+def test_kill_executor_mid_collective_fails_fast(tmp_path):
+    """SIGKILL one executor process while ``run_multihost_mesh_reduce``
+    is in flight (SURVEY §7 hard part 4: a failed participant stalls the
+    whole mesh). The driver must surface a group-wide failure within the
+    short fail grace — NOT block the full task budget on the wedged
+    survivor — and must name the lost process, not the survivor
+    (RdmaShuffleFetcherIterator.scala:376-381 is the reference's
+    stage-retry precedent; a jax.distributed group can't re-form around
+    a dead process, so the contract here is bounded-time fail-fast)."""
+    import threading
+
+    driver = SparkCompatShuffleManager(CONF, isDriver=True)
+    host, port = driver.driverAddr
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = "127.0.0.1:%d" % s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, str(i), coord, host, str(port),
+         str(tmp_path / f"w{i}")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for i in range(2)]
+    remotes = []
+    try:
+        remotes = remote_executors(driver, CONF, expect=2, timeout=60)
+        # many bounded rounds stretch the collective so the kill lands
+        # genuinely in flight (compile + rounds >> the 1s kill delay)
+        engine = DAGEngine(driver, remotes, dist_mesh_axis="shuffle",
+                           dist_rows_per_round=8, dist_fail_grace_s=3.0)
+        map_fn, reduce_fn = _make_fns()
+        stage = MapStage(MAPS, ShuffleDependency(
+            P, PartitionerSpec("modulo"), row_payload_bytes=4), map_fn)
+
+        # instrument the victim's proxy so the kill fires only once the
+        # collective dispatch is actually in flight on the workers.
+        # remote_executors returns proxies in driver-REGISTRATION order —
+        # a startup race — so map proxy->process by executor id ("w{i}"
+        # is process i by construction in _WORKER)
+        by_id = {r.manager_id.executor_id.executor: r for r in remotes}
+        victim, survivor = by_id["w1"], by_id["w0"]
+        dispatched = threading.Event()
+        orig = victim.run_result_task
+
+        def tapped(fn, parents, task_id):
+            dispatched.set()
+            return orig(fn, parents, task_id)
+
+        victim.run_result_task = tapped
+
+        outcome = {}
+
+        def run_job():
+            try:
+                outcome["got"] = engine.run(
+                    ResultStage(P, reduce_fn, parents=[stage]))
+            except BaseException as e:
+                outcome["err"] = e
+
+        t = threading.Thread(target=run_job)
+        t.start()
+        assert dispatched.wait(90), "collective was never dispatched"
+        time.sleep(1.0)  # let both processes enter the collective
+        procs[1].kill()
+        t_kill = time.monotonic()
+        t.join(timeout=60)
+        elapsed = time.monotonic() - t_kill
+        assert not t.is_alive(), \
+            "driver still blocked >60s after executor death"
+        err = outcome.get("err")
+        assert err is not None, f"job succeeded?! {outcome.get('got')}"
+        assert "restart the process group" in str(err) or \
+            "mid-collective" in str(err), f"unexpected failure: {err!r}"
+        # bounded: grace (3s) + transport detection, nowhere near the
+        # 120s task budget the survivor's RPC would otherwise hold
+        assert elapsed < 45, f"fail-fast took {elapsed:.0f}s"
+        # the SURVIVOR must not be blamed or written off as dead
+        assert getattr(survivor, "alive", True), \
+            "healthy survivor was marked dead"
+    finally:
+        for p in procs:
+            p.kill()
+        for r in remotes:
+            r.stop()
+        driver.stop()
+
+
 def test_engine_distributed_mesh_reduce(tmp_path):
     driver = SparkCompatShuffleManager(CONF, isDriver=True)
     host, port = driver.driverAddr
