@@ -16,6 +16,11 @@ One ALS half-step (solving item factors from fixed user factors):
 3. per item: accumulate normal equations ``A^T A + λI`` and ``A^T r`` over
    its ratings' user factors, then a **batched Cholesky-free solve**
    (``jnp.linalg.solve``) — dense [I_local, k, k] batches on the MXU.
+
+``run_als`` drives the FULL alternating loop — items from users, then
+users from items (the same half-step with the key columns swapped), two
+skewed shuffles per sweep — and reports the RMSE trajectory, matching the
+MLlib ALS cadence the reference benchmarks under config #5.
 """
 
 from __future__ import annotations
@@ -61,62 +66,108 @@ def generate_ratings(cfg: ALSConfig, num_devices: int, per_device: int,
 
 
 def solve_item_factors(ratings_for_device: np.ndarray, user_factors: np.ndarray,
-                       cfg: ALSConfig, items_on_device: np.ndarray) -> np.ndarray:
-    """Batched normal-equation solve for this device's items (jitted).
+                       cfg: ALSConfig, items_on_device: np.ndarray,
+                       key_col: int = 0) -> np.ndarray:
+    """Batched normal-equation solve for this device's entities (jitted).
 
     ``ratings_for_device``: the post-exchange (item, user, rating) rows this
     device owns. Dense accumulation via segment scatter-add, then one
     batched ``linalg.solve`` — [I, k, k] on the MXU.
+
+    ``key_col`` picks the side being SOLVED (0 = items from fixed user
+    factors, 1 = users from fixed item factors — the two alternating
+    half-steps are the same math with the columns swapped).
     """
     k = cfg.rank
-    item_index = {int(i): n for n, i in enumerate(items_on_device)}
-    local_item = np.array([item_index[int(i)] for i in ratings_for_device[:, 0]],
-                          dtype=np.int32)
-    users = ratings_for_device[:, 1].astype(np.int64)
+    other_col = 1 - key_col
+    # np.searchsorted over the sorted owned-entity ids: the Python dict
+    # per-row loop was the host bottleneck at rehearsal scale
+    local_key = np.searchsorted(items_on_device,
+                                ratings_for_device[:, key_col]).astype(np.int32)
+    others = ratings_for_device[:, other_col].astype(np.int64)
     vals = ratings_for_device[:, 2].view(np.float32)
 
-    n_items = len(items_on_device)
-    u = jnp.asarray(user_factors[users])              # [R, k]
-    li = jnp.asarray(local_item)
-    r = jnp.asarray(vals)
-    solve = _cached_solve(n_items, k, float(cfg.reg))
-    return np.asarray(solve(u, li, r))
+    # pow2 key-count bucket + fixed row chunks: a handful of compiled
+    # shapes total (not one per device per sweep), and the [CH, k, k]
+    # outer-product transient stays bounded no matter how many rows the
+    # zipf-hot device drew (11M rows would otherwise materialize a
+    # multi-GB intermediate in one op)
+    n_keys = len(items_on_device)
+    n_pad = 1 << max(4, (n_keys - 1).bit_length())
+    accum = _cached_accum(n_pad, k)
+    finish = _cached_finish(n_pad, k, float(cfg.reg))
+    ata = jnp.zeros((n_pad, k, k), jnp.float32)
+    atr = jnp.zeros((n_pad, k), jnp.float32)
+    R = len(ratings_for_device)
+    ch = _SOLVE_CHUNK
+    for lo in range(0, max(R, 1), ch):
+        hi = min(lo + ch, R)
+        pad = ch - (hi - lo)
+        u = user_factors[others[lo:hi]]
+        li = local_key[lo:hi]
+        r = vals[lo:hi]
+        if pad:
+            u = np.concatenate([u, np.zeros((pad, k), np.float32)])
+            # out-of-range key -> dropped by the scatter
+            li = np.concatenate([li, np.full(pad, n_pad, np.int32)])
+            r = np.concatenate([r, np.zeros(pad, np.float32)])
+        ata, atr = accum(ata, atr, jnp.asarray(u), jnp.asarray(li),
+                         jnp.asarray(r))
+    return np.asarray(finish(ata, atr))[:n_keys]
+
+
+_SOLVE_CHUNK = 1 << 20
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_solve(n_items: int, k: int, reg: float):
-    """One jitted solver per (n_items, k, reg) — reused across devices and
-    iterations so ALS pays a handful of compiles, not D*T."""
+def _cached_accum(n_pad: int, k: int):
+    """Jitted normal-equation accumulator over one fixed-size row chunk;
+    pow2 ``n_pad`` buckets keep the compile count logarithmic."""
 
     @jax.jit
-    def solve(u, li, r):
-        outer = u[:, :, None] * u[:, None, :]          # [R, k, k]
-        ata = jnp.zeros((n_items, k, k)).at[li].add(outer)
-        atr = jnp.zeros((n_items, k)).at[li].add(u * r[:, None])
+    def accum(ata, atr, u, li, r):
+        outer = u[:, :, None] * u[:, None, :]          # [CH, k, k]
+        return (ata.at[li].add(outer, mode="drop"),
+                atr.at[li].add(u * r[:, None], mode="drop"))
+
+    return accum
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_finish(n_pad: int, k: int, reg: float):
+    """Batched regularized solve; padded keys see ``reg*I x = 0`` -> 0."""
+
+    @jax.jit
+    def finish(ata, atr):
         ata = ata + reg * jnp.eye(k)[None]
         return jnp.linalg.solve(ata, atr[..., None])[..., 0]
 
-    return solve
+    return finish
 
 
 def als_half_step(mesh: Mesh, cfg: ALSConfig, ratings: np.ndarray,
                   user_factors: np.ndarray, quota: int,
-                  axis_name: str = "shuffle") -> Tuple[np.ndarray, int]:
-    """One item-side half-step: skewed shuffle + batched solves.
+                  axis_name: str = "shuffle",
+                  key_col: int = 0) -> Tuple[np.ndarray, int]:
+    """One half-step: skewed shuffle + batched solves.
 
-    Returns (item_factors[num_items, k], rounds_used). Item i is owned by
-    device ``i % D``; the chunked exchange bounds per-round memory no matter
-    how zipfian the item distribution is.
+    ``key_col=0``: solve item factors from fixed user factors (the
+    skew-hammered side); ``key_col=1``: solve user factors from fixed
+    item factors. Returns (factors[num_entities, k], rounds_used).
+    Entity e is owned by device ``e % D``; the chunked exchange bounds
+    per-round memory no matter how zipfian the distribution is.
     """
     n = mesh.shape[axis_name]
     per_dev = ratings.shape[0] // n
+    num_out = cfg.num_items if key_col == 0 else cfg.num_users
 
-    # destination-group rows by item owner (host-side: writer-side grouping)
+    # destination-group rows by entity owner (host-side: writer-side
+    # grouping, the analogue of the sort-by-partition spill)
     grouped = np.empty_like(ratings)
     counts = np.zeros((n, n), dtype=np.int32)
     for d in range(n):
         seg = ratings[d * per_dev:(d + 1) * per_dev]
-        dest = (seg[:, 0] % n).astype(np.int32)
+        dest = (seg[:, key_col] % n).astype(np.int32)
         order = np.argsort(dest, kind="stable")
         grouped[d * per_dev:(d + 1) * per_dev] = seg[order]
         counts[d] = np.bincount(dest, minlength=n)
@@ -124,15 +175,57 @@ def als_half_step(mesh: Mesh, cfg: ALSConfig, ratings: np.ndarray,
     received, rounds = chunked_exchange(mesh, axis_name, grouped, counts,
                                         quota=quota)
 
-    item_factors = np.zeros((cfg.num_items, cfg.rank), dtype=np.float32)
+    factors = np.zeros((num_out, cfg.rank), dtype=np.float32)
     for d in range(n):
         rows = received[d]
         if not len(rows):
             continue
-        items_here = np.unique(rows[:, 0])
-        factors = solve_item_factors(rows, user_factors, cfg, items_here)
-        item_factors[items_here.astype(np.int64)] = factors
-    return item_factors, rounds
+        keys_here = np.unique(rows[:, key_col])
+        solved = solve_item_factors(rows, user_factors, cfg, keys_here,
+                                    key_col=key_col)
+        factors[keys_here.astype(np.int64)] = solved
+    return factors, rounds
+
+
+def rmse(ratings: np.ndarray, user_factors: np.ndarray,
+         item_factors: np.ndarray, sample: int = 0) -> float:
+    """Root-mean-square prediction error over (a sample of) the ratings."""
+    rows = ratings
+    if sample and len(rows) > sample:
+        rows = rows[np.random.default_rng(0).permutation(len(rows))[:sample]]
+    pred = np.sum(user_factors[rows[:, 1].astype(np.int64)]
+                  * item_factors[rows[:, 0].astype(np.int64)], axis=1)
+    err = pred - rows[:, 2].view(np.float32)
+    return float(np.sqrt(np.mean(err * err)))
+
+
+def run_als(mesh: Mesh, cfg: ALSConfig, ratings: np.ndarray, quota: int,
+            iterations: int = 5, axis_name: str = "shuffle", seed: int = 0,
+            rmse_sample: int = 200_000,
+            ) -> Tuple[np.ndarray, np.ndarray, list, int]:
+    """The FULL alternating loop (BASELINE config #5's actual workload):
+    each iteration solves items from users, then users from items — two
+    skewed shuffles per iteration through the bounded-round exchange,
+    the cadence MLlib ALS drives per sweep.
+
+    Returns (user_factors, item_factors, rmse_history, total_rounds);
+    ``rmse_history[0]`` is the pre-training error of the random init.
+    """
+    rng = np.random.default_rng(seed)
+    user_factors = (rng.standard_normal((cfg.num_users, cfg.rank))
+                    .astype(np.float32) / np.sqrt(cfg.rank))
+    item_factors = np.zeros((cfg.num_items, cfg.rank), np.float32)
+    total_rounds = 0
+    history = [rmse(ratings, user_factors, item_factors, rmse_sample)]
+    for _ in range(iterations):
+        item_factors, r1 = als_half_step(mesh, cfg, ratings, user_factors,
+                                         quota, axis_name, key_col=0)
+        user_factors, r2 = als_half_step(mesh, cfg, ratings, item_factors,
+                                         quota, axis_name, key_col=1)
+        total_rounds += r1 + r2
+        history.append(rmse(ratings, user_factors, item_factors,
+                            rmse_sample))
+    return user_factors, item_factors, history, total_rounds
 
 
 def numpy_als_half_step(ratings: np.ndarray, user_factors: np.ndarray,

@@ -107,6 +107,41 @@ def test_als_skewed_half_step_matches_oracle(mesh):
     np.testing.assert_allclose(item_factors, expect, rtol=2e-2, atol=1e-3)
 
 
+def test_als_user_half_step_matches_oracle(mesh):
+    """The user-side half-step is the same math with columns swapped —
+    validated against the item-side oracle on a column-swapped copy."""
+    cfg = ALSConfig(num_users=64, num_items=16, rank=4, zipf_a=1.3)
+    ratings = generate_ratings(cfg, D, per_device=80, seed=6)
+    rng = np.random.default_rng(6)
+    item_factors = rng.normal(size=(cfg.num_items, cfg.rank)).astype(np.float32)
+    user_factors, _ = als_half_step(mesh, cfg, ratings, item_factors,
+                                    quota=16, key_col=1)
+    from dataclasses import replace
+    swapped_cfg = replace(cfg, num_users=cfg.num_items,
+                          num_items=cfg.num_users)
+    expect = numpy_als_half_step(ratings[:, [1, 0, 2]], item_factors,
+                                 swapped_cfg)
+    np.testing.assert_allclose(user_factors, expect, rtol=2e-2, atol=1e-3)
+
+
+def test_als_full_alternating_loop_converges(mesh):
+    """The full users⇄items loop must actually FIT the ratings: RMSE
+    drops hard from the random init and keeps improving (config #5's
+    workload semantics, not just its shuffle shape)."""
+    from sparkrdma_tpu.models.als import run_als
+
+    cfg = ALSConfig(num_users=96, num_items=24, rank=6, zipf_a=1.3)
+    ratings = generate_ratings(cfg, D, per_device=160, seed=8)
+    _uf, _if, history, rounds = run_als(mesh, cfg, ratings, quota=32,
+                                        iterations=4, seed=8)
+    assert rounds >= 8  # two skewed shuffles per sweep, multiple rounds
+    assert history[1] < history[0] * 0.5, history
+    # monotone improvement every sweep; unstructured uniform ratings
+    # floor near their intrinsic noise, so the bound is relative
+    assert all(b <= a for a, b in zip(history[1:], history[2:])), history
+    assert history[-1] < history[0] * 0.3, f"did not fit: {history}"
+
+
 # ---- join ----
 
 def test_join_matches_oracle(mesh):
