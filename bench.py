@@ -47,7 +47,20 @@ def _hw_artifact(max_age_s: Optional[float] = None) -> Optional[dict]:
             continue
         if rec.get("detail", {}).get("platform") != "tpu":
             continue
-        age_s = time.time() - os.path.getmtime(path)
+        # the record's own capture timestamp, not file mtime: a fresh
+        # clone resets mtime, which would make a months-old committed
+        # capture look brand new (file time falls back only when the
+        # record predates the captured_at field)
+        ref_t = os.path.getmtime(path)
+        captured_at = rec.get("detail", {}).get("captured_at")
+        if captured_at:
+            try:
+                import calendar
+                ref_t = calendar.timegm(
+                    time.strptime(captured_at, "%Y-%m-%dT%H:%M:%SZ"))
+            except ValueError:
+                pass
+        age_s = time.time() - ref_t
         if max_age_s is not None and age_s > max_age_s:
             continue
         return dict(rec, artifact=os.path.basename(path),
@@ -55,23 +68,28 @@ def _hw_artifact(max_age_s: Optional[float] = None) -> Optional[dict]:
     return None
 
 
-def _spawn_recovery_watch(out: str = "BENCH_HW_auto.json") -> bool:
+def _spawn_recovery_watch(out: str = "BENCH_HW_auto.json") -> str:
     """Leave a detached tunnel-recovery watcher behind after a failed
     probe (unless one is already running): three rounds were lost to
-    "try again later" — the watcher turns later into an artifact."""
+    "try again later" — the watcher turns later into an artifact.
+
+    Returns the watcher state for the record — "already_running" /
+    "spawned" / "spawn_failed" — so a record taken while a watcher from
+    earlier in the round is still probing doesn't under-report the
+    active recovery attempt as plain ``false``."""
     script = os.path.join(_REPO, "scripts", "bench_recovery_watch.sh")
     try:
         probe = subprocess.run(["pgrep", "-f", "bench_recovery_watch"],
                                capture_output=True)
         if probe.returncode == 0 and probe.stdout.strip():
-            return False  # already watching
+            return "already_running"
         with open(os.path.join(_REPO, "hw_watch.log"), "ab") as log:
             subprocess.Popen(["bash", script, out, "9"],
                              stdout=log, stderr=log,
                              start_new_session=True)
-        return True
+        return "spawned"
     except OSError:
-        return False
+        return "spawn_failed"
 
 
 def _probe_device(timeout_s: int = 60) -> tuple[str | None, str]:
@@ -258,7 +276,7 @@ def _emit_cpu_fallback(env: dict, timeout_s: int, failure: str) -> int:
             "captured by scripts/bench_recovery_watch.sh when the tunnel "
             f"recovered; replayed because the tunnel is wedged now "
             f"({failure[:200]})")
-        detail["recovery_watcher_spawned"] = spawned
+        detail["recovery_watcher"] = spawned
         print(json.dumps(hw))
         return 0
     env = dict(env)
@@ -276,7 +294,7 @@ def _emit_cpu_fallback(env: dict, timeout_s: int, failure: str) -> int:
             result = json.loads(line)
             result["detail"]["platform"] = "cpu-fallback"
             result["detail"]["tpu_failure"] = failure
-            result["detail"]["recovery_watcher_spawned"] = spawned
+            result["detail"]["recovery_watcher"] = spawned
             print(json.dumps(result))
             return 0
         failure += (" | cpu: exit=%d: %s"
@@ -287,7 +305,7 @@ def _emit_cpu_fallback(env: dict, timeout_s: int, failure: str) -> int:
     print(json.dumps({"metric": "terasort_shuffle_throughput_per_chip",
                       "value": 0.0, "unit": "GB/s/chip", "vs_baseline": 0.0,
                       "detail": {"error": failure[-600:],
-                                 "recovery_watcher_spawned": spawned}}))
+                                 "recovery_watcher": spawned}}))
     return 1
 
 

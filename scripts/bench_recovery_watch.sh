@@ -22,7 +22,7 @@ EOF
     BENCH_TIMEOUT_S="${BENCH_TIMEOUT_S:-700}" \
     BENCH_TIMEOUT_MULTISORT_S="${BENCH_TIMEOUT_MULTISORT_S:-2400}" \
       python bench.py > "$OUT.tmp" 2>/dev/null
-    if [ -s "$OUT.tmp" ] && grep -q '"platform": "tpu"' "$OUT.tmp"; then
+    if [ -s "$OUT.tmp" ] && grep -qE '"platform": "tpu"[,}]' "$OUT.tmp"; then
       mv "$OUT.tmp" "$OUT"
       echo "[$(date +%H:%M:%S)] hardware bench recorded in $OUT" >&2
       exit 0

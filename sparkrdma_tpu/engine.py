@@ -283,6 +283,15 @@ class DAGEngine:
         # by every task reading it
         self._mesh_cache: Dict[int, _MeshCell] = {}
         self._mesh_lock = threading.Lock()
+        # pinned stages (rdd.persist): their shuffles survive job teardown
+        # so later jobs SKIP the whole producing sub-DAG and read the
+        # materialized outputs — Spark's skipped-stages semantics, which
+        # is also its cache recovery story: a lost map output surfaces as
+        # FetchFailed and the ordinary stage retry recomputes it from the
+        # pinned stage's task_fn (the captured lineage). Refcounted ids:
+        # two cached RDDs sharing ancestors unpin independently.
+        self._pin_counts: Dict[int, int] = {}
+        self._pinned_complete: set = set()
 
     # -- public ----------------------------------------------------------
 
@@ -294,6 +303,75 @@ class DAGEngine:
         map-side joins; here it rides the same control plane as the
         driver table)."""
         return shared_vars.create_broadcast(value, self.driver.native.driver)
+
+    def pin(self, stage: MapStage) -> None:
+        """Pin ``stage`` and every ancestor MapStage: their shuffles stay
+        registered (with data) past job teardown, so subsequent jobs skip
+        the producing stages entirely and read the materialized outputs.
+        Ancestors pin too because a pinned map lost to executor failure
+        recomputes via its task_fn, which reads the parent shuffles —
+        lineage recovery needs the whole chain alive (Spark keeps all
+        shuffle files until dependency GC for exactly this reason)."""
+
+        seen: set = set()  # once per pin() call: diamond lineages
+        # (shared memoized ancestors) must walk linearly, not per-path
+
+        def visit(s):
+            if s.stage_id in seen:
+                return
+            seen.add(s.stage_id)
+            self._pin_counts[s.stage_id] = \
+                self._pin_counts.get(s.stage_id, 0) + 1
+            for p in s.parents:
+                visit(p)
+
+        visit(stage)
+
+    def unpin(self, stage: MapStage) -> None:
+        """Release one pin on ``stage`` + ancestors; a stage whose count
+        hits zero has its shuffle torn down now (rdd.unpersist)."""
+        seen: set = set()
+
+        def visit(s):
+            if s.stage_id in seen:
+                return
+            seen.add(s.stage_id)
+            n = self._pin_counts.get(s.stage_id, 0) - 1
+            if n > 0:
+                self._pin_counts[s.stage_id] = n
+            elif n == 0:
+                del self._pin_counts[s.stage_id]
+                self._pinned_complete.discard(s.stage_id)
+                self._teardown_stage(s)
+            for p in s.parents:
+                visit(p)
+
+        visit(stage)
+
+    def _teardown_stage(self, stage) -> None:
+        """Unregister one stage's shuffle everywhere and drop its engine
+        state (shared by job teardown and unpin)."""
+        handle = self._handles.pop(stage.stage_id, None)
+        self._stages.pop(stage.stage_id, None)
+        self._owners.pop(stage.stage_id, None)
+        if handle is None:
+            return
+        self._recovered = {k for k in self._recovered
+                           if k[0] != handle.shuffle_id}
+        with self._mesh_lock:
+            self._mesh_cache.pop(handle.shuffle_id, None)
+        self._dist_owner.pop(handle.shuffle_id, None)
+        self.driver.unregisterShuffle(handle.shuffle_id)
+        # executor-side too: drops the resolver's spill data and the
+        # memoized driver table, not just the driver entry — else every
+        # job leaks its full shuffle dataset
+        for ex in self._live():
+            try:
+                self._unregister_on(ex, handle.shuffle_id)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort; a
+                # dying executor must not mask the job's real outcome
+                log.warning("cleanup of shuffle %d failed on an executor",
+                            handle.shuffle_id, exc_info=True)
 
     def accumulator(self, name: str, zero=0) -> "shared_vars.Accumulator":
         """Create a driver-owned counter tasks can ``add`` to (Spark's
@@ -362,28 +440,13 @@ class DAGEngine:
                     if self._gen_of_stage.get(s.stage_id) == job_gen:
                         del self._gen_of_stage[s.stage_id]
             for stage in registered:
-                handle = self._handles.pop(stage.stage_id, None)
-                self._stages.pop(stage.stage_id, None)
-                self._owners.pop(stage.stage_id, None)
-                if handle is not None:
-                    self._recovered = {k for k in self._recovered
-                                       if k[0] != handle.shuffle_id}
-                    with self._mesh_lock:
-                        self._mesh_cache.pop(handle.shuffle_id, None)
-                    self._dist_owner.pop(handle.shuffle_id, None)
-                    self.driver.unregisterShuffle(handle.shuffle_id)
-                    # executor-side too: drops the resolver's spill data and
-                    # the memoized driver table, not just the driver entry —
-                    # else every job leaks its full shuffle dataset
-                    for ex in self._live():
-                        try:
-                            self._unregister_on(ex, handle.shuffle_id)
-                        except Exception:  # noqa: BLE001 — cleanup is
-                            # best-effort; a dying executor must not mask
-                            # the job's real outcome
-                            log.warning("cleanup of shuffle %d failed on an "
-                                        "executor", handle.shuffle_id,
-                                        exc_info=True)
+                # a pinned stage that COMPLETED keeps its shuffle for
+                # later jobs (rdd.persist); one that failed mid-run tears
+                # down normally and re-registers on the next action
+                if (stage.stage_id in self._pin_counts
+                        and stage.stage_id in self._pinned_complete):
+                    continue
+                self._teardown_stage(stage)
 
     # -- scheduling ------------------------------------------------------
 
@@ -393,10 +456,18 @@ class DAGEngine:
 
         def visit(stage):
             for p in stage.parents:
-                if p.stage_id not in seen:
-                    seen[p.stage_id] = p
-                    visit(p)
-                    order.append(p)
+                if p.stage_id in seen:
+                    continue
+                if (p.stage_id in self._pinned_complete
+                        and p.stage_id in self._handles):
+                    # pinned stage with live materialized outputs: skip it
+                    # AND its whole producing sub-DAG (Spark's skipped
+                    # stages); readers fetch the retained shuffle, and a
+                    # lost output recovers via stage retry, not a re-run
+                    continue
+                seen[p.stage_id] = p
+                visit(p)
+                order.append(p)
         visit(final)
         return order
 
@@ -457,6 +528,8 @@ class DAGEngine:
                               stage=stage.stage_id, shuffle=shuffle_id,
                               tasks=stage.num_tasks):
             self._run_stage_tasks(stage)
+        if stage.stage_id in self._pin_counts:
+            self._pinned_complete.add(stage.stage_id)
 
     def _run_stage_tasks(self, stage) -> List[object]:
         """All of a stage's tasks, up to max_parallel_tasks in flight

@@ -99,7 +99,11 @@ def solve_item_factors(ratings_for_device: np.ndarray, user_factors: np.ndarray,
     ata = jnp.zeros((n_pad, k, k), jnp.float32)
     atr = jnp.zeros((n_pad, k), jnp.float32)
     R = len(ratings_for_device)
-    ch = _SOLVE_CHUNK
+    # bucket the chunk size like n_pad: tiny inputs (unit tests, sparse
+    # devices) must not each run a padded 1M-row outer-product — pow2
+    # bucketing keeps the compile count logarithmic while sizing the
+    # [CH, k, k] transient to the data
+    ch = min(_SOLVE_CHUNK, 1 << max(10, (max(R, 1) - 1).bit_length()))
     for lo in range(0, max(R, 1), ch):
         hi = min(lo + ch, R)
         pad = ch - (hi - lo)
@@ -174,6 +178,7 @@ def als_half_step(mesh: Mesh, cfg: ALSConfig, ratings: np.ndarray,
 
     received, rounds = chunked_exchange(mesh, axis_name, grouped, counts,
                                         quota=quota)
+    del grouped  # ~1x the dataset; the solves below only need `received`
 
     factors = np.zeros((num_out, cfg.rank), dtype=np.float32)
     for d in range(n):

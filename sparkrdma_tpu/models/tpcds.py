@@ -2,9 +2,9 @@
 
 BASELINE.md config #4's missing half: where ``models/join.py`` is one
 equi-join, real TPC-DS plans chain shuffles — q64/q95 join a skewed fact
-table against several dimension tables and aggregate
-(/root/reference/README.md:25-31 benchmarks exactly this class on Spark
-SQL). This model runs the canonical star shape
+table against several dimension tables and aggregate (the reference's
+published workloads are shuffle-bound Spark jobs of exactly this class,
+/root/reference/README.md:7-31). This model runs the canonical star shape
 
     fact  ⋈(key1) dim1  ⋈(key2) dim2  -> GROUP BY g -> (count, sum)
 

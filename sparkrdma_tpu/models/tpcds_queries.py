@@ -1,7 +1,10 @@
 """Actual TPC-DS q64 / q95 plan shapes (BASELINE.md config #4).
 
-The reference benchmarks real Spark SQL TPC-DS — its README names q64 and
-q95 as the shuffle-heavy winners (/root/reference/README.md:25-31). The
+The reference's workload class is shuffle-heavy Spark jobs (its README
+publishes TeraSort and PageRank results, /root/reference/README.md:7-31);
+BASELINE.md config #4 names Spark SQL TPC-DS q64/q95 as the
+multi-join shuffle stress for this build — q64 and q95 are the
+standard shuffle-heavy picks in TPC-DS benchmarking literature. The
 generic star in ``models/tpcds.py`` covers the *class*; this module
 expresses the two *named* plans:
 

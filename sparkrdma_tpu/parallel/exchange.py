@@ -536,13 +536,46 @@ def chunked_exchange(mesh: Mesh, axis_name: str, grouped: np.ndarray,
     acc = jax.device_put(
         np.zeros((n * cap_out,) + grouped.shape[1:], grouped.dtype),
         sharding)
+    # Bound dispatch run-ahead. On XLA:CPU a collective BLOCKS its worker
+    # thread inside the rendezvous (InProcessCommunicator); unbounded
+    # async dispatch lets fast device threads queue rounds ahead and fill
+    # the shared pool with executions parked at future-round rendezvous,
+    # starving some device of a thread for the CURRENT round — after 40s
+    # the rendezvous aborts the process ("Expected 8 ... only 7 arrived").
+    # Reproduced deterministically on a 1-core host at rehearsal scale:
+    # synchronized rounds run at ~0.1s/round, the first unsynchronized
+    # batch of rounds SIGABRTs. On TPU collectives run device-side (the
+    # host thread is not parked), so a deeper pipeline is safe and keeps
+    # dispatch off the critical path.
+    platform = next(iter(mesh.devices.flat)).platform
+    sync_every = 1 if platform == "cpu" else 8
     for r in range(num_rounds):
         acc = round_acc(grouped_d, counts_d, r, acc)
+        if (r + 1) % sync_every == 0:
+            jax.block_until_ready(acc)
     record_exchange(int(counts_host.sum()))
-    out = np.asarray(acc).reshape(n, cap_out, *grouped.shape[1:])
-    # copies, not views: under skew the padded base array is up to D x the
-    # real data, and callers (ALS) hold the results across whole solves
-    return [out[d][:int(recv_totals[d])].copy() for d in range(n)], num_rounds
+    # Epilogue peak control: pull ONE device's shard to the host at a
+    # time and free buffers as we go. Materializing the whole padded
+    # accumulator host-side while the device copy is still alive doubles
+    # the padded footprint (up to D x the real data under skew) — at
+    # rehearsal scale that is the difference between fitting the memory
+    # contract and an honest MemoryError under RLIMIT_AS.
+    del grouped_d, counts_d
+    shards = {s.index[0].start or 0: s for s in acc.addressable_shards}
+    results: list = []
+    if len(shards) == n:
+        for d in range(n):
+            host = np.asarray(shards[d * cap_out].data)
+            # copies, not views: under skew the padded shard is up to D x
+            # the real rows, and callers (ALS) hold results across solves
+            results.append(host[:int(recv_totals[d])].copy())
+            del host
+    else:  # multi-process mesh: only local shards are addressable —
+        # assemble the global array (callers at that scale stream)
+        out = np.asarray(acc).reshape(n, cap_out, *grouped.shape[1:])
+        del acc
+        results = [out[d][:int(recv_totals[d])].copy() for d in range(n)]
+    return results, num_rounds
 
 
 @functools.lru_cache(maxsize=64)

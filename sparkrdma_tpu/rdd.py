@@ -207,6 +207,16 @@ class _Shuffled:
     * ``group``   — reduce side yields (k, [v, ...])     (groupByKey)
     * ``reduce``  — map-side combine with ``merge``, reduce side merges
       partial aggregates: yields (k, merged)             (reduceByKey)
+    * ``combine`` — generalized aggregation (combineByKey): map side
+      seeds with ``create`` and folds values with ``merge_value``,
+      reduce side merges partial combiners with ``merge``
+
+    Routing: by key hash (default / ``part_fn``), or — for
+    partition-level moves where records are arbitrary objects, not
+    (k, v) pairs — ``route_task`` sends task t's whole output to
+    partition ``route_task(t)`` (union/coalesce), and ``route_index``
+    round-robins records by index (repartition; deterministic, so
+    recomputes and speculative attempts write identical bytes).
     """
 
     parent: object
@@ -214,6 +224,10 @@ class _Shuffled:
     mode: str = "records"
     merge: Optional[Callable] = None
     part_fn: Optional[Callable[[object], int]] = None  # default hash%P
+    create: Optional[Callable] = None          # combine: createCombiner
+    merge_value: Optional[Callable] = None     # combine: mergeValue
+    route_task: Optional[Callable[[int], int]] = None
+    route_index: bool = False
 
     def num_partitions(self) -> int:
         return self.parts
@@ -222,6 +236,58 @@ class _Shuffled:
         if self.part_fn is not None:
             return self.part_fn(key)
         return portable_hash(key) % self.parts
+
+
+@dataclass
+class _Union:
+    """Concatenation of several lineages: partitions are the sides'
+    partitions in order. Compiles narrow (task t delegates to one side's
+    builder) when every side's chain is boundary-free; otherwise each
+    side becomes one identity-routed shuffle into the union's partition
+    space (Spark's union is narrow always, but its tasks can read any
+    parent partition — this engine's co-partitioning contract trades
+    that for one exchange, which under a mesh rides ICI anyway)."""
+
+    sides: List[object]
+
+    def num_partitions(self) -> int:
+        return sum(s.num_partitions() for s in self.sides)
+
+
+@dataclass
+class _Coalesce:
+    """Narrow partition-count reduction: new partition i reads parent
+    partitions [i*P//n, (i+1)*P//n) — Spark's coalesce(shuffle=False)
+    fan-in. Falls back to an identity-routed shuffle when a boundary
+    sits upstream (task t can only read parent partition t here)."""
+
+    parent: object
+    n: int
+
+    def num_partitions(self) -> int:
+        return self.n
+
+
+class _Cached:
+    """persist()/cache(): materializes the parent lineage ONCE as a
+    pinned identity shuffle — map task t writes parent partition t's
+    records to partition t, and the engine keeps the shuffle registered
+    past job teardown (engine.pin), so later actions SKIP the whole
+    upstream DAG and read the retained outputs from any executor.
+
+    This is Spark's actual cache-interaction machinery re-based on the
+    shuffle layer: skipped stages + shuffle files that outlive the job,
+    with recovery for free — an executor loss surfaces as FetchFailed
+    and stage retry recomputes the lost maps from ``task_fn``'s captured
+    lineage (true lineage recovery through a cached RDD, exercised in
+    test_rdd.py)."""
+
+    def __init__(self, parent):
+        self.parent = parent
+        self._stage = None  # built once, reused across actions
+
+    def num_partitions(self) -> int:
+        return self.parent.num_partitions()
 
 
 @dataclass
@@ -321,6 +387,91 @@ class RDD:
                 .map_partitions(lambda it: ((k, v) for (k, _r), v in it))
                 .reduce_by_key(f, parts))
 
+    def combine_by_key(self, create_combiner, merge_value, merge_combiners,
+                       num_partitions: Optional[int] = None) -> "RDD":
+        """The general aggregation primitive (Spark's combineByKey):
+        ``create_combiner(v) -> C`` seeds a key's combiner map-side,
+        ``merge_value(C, v) -> C`` folds further values map-side, and
+        ``merge_combiners(C, C) -> C`` merges partial combiners
+        reduce-side — shuffle bytes scale with distinct keys, and the
+        value and combiner types may differ (the part reduceByKey can't
+        express)."""
+        return RDD(self._ctx, _Shuffled(
+            self._node, self._parts(num_partitions), mode="combine",
+            merge=merge_combiners, create=create_combiner,
+            merge_value=merge_value))
+
+    def aggregate_by_key(self, zero, seq_func, comb_func,
+                         num_partitions: Optional[int] = None) -> "RDD":
+        """Aggregate values per key starting from ``zero`` (Spark's
+        aggregateByKey): ``seq_func(acc, v)`` folds map-side,
+        ``comb_func(acc, acc)`` merges partials reduce-side. ``zero`` is
+        deep-copied per key so a mutable zero ([], {}) is safe to mutate
+        in ``seq_func`` — each key gets its own accumulator."""
+        import copy
+        return self.combine_by_key(
+            lambda v, _z=zero, _s=seq_func: _s(copy.deepcopy(_z), v),
+            seq_func, comb_func, num_partitions)
+
+    def fold_by_key(self, zero, f,
+                    num_partitions: Optional[int] = None) -> "RDD":
+        return self.aggregate_by_key(zero, f, f, num_partitions)
+
+    def union(self, *others: "RDD") -> "RDD":
+        """Concatenate this RDD with ``others`` (partitions in argument
+        order; nested unions flatten, so chained unions don't deepen the
+        plan)."""
+        nodes: list = []
+        for r in (self, *others):
+            if isinstance(r._node, _Union):
+                nodes.extend(r._node.sides)
+            else:
+                nodes.append(r._node)
+        return RDD(self._ctx, _Union(nodes))
+
+    def coalesce(self, num_partitions: int, shuffle: bool = False) -> "RDD":
+        """Reduce the partition count without a shuffle (new partition i
+        absorbs a contiguous range of old ones); ``shuffle=True``
+        redistributes records round-robin instead — the only way to
+        GROW the count or rebalance skewed partitions."""
+        n = self._parts(num_partitions)
+        if shuffle:
+            return RDD(self._ctx, _Shuffled(self._node, n,
+                                            route_index=True))
+        return RDD(self._ctx,
+                   _Coalesce(self._node,
+                             min(n, self._node.num_partitions())))
+
+    def repartition(self, num_partitions: int) -> "RDD":
+        return self.coalesce(num_partitions, shuffle=True)
+
+    def persist(self) -> "RDD":
+        """Materialize this lineage once and keep it: the first action
+        runs the upstream DAG and pins its output shuffle (engine.pin);
+        every later action skips the upstream stages and reads the
+        retained partitions. Executor loss recomputes only the lost
+        partitions from lineage via the ordinary FetchFailed stage
+        retry. In-place like Spark's persist: marks THIS RDD object and
+        returns it; RDDs derived afterwards read through the cache."""
+        if not isinstance(self._node, _Cached):
+            self._node = _Cached(self._node)
+        return self
+
+    cache = persist
+
+    def unpersist(self) -> "RDD":
+        """Release the pinned shuffle (and its pinned ancestors) now;
+        later actions recompute from lineage."""
+        if isinstance(self._node, _Cached):
+            if self._node._stage is not None:
+                self._ctx.engine.unpin(self._node._stage)
+            self._node = self._node.parent
+        return self
+
+    @property
+    def is_cached(self) -> bool:
+        return isinstance(self._node, _Cached)
+
     def sort_by_key(self, num_partitions: Optional[int] = None,
                     ascending: bool = True, sample_size: int = 512) -> "RDD":
         """Global sort: a sampling pass picks P-1 range splitters (Spark's
@@ -396,13 +547,15 @@ class RDD:
         return out[:n]
 
     def materialize(self) -> "RDD":
-        """Evaluate once, return an RDD over the results (the cache()
-        role). Partition data collects to the driver and redistributes
-        through the broadcast plane, so later actions skip the whole
-        upstream lineage — recovery-safe (the driver owns the bytes;
-        executor loss costs nothing) at the price of driver memory, like
-        a collect + parallelize that keeps partitioning. Use before
-        multi-action reuse or sort_by_key's extra sampling pass."""
+        """Evaluate once, return an RDD over the results, driver-held.
+        Partition data collects to the driver and redistributes through
+        the broadcast plane, so later actions skip the whole upstream
+        lineage — recovery-safe (the driver owns the bytes; executor
+        loss costs nothing) at the price of driver memory, like a
+        collect + parallelize that keeps partitioning. Prefer
+        :meth:`persist` for large data: it keeps partitions on the
+        executors (pinned shuffle) and recovers via lineage instead of
+        driver RAM."""
         parts = self._run(lambda it, _t: list(it))
         return RDD(self._ctx,
                    _Source(self._ctx.engine.broadcast(parts), len(parts)))
@@ -486,6 +639,9 @@ class RDD:
     partitionBy = partition_by
     groupByKey = group_by_key
     reduceByKey = reduce_by_key
+    combineByKey = combine_by_key
+    aggregateByKey = aggregate_by_key
+    foldByKey = fold_by_key
     saveAsTextFile = save_as_text_file
 
     def sortByKey(self, ascending: bool = True,
@@ -591,12 +747,111 @@ def _chain(node, memo: dict, ctx: "EngineContext"):
 
     if isinstance(node, _Shuffled):
         stage = _shuffle_stage(node, memo, ctx)
+        # "combine" partial combiners merge reduce-side exactly like
+        # "reduce" partial aggregates — with merge_combiners as the merge
+        mode = "reduce" if node.mode == "combine" else node.mode
 
-        def build(tc, task_id, _mode=node.mode, _merge=node.merge):
+        def build(tc, task_id, _mode=mode, _merge=node.merge):
             return _reduce_side(tc.read(build._slot).readBatches(),
                                 _mode, _merge)
 
         build._slot = None  # wired by _wire_slots before the job runs
+        build._boundary = build
+        return build, [stage]
+
+    if isinstance(node, _Union):
+        compiled = [_chain(s, memo, ctx) for s in node.sides]
+        offs, off = [], 0
+        for s in node.sides:
+            offs.append(off)
+            off += s.num_partitions()
+        if all(b._boundary is None for b, _ in compiled):
+            # narrow: every side is source/narrow-only, so union task t
+            # just delegates to the owning side's builder
+            builders = [b for b, _ in compiled]
+
+            def build(tc, task_id, _bs=builders, _offs=offs):
+                import bisect
+                i = bisect.bisect_right(_offs, task_id) - 1
+                return _bs[i](tc, task_id - _offs[i])
+
+            build._boundary = None
+            return build, []
+        # some side has a shuffle upstream: each side becomes one
+        # identity-routed map stage into the union's partition space;
+        # slots are statically 0..k-1 (this build is the chain's only
+        # boundary, so its parents head the consuming stage's list)
+        stages = [
+            _shuffle_stage(_Shuffled(s, node.num_partitions(),
+                                     route_task=(lambda t, _o=o: _o + t)),
+                           memo, ctx)
+            for s, o in zip(node.sides, offs)]
+
+        def build(tc, task_id, _k=len(stages)):
+            def gen():
+                for i in range(_k):
+                    yield from _reduce_side(tc.read(i).readBatches(),
+                                            "records", None)
+            return gen()
+
+        # this IS a boundary (it reads shuffle slots): downstream
+        # narrow-vs-shuffle checks must see it as one. Slots are wired
+        # statically (0..k-1 matching the returned parents order), so
+        # _wire_slots has nothing to assign — the build carries no
+        # _slot/_lslot attributes.
+        build._boundary = build
+        return build, stages
+
+    if isinstance(node, _Coalesce):
+        inner, parents = _chain(node.parent, memo, ctx)
+        P, n = node.parent.num_partitions(), node.n
+        if inner._boundary is None:
+            def build(tc, task_id, _inner=inner, _P=P, _n=n):
+                lo, hi = task_id * _P // _n, (task_id + 1) * _P // _n
+
+                def gen():
+                    for pid in range(lo, hi):
+                        yield from _inner(tc, pid)
+                return gen()
+
+            build._boundary = None
+            return build, parents  # boundary-free => parents is []
+        # a shuffle upstream: this engine's tasks read only their own
+        # partition of a parent shuffle, so fan-in compiles to one
+        # identity-routed exchange instead. Memoized on the node: a
+        # coalesced RDD consumed twice in one job must compile ONE
+        # exchange stage (the _shuffle_stage memo keys on node identity)
+        sh = getattr(node, "_shuffled", None)
+        if sh is None:
+            sh = _Shuffled(node.parent, n,
+                           route_task=(lambda t, _P=P, _n=n: t * _n // _P))
+            node._shuffled = sh
+        return _chain(sh, memo, ctx)
+
+    if isinstance(node, _Cached):
+        stage = node._stage
+        if stage is None:
+            inner, parents = _chain(node.parent, memo, ctx)
+            _wire_slots(inner)
+            width = ctx.row_bytes
+            dep = ShuffleDependency(node.num_partitions(),
+                                    PartitionerSpec("modulo"),
+                                    row_payload_bytes=width)
+
+            def task_fn(tc, writer, task_id, _inner=inner, _w=width):
+                records = list(_inner(tc, task_id))
+                writer.write(_encode_blob(records, task_id, _w, task_id))
+
+            stage = MapStage(node.parent.num_partitions(), dep, task_fn,
+                             parents=parents)
+            node._stage = stage
+            ctx.engine.pin(stage)
+
+        def build(tc, task_id):
+            return _reduce_side(tc.read(build._slot).readBatches(),
+                                "records", None)
+
+        build._slot = None
         build._boundary = build
         return build, [stage]
 
@@ -649,11 +904,31 @@ def _shuffle_stage(node: _Shuffled, memo: dict, ctx: "EngineContext"):
                             row_payload_bytes=width)
 
     def task_fn(tc, writer, task_id, _inner=inner, _node=node, _w=width):
+        if _node.route_task is not None:
+            # partition-level move (union/coalesce): the whole task
+            # output — arbitrary records, not (k, v) pairs — lands in
+            # one destination partition
+            records = list(_inner(tc, task_id))
+            writer.write(_encode_blob(records, _node.route_task(task_id),
+                                      _w, task_id))
+            return
         buckets: dict = {}
-        if _node.mode == "reduce":
+        if _node.route_index:
+            # round-robin by record index (repartition): deterministic,
+            # so recomputes/speculative attempts write identical bytes
+            for i, x in enumerate(_inner(tc, task_id)):
+                buckets.setdefault(i % _node.parts, []).append(x)
+            items = buckets.items()
+        elif _node.mode == "reduce":
             for k, v in _inner(tc, task_id):
                 b = buckets.setdefault(_node.route(k), {})
                 b[k] = _node.merge(b[k], v) if k in b else v
+            items = ((p, list(d.items())) for p, d in buckets.items())
+        elif _node.mode == "combine":
+            for k, v in _inner(tc, task_id):
+                b = buckets.setdefault(_node.route(k), {})
+                b[k] = _node.merge_value(b[k], v) if k in b \
+                    else _node.create(v)
             items = ((p, list(d.items())) for p, d in buckets.items())
         else:
             for k, v in _inner(tc, task_id):

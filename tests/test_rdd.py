@@ -503,6 +503,183 @@ def test_materialize_caches_lineage(ctx):
     assert evals.value == 40  # lineage never re-ran
 
 
+def test_union_narrow_and_wide(ctx):
+    """union() of source RDDs is narrow (no shuffle stage); union with
+    a shuffled side routes through identity exchanges — same records
+    either way, partitions in argument order."""
+    a = ctx.parallelize(range(10), 3)
+    b = ctx.parallelize(range(100, 106), 2)
+    u = a.union(b)
+    assert u.num_partitions == 5
+    assert sorted(u.collect()) == sorted(list(range(10))
+                                         + list(range(100, 106)))
+    # chained unions flatten, order preserved
+    c = ctx.parallelize([999], 1)
+    assert a.union(b).union(c).collect()[-1] == 999
+    # wide side: a reduce_by_key result unioned with a plain source
+    pairs = ctx.parallelize([(i % 3, 1) for i in range(30)], 3) \
+        .reduce_by_key(lambda x, y: x + y, 3)
+    extra = ctx.parallelize([(9, 99)], 1)
+    got = sorted(pairs.union(extra).collect())
+    assert got == [(0, 10), (1, 10), (2, 10), (9, 99)]
+
+
+def test_coalesce_narrow_contiguous_and_shuffle_grow(ctx):
+    rdd = ctx.parallelize(range(12), 6)
+    small = rdd.coalesce(2)
+    assert small.num_partitions == 2
+    parts = small.glom().collect()
+    # narrow fan-in: each new partition is a contiguous range of old ones
+    assert [sorted(p) for p in parts] == [[0, 1, 2, 3, 4, 5],
+                                          [6, 7, 8, 9, 10, 11]]
+    # coalesce never grows without shuffle=True
+    assert rdd.coalesce(64).num_partitions == 6
+    grown = rdd.coalesce(9, shuffle=True)
+    assert grown.num_partitions == 9
+    assert sorted(grown.collect()) == list(range(12))
+    # repartition balances a skewed layout
+    skewed = ctx.parallelize(range(100), 1).repartition(4)
+    sizes = [len(p) for p in skewed.glom().collect()]
+    assert sorted(skewed.collect()) == list(range(100))
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_wide_union_composes_downstream(ctx):
+    """A wide union is a real chain boundary: coalescing it, unioning it
+    again, or shuffling above it must compile correctly (regression —
+    the wide-union build once claimed to be boundary-free and broke
+    every downstream narrow-vs-shuffle decision)."""
+    pairs = ctx.parallelize([(i % 3, 1) for i in range(30)], 3) \
+        .reduce_by_key(lambda x, y: x + y, 3)
+    extra = ctx.parallelize([(9, 99)], 1)
+    u = pairs.union(extra)
+    assert sorted(u.coalesce(2).collect()) == \
+        [(0, 10), (1, 10), (2, 10), (9, 99)]
+    more = ctx.parallelize([(7, 7)], 1)
+    assert sorted(u.map(lambda kv: kv).union(more).collect()) == \
+        [(0, 10), (1, 10), (2, 10), (7, 7), (9, 99)]
+    assert dict(u.reduce_by_key(lambda a, b: a + b, 2).collect()) == \
+        {0: 10, 1: 10, 2: 10, 9: 99}
+
+
+def test_coalesce_below_shuffle_boundary(ctx):
+    """coalesce after a wide op compiles to an identity-routed exchange
+    (tasks here read only their own partition) — records survive and
+    land in the right fan-in partition."""
+    counts = (ctx.parallelize([(i % 6, 1) for i in range(60)], 4)
+              .reduce_by_key(lambda a, b: a + b, 6)
+              .coalesce(2))
+    assert counts.num_partitions == 2
+    assert sorted(counts.collect()) == [(k, 10) for k in range(6)]
+
+
+def test_aggregate_by_key_mutable_zero(ctx):
+    """aggregateByKey with a mutable zero ([]): each key must get its
+    own accumulator (deep-copied), and value/combiner types differ."""
+    pairs = [(i % 3, i) for i in range(12)]
+    got = dict(ctx.parallelize(pairs, 4)
+               .aggregate_by_key([], lambda acc, v: acc + [v],
+                                 lambda a, b: a + b, 2)
+               .map_values(sorted)
+               .collect())
+    assert got == {k: sorted(v for i, v in pairs if i == k)
+                   for k in range(3)}
+
+
+def test_combine_by_key_mean(ctx):
+    """The classic combineByKey use: per-key mean via (sum, count)
+    combiners — a shape reduceByKey cannot express."""
+    pairs = [("a", 2.0), ("b", 4.0), ("a", 4.0), ("b", 6.0), ("a", 6.0)]
+    sums = dict(ctx.parallelize(pairs, 3)
+                .combine_by_key(lambda v: (v, 1),
+                                lambda c, v: (c[0] + v, c[1] + 1),
+                                lambda c1, c2: (c1[0] + c2[0],
+                                                c1[1] + c2[1]), 2)
+                .map_values(lambda c: c[0] / c[1])
+                .collect())
+    assert sums == {"a": 4.0, "b": 5.0}
+    folded = dict(ctx.parallelize([(1, 2), (1, 3), (2, 5)], 2)
+                  .fold_by_key(0, lambda a, b: a + b, 2).collect())
+    assert folded == {1: 5, 2: 5}
+
+
+def test_persist_skips_upstream_stages(ctx):
+    """persist(): the first action materializes the pinned shuffle; later
+    actions SKIP the whole upstream DAG (accumulator proves the map fn
+    never re-runs — Spark's skipped-stages semantics); unpersist()
+    releases it and lineage runs again."""
+    evals = ctx.accumulator("evals")
+
+    def counting(x, _a=evals):
+        _a.add(1)
+        return (x % 4, x)
+
+    cached = ctx.parallelize(range(40), 4).map(counting).persist()
+    assert cached.is_cached
+    assert sorted(cached.values().collect()) == list(range(40))
+    assert evals.value == 40
+    # second + third actions: upstream skipped entirely
+    assert cached.count() == 40
+    assert cached.reduce_by_key(lambda a, b: a + b, 2).count() == 4
+    assert evals.value == 40
+    # engine retains exactly the pinned stage's shuffle
+    assert len(ctx.engine._handles) == 1
+    cached.unpersist()
+    assert not cached.is_cached
+    assert len(ctx.engine._handles) == 0
+    assert cached.count() == 40
+    assert evals.value == 80  # lineage re-ran after unpersist
+
+
+def test_persist_recovery_through_cached_rdd(tmp_path):
+    """Kill the executor PROCESS holding part of a cached RDD between
+    actions: the next action's read hits FetchFailed and stage retry
+    recomputes ONLY the lost partitions from the pinned stage's captured
+    lineage — true lineage recovery through a cached RDD."""
+    import subprocess
+    import sys
+    import time
+
+    from test_remote_engine import _WORKER, CONF
+    from sparkrdma_tpu.shuffle.spark_compat import SparkCompatShuffleManager
+    from sparkrdma_tpu.tasks import remote_executors
+
+    driver = SparkCompatShuffleManager(CONF, isDriver=True)
+    host, port = driver.driverAddr
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, host, str(port), f"w{i}",
+         str(tmp_path / f"w{i}")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    remotes = []
+    try:
+        remotes = remote_executors(driver, CONF, expect=2, timeout=30)
+        ctx = EngineContext(DAGEngine(driver, remotes))
+        cached = (ctx.parallelize([(i % 5, 1) for i in range(200)], 4)
+                  .reduce_by_key(lambda a, b: a + b, 4)
+                  .persist())
+        assert dict(cached.collect()) == {k: 40 for k in range(5)}
+
+        victim = remotes[1]
+        victim_proc = procs[int(victim.manager_id.executor_id.executor[1:])]
+        victim_proc.kill()
+        victim_proc.wait()
+        driver.native.driver.remove_member(victim.manager_id)
+        time.sleep(0.2)
+
+        # both a plain replay and a downstream wide op must survive
+        assert dict(cached.collect()) == {k: 40 for k in range(5)}
+        assert dict(cached.map_values(lambda v: v * 2)
+                    .reduce_by_key(lambda a, b: a + b, 2)
+                    .collect()) == {k: 80 for k in range(5)}
+    finally:
+        for p in procs:
+            p.kill()
+        for r in remotes:
+            r.stop()
+        driver.stop()
+
+
 def test_rdd_pagerank_matches_oracle(ctx):
     """PageRank written in ~15 lines of RDD code (the classic Spark
     program, and BASELINE config #3's shape) agrees with the in-tree
