@@ -79,6 +79,16 @@ _KEYS = [
     # --- flow control (reference: recv/send queue depths, swFlowControl 61-68)
     _Key("send_queue_depth", 4096, "int", 16, 1 << 20,
          doc="Outstanding async fetch budget per peer (ref sendQueueDepth=4096)."),
+    _Key("read_ahead_depth", 0, "int", 0, 1 << 20,
+         doc="Grouped fetches kept in flight per peer connection; 0 = auto "
+             "(send_queue_depth // cores, the reference's division, "
+             "RdmaShuffleFetcherIterator.scala:82-83); 1 = fully sequential "
+             "fetch (pre-pipelining behavior, the regression escape hatch)."),
+    _Key("pre_warm_connections", True, "bool",
+         doc="Dial peer control connections the moment an announce names "
+             "them (ref pre-connects requestor channels on announce, "
+             "RdmaShuffleManager.scala:117-126) so a shuffle's first fetch "
+             "pays no handshake latency."),
     _Key("recv_queue_depth", 256, "int", 4, 1 << 16,
          doc="Control-plane inflight message budget (ref recvQueueDepth=256)."),
     _Key("rpc_msg_size", "4k", "bytes", 256, 1 << 24,
@@ -198,6 +208,17 @@ class TpuShuffleConf:
         if name in _KEY_MAP:
             return self._get(name)
         raise AttributeError(f"unknown config key: {name}")
+
+    def resolved_read_ahead_depth(self) -> int:
+        """The effective per-peer read-ahead window: the configured depth,
+        or (when 0/auto) the reference's ``sendQueueDepth / cores`` split
+        (RdmaShuffleFetcherIterator.scala:82-83), floored at 1."""
+        import os
+
+        depth = self.read_ahead_depth
+        if depth <= 0:
+            depth = self.send_queue_depth // max(1, os.cpu_count() or 1)
+        return max(1, depth)
 
     def prealloc_spec(self) -> Dict[int, int]:
         """Parse 'size:count,size:count' into {bytes: count}.

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.utils.compat import shard_map
+
 from sparkrdma_tpu.ops.partition import hash_partition
 from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
 
@@ -73,7 +75,7 @@ def make_join_step(mesh: Mesh, axis_name: str, cfg: JoinConfig,
                 total, overflowed)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(spec, spec),
                        out_specs=(spec, spec, spec))
     def step(left, right):

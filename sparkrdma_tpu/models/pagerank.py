@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.utils.compat import shard_map
+
 from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
 
 
@@ -62,7 +64,7 @@ def make_pagerank_step(mesh: Mesh, axis_name: str, cfg: PageRankConfig,
     spec = P(axis_name)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(spec, spec, spec),
                        out_specs=(spec, spec))
     def step(edges, ranks, out_deg):

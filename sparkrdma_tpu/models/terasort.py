@@ -34,6 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.utils.compat import shard_map
+
 from sparkrdma_tpu.ops.partition import uniform_splitters
 from sparkrdma_tpu.parallel.exchange import ragged_exchange_shard, resolve_impl
 
@@ -124,15 +126,13 @@ def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
     # pallas interpret-mode outputs confuse the vma checker when mixed
     # with collectives; disable it ONLY for the ring transports (same
     # rule as make_chunked_exchange / make_shuffle_exchange)
-    shard_kwargs = dict(jax_mesh=mesh, in_specs=(spec,),
+    shard_kwargs = dict(mesh=mesh, in_specs=(spec,),
                         out_specs=(spec, spec, spec))
-    shard_kwargs = {("mesh" if k == "jax_mesh" else k): v
-                    for k, v in shard_kwargs.items()}
     if impl in ("ring", "ring_interpret"):
         shard_kwargs["check_vma"] = False
 
     @jax.jit
-    @functools.partial(jax.shard_map, **shard_kwargs)
+    @functools.partial(shard_map, **shard_kwargs)
     def step(rows):
         keys = rows[:, 0]
         if n == 1:

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.utils.compat import shard_map
+
 from sparkrdma_tpu.ops.partition import hash_partition
 from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
 
@@ -128,7 +130,7 @@ def make_tpcds_step(mesh: Mesh, axis_name: str, cfg: TpcdsConfig,
         return jnp.take(dattr_s, idx), found
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(spec, spec, spec),
                        out_specs=(spec, spec, spec))
     def step(fact, dim1, dim2):

@@ -57,6 +57,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.utils.compat import shard_map
+
 from sparkrdma_tpu.ops.partition import hash_partition
 from sparkrdma_tpu.parallel.exchange import resolve_impl, shuffle_shard
 
@@ -231,7 +233,7 @@ def make_q95_step(mesh: Mesh, axis_name: str, cfg: Q95Config,
         return (f_recv.at[:, 7].set(flags), f_valid, of_d | of_f)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(spec,) * 5, out_specs=(spec, spec))
     def step(ws, wr, date, addr, site):
         # working rows: [order, wh, date, addr, site, cost, profit, flags]
@@ -420,7 +422,7 @@ def make_q64_step(mesh: Mesh, axis_name: str, cfg: Q64Config,
     cap_cs = cfg.cs_rows_per_device * cfg.out_factor
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(spec,) * 5, out_specs=(spec, spec))
     def step(ss, sr, cs, cr, date):
         all_valid = jnp.ones  # shorthand

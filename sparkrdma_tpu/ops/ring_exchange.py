@@ -39,6 +39,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sparkrdma_tpu.utils.compat import (
+    shape_dtype_struct,
+    shard_map,
+    tpu_compiler_params,
+)
+
 
 def _ring_kernel(axis_name: str, num_devices: int, use_barrier: bool,
                  blocks_ref, out_ref, transit, send_sem, recv_sem, bar_dir):
@@ -120,8 +126,8 @@ def ring_all_to_all_shard(blocks: jnp.ndarray, axis_name: str,
                                not interpret)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(blocks.shape, blocks.dtype,
-                                       vma=frozenset({axis_name})),
+        out_shape=shape_dtype_struct(blocks.shape, blocks.dtype,
+                                     vma=frozenset({axis_name})),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -134,7 +140,7 @@ def ring_all_to_all_shard(blocks: jnp.ndarray, axis_name: str,
         # entry rendezvous; interpret mode has no barrier (and Mosaic
         # rejects the id when no barrier semaphore is referenced)
         compiler_params=(None if interpret
-                         else pltpu.CompilerParams(collective_id=7)),
+                         else tpu_compiler_params(collective_id=7)),
         interpret=interpret,
     )(blocks)
 
@@ -146,7 +152,7 @@ def make_ring_all_to_all(mesh: Mesh, axis_name: str,
     n = mesh.shape[axis_name]
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=P(axis_name), out_specs=P(axis_name),
                        check_vma=False)
     def a2a(x):

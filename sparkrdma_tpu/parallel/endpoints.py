@@ -24,6 +24,7 @@ role the (address, lkey) pair plays in the reference.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from typing import Dict, List, Optional, Protocol, Tuple
@@ -36,6 +37,7 @@ from sparkrdma_tpu.parallel.transport import (
     ConnectionCache,
     ControlServer,
     TransportError,
+    await_response,
 )
 from sparkrdma_tpu.shuffle.map_output import DriverTable, MapTaskOutput
 from sparkrdma_tpu.utils.ids import ShuffleManagerId
@@ -61,6 +63,46 @@ def _codec_aad(req, flags: int) -> bytes:
     import struct
 
     return struct.pack("<qiI", req.req_id, req.shuffle_id, flags)
+
+
+class AsyncFetch:
+    """Completion handle for a pipelined fetch issued via
+    ``Connection.request_async``: the request is already on the wire;
+    ``result()`` finishes it on the CALLING thread (decode, credit
+    bookkeeping, status handling) so connection reader threads never
+    carry per-fetch CPU work. ``wire_done_s`` is stamped
+    (``time.monotonic``) the instant the raw response lands — the
+    issue→wire→complete boundary the fetcher's trace spans use."""
+
+    __slots__ = ("wire_done_s", "_fut", "_default_timeout_s", "_complete")
+
+    def __init__(self, fut, default_timeout_s: float, complete):
+        self.wire_done_s: Optional[float] = None
+        self._fut = fut
+        self._default_timeout_s = default_timeout_s
+        self._complete = complete
+        fut.add_done_callback(self._stamp)
+
+    def _stamp(self, _fut) -> None:
+        self.wire_done_s = time.monotonic()
+
+    def done(self) -> bool:
+        """True once the raw response (or failure) has landed; a
+        subsequent ``result()`` will not block on the wire."""
+        return self._fut.done()
+
+    def result(self, timeout: Optional[float] = None):
+        tmo = self._default_timeout_s if timeout is None else timeout
+        return self._complete(await_response(self._fut, tmo))
+
+    def cancel(self) -> None:
+        """Abandon the request: cancelling a still-pending future fires
+        the connection's cleanup callback, reclaiming its send-budget
+        slot (an abandoned-but-never-answered request must not hold a
+        slot forever). No-op once the response has landed — the
+        done-callback already released the slot, and the credit
+        bookkeeping's orphan path owns any landed-late response."""
+        self._fut.cancel()
 
 
 class ShuffleDataSource(Protocol):
@@ -515,6 +557,23 @@ class ExecutorEndpoint:
         self._fetch_credit_pending: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()
         self._fetch_credit_lock = threading.Lock()
+        # connection pre-warming (reference pre-connects requestor
+        # channels the moment a peer announces,
+        # RdmaShuffleManager.scala:117-126): addresses this endpoint has
+        # already dialed (or is dialing) ahead of any fetch
+        self._prewarmed: set = set()
+        self._prewarm_lock = threading.Lock()
+        self._stopping = False
+        # CreditReport sends ride a dedicated worker (started on first
+        # use): the receipt-time settle runs on connection READER
+        # threads, and a blocking sendall there — both TCP directions
+        # full under sustained load — would stop the reader from
+        # draining responses, stalling every in-flight fetch until
+        # timeout instead of making progress
+        self._credit_q: "queue.Queue" = queue.Queue()
+        self._credit_worker: Optional[threading.Thread] = None
+        self._credit_worker_lock = threading.Lock()
+        self.prewarm_dials = 0  # audit: successful ahead-of-fetch dials
 
     # -- lifecycle -------------------------------------------------------
 
@@ -526,12 +585,18 @@ class ExecutorEndpoint:
         return self._clients.get(*self._driver_addr)
 
     def stop(self) -> None:
+        # flagged BEFORE close_all so a racing prewarm dial either sees
+        # it (and closes its own connection) or inserts into the cache
+        # before close_all drains it — no window where a fresh dial can
+        # outlive this teardown
+        self._stopping = True
         if self._task_pool is not None:
             self._task_pool.shutdown(wait=False, cancel_futures=True)
         if self._serve_pool is not None:
             self._serve_pool.shutdown(wait=False, cancel_futures=True)
         self._clients.close_all()
         self.server.stop()
+        self._credit_q.put(None)  # ends the credit worker, if started
 
     # -- membership ------------------------------------------------------
 
@@ -572,6 +637,61 @@ class ExecutorEndpoint:
             raise DeadExecutorError(f"executor slot {index} was lost")
         return m
 
+    # -- connection pre-warming ------------------------------------------
+
+    def _prewarm_peers(self) -> None:
+        """Dial every newly-announced peer in the background so the first
+        fetch of a shuffle pays zero handshake latency (the reference
+        pre-connects on announce, RdmaShuffleManager.scala:117-126).
+
+        Runs OFF the announce reader thread — dialing is bounded by the
+        existing connect budget (``max_connection_attempts`` x
+        ``connect_timeout_ms``, java/RdmaNode.java:283-353) and must not
+        stall announce processing behind a slow peer. Warms the control
+        port always, plus the native block-server port when the fetch
+        path would actually use it (no wire compression/codec)."""
+        with self._members_lock:
+            members = list(self._members)
+        warm_block = self._codec is None and not self.conf.wire_compress
+        addrs = []
+        for m in members:
+            if m == TOMBSTONE or m == self.manager_id:
+                continue
+            addrs.append((m.rpc_host, m.rpc_port))
+            if warm_block and m.block_port:
+                addrs.append((m.rpc_host, m.block_port))
+        with self._prewarm_lock:
+            todo = [a for a in addrs if a not in self._prewarmed]
+            self._prewarmed.update(todo)
+        if not todo:
+            return
+        threading.Thread(target=self._prewarm_dial, args=(todo,),
+                         daemon=True,
+                         name=f"prewarm-"
+                              f"{self.manager_id.executor_id.executor}"
+                         ).start()
+
+    def _prewarm_dial(self, addrs) -> None:
+        for host, port in addrs:
+            if self._stopping or self.server.stopped:
+                return
+            try:
+                conn = self._clients.get(host, port)
+                if self._stopping:
+                    # stop() raced the dial: either close_all() drained
+                    # the cache after our insert (conn already closed),
+                    # or it ran before — then this close is ours to do,
+                    # or the socket + reader thread outlive the endpoint
+                    conn.close()
+                    return
+                self.prewarm_dials += 1
+            except TransportError as e:
+                # un-mark so the next announce retries; the lazy fetch
+                # path stays the correctness backstop either way
+                with self._prewarm_lock:
+                    self._prewarmed.discard((host, port))
+                log.debug("prewarm of %s:%s failed: %s", host, port, e)
+
     # -- serving peers ---------------------------------------------------
 
     def _handle(self, conn: Connection, msg: RpcMsg) -> Optional[RpcMsg]:
@@ -584,6 +704,8 @@ class ExecutorEndpoint:
                     self._announce_epoch = msg.epoch
                     self._members = list(msg.manager_ids)
             self._members_event.set()
+            if self.conf.pre_warm_connections:
+                self._prewarm_peers()
             return None
         if isinstance(msg, M.FetchOutputReq):
             return self._on_fetch_output(msg)
@@ -878,50 +1000,108 @@ class ExecutorEndpoint:
             self._table_cache.pop(shuffle_id, None)
             self._table_gen += 1
 
+    def fetch_output_range_async(self, peer: ShuffleManagerId,
+                                 shuffle_id: int, map_id: int, start: int,
+                                 end: int) -> AsyncFetch:
+        """Issue one block-location read without waiting for it: the
+        fetcher's read-ahead window keeps several of these in flight per
+        peer over the pipelined connection."""
+        conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        fut = conn.request_async(
+            M.FetchOutputReq(conn.next_req_id(), shuffle_id, map_id,
+                             start, end))
+
+        def complete(resp):
+            assert isinstance(resp, M.FetchOutputResp)
+            if resp.status != M.STATUS_OK:
+                raise TransportError(f"fetch_output status={resp.status}")
+            return MapTaskOutput.locations_from_range(resp.entries)
+
+        return AsyncFetch(fut, self.conf.connect_timeout_ms / 1000,
+                          complete)
+
     def fetch_output_range(self, peer: ShuffleManagerId, shuffle_id: int,
                            map_id: int, start: int, end: int):
-        conn = self._clients.get(peer.rpc_host, peer.rpc_port)
-        resp = conn.request(M.FetchOutputReq(conn.next_req_id(), shuffle_id,
-                                             map_id, start, end))
-        assert isinstance(resp, M.FetchOutputResp)
-        if resp.status != M.STATUS_OK:
-            raise TransportError(f"fetch_output status={resp.status}")
-        return MapTaskOutput.locations_from_range(resp.entries)
+        return self.fetch_output_range_async(peer, shuffle_id, map_id,
+                                             start, end).result()
 
-    def _credited_request(self, conn: Connection,
-                          req: "M.FetchBlocksReq", credited: bool) -> RpcMsg:
-        """``conn.request`` with receipt-credit accounting: on an OK
-        response, report the request's logical size so the server's
-        serving window replenishes (the server freed its copy the moment
-        we have ours). The pending entry is keyed by (conn, req_id) so a
-        response that arrives ORPHANED — our wait timed out but the
-        server's send succeeded — still gets its report from the
-        unsolicited-message path instead of leaking window forever.
-        Native block-server responses aren't credited (``credited=False``
-        there; that path has its own caps)."""
+    def _register_credit(self, conn: Connection,
+                         req: "M.FetchBlocksReq", credited: bool) -> bool:
+        """Receipt-credit accounting, issue half: remember the request's
+        logical size BEFORE it hits the wire. The pending entry is keyed
+        by (conn, req_id) so a response that arrives ORPHANED — our wait
+        timed out but the server's send succeeded — still gets its
+        report from the unsolicited-message path instead of leaking
+        window forever. Native block-server responses aren't credited
+        (``credited=False`` there; that path has its own caps)."""
         if not (credited and self.conf.sw_flow_control):
-            return conn.request(req)
+            return False
         total = sum(length for _, _, length in req.blocks)
         with self._fetch_credit_lock:
             self._fetch_credit_pending.setdefault(conn, {})[req.req_id] = \
                 total
-        try:
-            resp = conn.request(req)
-        except TransportError:
-            # conn is dead: no orphan will ever arrive, and the server
-            # releases on its own failed send
-            with self._fetch_credit_lock:
-                self._fetch_credit_pending.get(conn, {}).pop(req.req_id,
-                                                             None)
-            raise
+        return True
+
+    def _settle_credit(self, conn: Connection, req: "M.FetchBlocksReq",
+                       resp: RpcMsg) -> None:
+        """Receipt-credit accounting, completion half: on an OK response
+        report the logical size so the server's serving window
+        replenishes (the server freed its copy the moment we have
+        ours)."""
         with self._fetch_credit_lock:
             pending = self._fetch_credit_pending.get(conn, {}).pop(
                 req.req_id, None)
         if pending is not None and resp.status == M.STATUS_OK:
+            self._queue_credit_report(conn, pending)
+
+    def _queue_credit_report(self, conn: Connection, total: int) -> None:
+        """Hand a CreditReport send to the dedicated worker so the
+        callers — connection reader threads via the receipt-time settle
+        and orphan paths — can never block in ``sendall`` when both TCP
+        directions are full; a blocked reader would stop draining the
+        very responses whose receipt replenishes the window."""
+        if self._credit_worker is None:
+            with self._credit_worker_lock:
+                if self._credit_worker is None and not self._stopping:
+                    self._credit_worker = threading.Thread(
+                        target=self._credit_loop, daemon=True,
+                        name=f"credit-"
+                             f"{self.manager_id.executor_id.executor}")
+                    self._credit_worker.start()
+        self._credit_q.put((conn, total))
+
+    def _credit_loop(self) -> None:
+        while True:
+            item = self._credit_q.get()
+            if item is None:
+                return
+            conn, total = item
             try:
-                conn.send(M.CreditReport(pending))
+                conn.send(M.CreditReport(total))
             except TransportError:
                 pass  # conn died post-response; server releases on its own
+
+    def _drop_credit(self, conn: Connection,
+                     req: "M.FetchBlocksReq") -> None:
+        """The connection died mid-request: no orphan will ever arrive,
+        and the server releases on its own failed send."""
+        with self._fetch_credit_lock:
+            self._fetch_credit_pending.get(conn, {}).pop(req.req_id, None)
+
+    def _credited_request(self, conn: Connection,
+                          req: "M.FetchBlocksReq", credited: bool) -> RpcMsg:
+        """``conn.request`` with receipt-credit accounting (see
+        ``_register_credit``/``_settle_credit``). A TIMEOUT leaves the
+        pending entry in place on purpose — the orphan path owns it."""
+        registered = self._register_credit(conn, req, credited)
+        try:
+            resp = conn.request(req)
+        except TransportError:
+            if registered:
+                self._drop_credit(conn, req)
+            raise
+        if registered:
+            self._settle_credit(conn, req, resp)
         return resp
 
     def _on_orphan_blocks_resp(self, conn: Connection,
@@ -933,17 +1113,20 @@ class ExecutorEndpoint:
             total = self._fetch_credit_pending.get(conn, {}).pop(
                 msg.req_id, None)
         if total is not None and msg.status == M.STATUS_OK:
-            try:
-                conn.send(M.CreditReport(total))
-            except TransportError:
-                pass
+            self._queue_credit_report(conn, total)
 
-    def fetch_blocks(self, peer: ShuffleManagerId, shuffle_id: int,
-                     blocks) -> bytes:
-        # prefer the peer's native block server when advertised: same wire
-        # protocol, no Python on the serving side. The native server doesn't
-        # compress or wrap, so when wire compression or a wire codec is
-        # configured stay on the control path which does.
+    def fetch_blocks_async(self, peer: ShuffleManagerId, shuffle_id: int,
+                           blocks) -> AsyncFetch:
+        """Issue one grouped data fetch without waiting for it — the
+        measured fetch fast path. The request multiplexes onto the shared
+        pipelined connection by req_id; the returned handle's
+        ``result()`` settles credits, handles the native-server size-cap
+        retry, and decodes, all on the calling (peer fetch) thread.
+
+        Prefers the peer's native block server when advertised: same wire
+        protocol, no Python on the serving side. The native server
+        doesn't compress or wrap, so when wire compression or a wire
+        codec is configured stay on the control path which does."""
         blocks = list(blocks)
         port = (peer.block_port
                 if peer.block_port and not self.conf.wire_compress
@@ -951,22 +1134,59 @@ class ExecutorEndpoint:
                 else peer.rpc_port)
         conn = self._clients.get(peer.rpc_host, port)
         req = M.FetchBlocksReq(conn.next_req_id(), shuffle_id, blocks)
-        resp = self._credited_request(conn, req,
-                                      credited=port == peer.rpc_port)
-        assert isinstance(resp, M.FetchBlocksResp)
-        if resp.status == M.STATUS_BAD_RANGE and port != peer.rpc_port:
-            # only the size-cap case is worth retrying: the native server
-            # enforces a stricter response-size cap than the Python path.
-            # Other statuses (unknown token/shuffle) would fail identically
-            # on the control connection — retrying would just double the
-            # failure-path load during an executor-loss storm
-            port = peer.rpc_port
-            conn = self._clients.get(peer.rpc_host, port)
-            req = M.FetchBlocksReq(conn.next_req_id(), shuffle_id, blocks)
-            resp = self._credited_request(conn, req, credited=True)
+        registered = self._register_credit(conn, req,
+                                           credited=port == peer.rpc_port)
+        fut = conn.request_async(req)
+        if registered:
+            # CreditReport ON RECEIPT (reader thread), not at completion:
+            # a read-ahead window completes oldest-issued-first, but the
+            # server may serve out of order — landed-but-uncompleted
+            # responses must replenish the window immediately or a parked
+            # older response could deadlock against its own window until
+            # the park timeout. (The orphan path already reports from the
+            # reader thread for the same reason.)
+            def _on_wire(f) -> None:
+                if f.cancelled():
+                    return  # orphan path owns the pending entry
+                exc = f.exception()
+                if exc is not None:
+                    if isinstance(exc, TransportError):
+                        # dead connection: no orphan will ever arrive
+                        self._drop_credit(conn, req)
+                    return
+                self._settle_credit(conn, req, f.result())
+
+            fut.add_done_callback(_on_wire)
+
+        def complete(resp):
             assert isinstance(resp, M.FetchBlocksResp)
-        if resp.status != M.STATUS_OK:
-            raise TransportError(f"fetch_blocks status={resp.status}")
+            final_req = req
+            if resp.status == M.STATUS_BAD_RANGE and port != peer.rpc_port:
+                # only the size-cap case is worth retrying: the native
+                # server enforces a stricter response-size cap than the
+                # Python path. Other statuses (unknown token/shuffle)
+                # would fail identically on the control connection —
+                # retrying would just double the failure-path load during
+                # an executor-loss storm
+                rconn = self._clients.get(peer.rpc_host, peer.rpc_port)
+                final_req = M.FetchBlocksReq(rconn.next_req_id(),
+                                             shuffle_id, blocks)
+                resp = self._credited_request(rconn, final_req,
+                                              credited=True)
+                assert isinstance(resp, M.FetchBlocksResp)
+            if resp.status != M.STATUS_OK:
+                raise TransportError(f"fetch_blocks status={resp.status}")
+            return self._decode_blocks_resp(final_req, resp)
+
+        return AsyncFetch(fut, self.conf.connect_timeout_ms / 1000,
+                          complete)
+
+    def fetch_blocks(self, peer: ShuffleManagerId, shuffle_id: int,
+                     blocks) -> bytes:
+        return self.fetch_blocks_async(peer, shuffle_id, blocks).result()
+
+    def _decode_blocks_resp(self, req: "M.FetchBlocksReq",
+                            resp: "M.FetchBlocksResp") -> bytes:
         with self._wire_lock:
             self.wire_bytes_in += len(resp.data)
         data = resp.data

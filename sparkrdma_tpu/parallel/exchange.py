@@ -58,6 +58,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.utils.compat import shard_map
+
 # Host-side dispatch tally for the ICI data plane. Callers that launch a
 # collective exchange (mesh_service, models) record here so tests and the
 # engine can assert that a job's shuffle bytes actually crossed the mesh
@@ -348,7 +350,7 @@ def _native_compiles(mesh: Mesh, axis_name: str) -> Tuple[bool, str]:
     spec = P(axis_name)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * 4,
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,) * 4,
                        out_specs=spec)
     def probe(op, out, iof, sz):
         return lax.ragged_all_to_all(op[0], out[0], iof[0], sz[0], iof[0],
@@ -425,7 +427,7 @@ def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
         shard_kwargs["check_vma"] = False
 
     @jax.jit
-    @functools.partial(jax.shard_map, **shard_kwargs)
+    @functools.partial(shard_map, **shard_kwargs)
     def round_fn(grouped, counts, round_idx):
         received, recv_counts = _chunked_round_shard(
             grouped, counts, round_idx, axis_name, n, quota, impl_resolved)
@@ -515,7 +517,7 @@ def make_chunked_exchange_acc(mesh: Mesh, axis_name: str, quota: int,
         shard_kwargs["check_vma"] = False
 
     @functools.partial(jax.jit, donate_argnums=(3,))
-    @functools.partial(jax.shard_map, **shard_kwargs)
+    @functools.partial(shard_map, **shard_kwargs)
     def round_acc(grouped, counts, round_idx, acc):
         counts = counts.reshape(-1).astype(jnp.int32)
         received, _ = _chunked_round_shard(
@@ -647,7 +649,7 @@ def make_shuffle_exchange(mesh: Mesh, axis_name: str, impl: str = "auto",
         shard_kwargs["check_vma"] = False
 
     @jax.jit
-    @functools.partial(jax.shard_map, **shard_kwargs)
+    @functools.partial(shard_map, **shard_kwargs)
     def exchange(data, dest):
         output = jnp.zeros((data.shape[0] * out_factor,) + data.shape[1:],
                            dtype=data.dtype)

@@ -28,6 +28,7 @@ import logging
 import socket
 import threading
 from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Callable, Dict, Optional, Tuple
 
 from sparkrdma_tpu.config import TpuShuffleConf
@@ -40,6 +41,29 @@ Addr = Tuple[str, int]
 
 class TransportError(RuntimeError):
     pass
+
+
+def await_response(fut: Future, timeout: Optional[float]) -> RpcMsg:
+    """Wait out a request future with the claim-back race handling every
+    caller needs: on timeout, cancel() failing means the reader won the
+    race and a response already landed — return it rather than dropping a
+    consumed message on the floor (a credited fetch would otherwise leak
+    the server's window forever: the response never reaches the orphan
+    path AND the requester never reports). cancel() succeeding poisons
+    the future, so a late set_result in _dispatch raises and the response
+    is re-routed to the unsolicited-message path.
+
+    Catches both timeout flavors — on this interpreter (3.10)
+    ``concurrent.futures.TimeoutError`` is NOT the builtin — and always
+    re-raises the BUILTIN ``TimeoutError`` so every caller can catch one
+    class (pre-normalization, 3.10 callers writing ``except
+    TimeoutError`` silently missed the futures flavor)."""
+    try:
+        return fut.result(timeout=timeout)
+    except (TimeoutError, FutureTimeoutError) as e:
+        if not fut.cancel():
+            return fut.result(timeout=0)
+        raise TimeoutError("request timed out") from e
 
 
 class Connection:
@@ -85,37 +109,58 @@ class Connection:
             except OSError as e:
                 raise TransportError(f"{self.name}: send failed: {e}") from e
 
-    def request(self, msg: RpcMsg, timeout: Optional[float] = None) -> RpcMsg:
-        """Send a req_id-bearing message and wait for the echoed response."""
+    def request_async(self, msg: RpcMsg) -> Future:
+        """Send a req_id-bearing message; the returned Future completes
+        with the echoed response (reader thread), a TransportError
+        (teardown/lost connection), or cancellation (caller gave up).
+
+        This is the req-id pipelining surface: many requests ride one
+        connection concurrently, each holding a send-budget slot
+        (java/RdmaChannel.java:66-67) from issue until its future is done
+        — acquisition blocks when the queue-depth budget is exhausted,
+        exactly like the reference's send-queue semaphore.
+        """
         req_id = getattr(msg, "req_id", None)
         if req_id is None:
-            raise ValueError("request() needs a msg with req_id")
+            raise ValueError("request_async() needs a msg with req_id")
         fut: Future = Future()
         self._budget.acquire()
-        try:
+
+        def _cleanup(f: Future, _req_id=req_id) -> None:
             with self._pending_lock:
-                self._pending[req_id] = fut
-            self.send(msg)
-            tmo = timeout if timeout is not None else self._conf.connect_timeout_ms / 1000
-            try:
-                return fut.result(timeout=tmo)
-            except TimeoutError:
-                # Claim the future back before giving up. cancel() failing
-                # means the reader won the race and a response already
-                # landed — return it rather than dropping a consumed
-                # message on the floor (a credited fetch would otherwise
-                # leak the server's window forever: the response never
-                # reaches the orphan path AND the requester never reports).
-                # cancel() succeeding poisons the future, so a late
-                # set_result in _dispatch raises and the response is
-                # re-routed to the unsolicited-message path.
-                if not fut.cancel():
-                    return fut.result(timeout=0)
-                raise
-        finally:
-            with self._pending_lock:
-                self._pending.pop(req_id, None)
+                self._pending.pop(_req_id, None)
             self._budget.release()
+
+        # done-callback cleanup fires exactly once per future, whether the
+        # reader completed it, teardown failed it, or the caller cancelled
+        fut.add_done_callback(_cleanup)
+        with self._pending_lock:
+            self._pending[req_id] = fut
+        try:
+            self.send(msg)
+        except TransportError as e:
+            if not fut.cancel():
+                # the reader raced a (stale) completion in; surface that
+                return fut
+            # cancel() already triggered _cleanup; hand back a failed
+            # future so callers see one error path
+            failed: Future = Future()
+            failed.set_exception(e)
+            return failed
+        except BaseException:
+            # non-transport failure (encode bug, codec error): resolve
+            # the future so _cleanup reclaims the budget slot + pending
+            # entry, then let the bug propagate as itself — same contract
+            # as the replaced blocking request()'s try/finally
+            fut.cancel()
+            raise
+        return fut
+
+    def request(self, msg: RpcMsg, timeout: Optional[float] = None) -> RpcMsg:
+        """Send a req_id-bearing message and wait for the echoed response."""
+        fut = self.request_async(msg)
+        tmo = timeout if timeout is not None else self._conf.connect_timeout_ms / 1000
+        return await_response(fut, tmo)
 
     # -- receiving -------------------------------------------------------
 
@@ -171,8 +216,14 @@ class Connection:
         with self._pending_lock:
             pending, self._pending = dict(self._pending), {}
         for fut in pending.values():
-            if not fut.done():
-                fut.set_exception(exc)
+            try:
+                if not fut.done():
+                    fut.set_exception(exc)
+            except InvalidStateError:
+                # a caller's cancel() won the race between the done()
+                # check and here (the pipelined fetcher cancels whole
+                # windows at exactly this moment); cancelled is resolved
+                pass
 
     def close(self) -> None:
         self._closed.set()

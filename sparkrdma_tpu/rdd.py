@@ -780,12 +780,18 @@ def _chain(node, memo: dict, ctx: "EngineContext"):
         # some side has a shuffle upstream: each side becomes one
         # identity-routed map stage into the union's partition space;
         # slots are statically 0..k-1 (this build is the chain's only
-        # boundary, so its parents head the consuming stage's list)
-        stages = [
-            _shuffle_stage(_Shuffled(s, node.num_partitions(),
-                                     route_task=(lambda t, _o=o: _o + t)),
-                           memo, ctx)
-            for s, o in zip(node.sides, offs)]
+        # boundary, so its parents head the consuming stage's list).
+        # The wrappers are memoized on the node (like _Coalesce._shuffled):
+        # the _shuffle_stage memo keys on node identity, so a union
+        # consumed twice in one job must present the SAME _Shuffled nodes
+        # both times or each side's data shuffles twice
+        shs = getattr(node, "_shuffled_sides", None)
+        if shs is None:
+            shs = [_Shuffled(s, node.num_partitions(),
+                             route_task=(lambda t, _o=o: _o + t))
+                   for s, o in zip(node.sides, offs)]
+            node._shuffled_sides = shs
+        stages = [_shuffle_stage(sh, memo, ctx) for sh in shs]
 
         def build(tc, task_id, _k=len(stages)):
             def gen():

@@ -15,7 +15,14 @@ point by point:
 * local map outputs short-circuit the network entirely (:327-337);
 * results flow through a blocking queue; a sentinel terminates iteration
   (:47-50, 113-117); failures surface as ``FetchFailedError`` so the engine
-  can recompute the stage (:376-381).
+  can recompute the stage (:376-381);
+* **bounded read-ahead per peer**: each peer thread keeps up to
+  ``read_ahead_depth`` grouped fetches outstanding on the pipelined
+  connection and overlaps STEP-2 location reads with STEP-3 data reads —
+  the ``sendQueueDepth / cores`` in-flight split that the reference's
+  whole speedup rides on (:82-83). ``read_ahead_depth=1`` reproduces the
+  fully sequential pre-pipelining behavior exactly (regression escape
+  hatch).
 """
 
 from __future__ import annotations
@@ -25,6 +32,7 @@ import queue
 import random
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -35,6 +43,7 @@ from sparkrdma_tpu.parallel.endpoints import (
 )
 from sparkrdma_tpu.parallel.transport import TransportError
 from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
+from sparkrdma_tpu.utils.stats import FetchPipelineStats
 
 log = logging.getLogger(__name__)
 
@@ -124,6 +133,11 @@ class ShuffleFetcher:
         self.start_partition = start_partition
         self.end_partition = end_partition
         self.metrics = ReadMetrics()
+        # per-peer read-ahead telemetry (depth + queue-wait histograms).
+        # When stats collection is on this IS reader_stats.pipeline — one
+        # object, one lock per issue, one source of truth in snapshots
+        self.pipeline = (reader_stats.pipeline if reader_stats is not None
+                         else FetchPipelineStats())
         self._results: "queue.Queue[FetchResult]" = queue.Queue()
         self._expected_results = 0
         self._consumed = 0
@@ -217,63 +231,12 @@ class ShuffleFetcher:
                          count_lock: threading.Lock) -> None:
         try:
             peer = self.endpoint.member_at(exec_idx)
-            pending: List[_PendingFetch] = []
-            for m in maps:
-                # STEP 2: block locations (:293-315).
-                with self.tracer.span("fetch.locations", "fetch",
-                                      map=m, peer=exec_idx):
-                    locs = self.endpoint.fetch_output_range(
-                        peer, self.shuffle_id, m,
-                        self.start_partition, self.end_partition)
-                # STEP 3 grouping: consecutive partitions, ≤ read block size
-                # (:240-263). Zero-length blocks ride along byte-free but
-                # still count toward a block-count bound so a wide, mostly-
-                # empty partition range can't build a request frame past the
-                # native server's 1 MiB inbound cap (csrc/blockserver.cpp
-                # kMaxReqFrame; 8192 blocks ~= 128 KiB of frame).
-                group: List = []
-                group_start = self.start_partition
-                group_bytes = 0
-                limit = self.conf.shuffle_read_block_size
-                max_blocks = 8192
-                for i, loc in enumerate(locs):
-                    p = self.start_partition + i
-                    if group and (group_bytes + loc.length > limit
-                                  or len(group) >= max_blocks):
-                        pending.append(_PendingFetch(
-                            exec_idx, m, group_start, p, group, group_bytes))
-                        group, group_start, group_bytes = [], p, 0
-                    group.append((loc.buf, loc.offset, loc.length))
-                    group_bytes += loc.length
-                if group:
-                    pending.append(_PendingFetch(
-                        exec_idx, m, group_start,
-                        self.start_partition + len(locs), group, group_bytes))
-            self._rng.shuffle(pending)
-            with count_lock:
-                self._expected_results += len(pending)
-            for fetch in pending:
-                if self._aborted.is_set():
-                    raise _Aborted()
-                self._acquire_in_flight(fetch.total_bytes)
-                t0 = time.monotonic()
-                try:
-                    with self.tracer.span("fetch.blocks", "fetch",
-                                          map=fetch.map_id, peer=exec_idx,
-                                          bytes=fetch.total_bytes):
-                        data = self.endpoint.fetch_blocks(
-                            peer, self.shuffle_id, fetch.blocks)
-                except (TransportError, AssertionError) as e:
-                    self._release_in_flight(fetch.total_bytes)
-                    raise FetchFailedError(self.shuffle_id, fetch.map_id,
-                                           exec_idx, str(e)) from e
-                dt = time.monotonic() - t0
-                self.metrics.record_remote(len(data), dt)
-                if self.reader_stats is not None:
-                    self.reader_stats.update(exec_idx, dt)
-                self._results.put(FetchResult(
-                    fetch.map_id, fetch.start_partition, fetch.end_partition,
-                    data))
+            depth = self.conf.resolved_read_ahead_depth()
+            if depth <= 1:
+                self._fetch_sequential(peer, exec_idx, maps, count_lock)
+            else:
+                self._fetch_pipelined(peer, exec_idx, maps, count_lock,
+                                      depth)
         except _Aborted:
             pass  # consumer went away; exit quietly
         except Exception as e:  # noqa: BLE001 — ANY peer-thread failure must
@@ -290,6 +253,229 @@ class ShuffleFetcher:
                 if self._peer_threads_left == 0:
                     self._results.put(FetchResult(is_sentinel=True))
 
+    def _group_locations(self, exec_idx: int, m: int,
+                         locs) -> List[_PendingFetch]:
+        """STEP 3 grouping: consecutive partitions, ≤ read block size
+        (:240-263). Zero-length blocks ride along byte-free but still
+        count toward a block-count bound so a wide, mostly-empty
+        partition range can't build a request frame past the native
+        server's 1 MiB inbound cap (csrc/blockserver.cpp kMaxReqFrame;
+        8192 blocks ~= 128 KiB of frame)."""
+        pending: List[_PendingFetch] = []
+        group: List = []
+        group_start = self.start_partition
+        group_bytes = 0
+        limit = self.conf.shuffle_read_block_size
+        max_blocks = 8192
+        for i, loc in enumerate(locs):
+            p = self.start_partition + i
+            if group and (group_bytes + loc.length > limit
+                          or len(group) >= max_blocks):
+                pending.append(_PendingFetch(
+                    exec_idx, m, group_start, p, group, group_bytes))
+                group, group_start, group_bytes = [], p, 0
+            group.append((loc.buf, loc.offset, loc.length))
+            group_bytes += loc.length
+        if group:
+            pending.append(_PendingFetch(
+                exec_idx, m, group_start,
+                self.start_partition + len(locs), group, group_bytes))
+        return pending
+
+    def _fetch_sequential(self, peer, exec_idx: int, maps: List[int],
+                          count_lock: threading.Lock) -> None:
+        """``read_ahead_depth=1``: the fully serialized fetch — every
+        location read then every data read, one at a time. Kept verbatim
+        as the regression escape hatch the pipelined path is diffed
+        against."""
+        pending: List[_PendingFetch] = []
+        for m in maps:
+            # STEP 2: block locations (:293-315).
+            with self.tracer.span("fetch.locations", "fetch",
+                                  map=m, peer=exec_idx):
+                locs = self.endpoint.fetch_output_range(
+                    peer, self.shuffle_id, m,
+                    self.start_partition, self.end_partition)
+            pending.extend(self._group_locations(exec_idx, m, locs))
+        self._rng.shuffle(pending)
+        with count_lock:
+            self._expected_results += len(pending)
+        for fetch in pending:
+            if self._aborted.is_set():
+                raise _Aborted()
+            self._acquire_in_flight(fetch.total_bytes)
+            t0 = time.monotonic()
+            try:
+                with self.tracer.span("fetch.blocks", "fetch",
+                                      map=fetch.map_id, peer=exec_idx,
+                                      bytes=fetch.total_bytes):
+                    data = self.endpoint.fetch_blocks(
+                        peer, self.shuffle_id, fetch.blocks)
+            except (TransportError, AssertionError) as e:
+                self._release_in_flight(fetch.total_bytes)
+                raise FetchFailedError(self.shuffle_id, fetch.map_id,
+                                       exec_idx, str(e)) from e
+            dt = time.monotonic() - t0
+            self.metrics.record_remote(len(data), dt)
+            if self.reader_stats is not None:
+                self.reader_stats.update(exec_idx, dt)
+            self._results.put(FetchResult(
+                fetch.map_id, fetch.start_partition, fetch.end_partition,
+                data))
+
+    def _fetch_pipelined(self, peer, exec_idx: int, maps: List[int],
+                         count_lock: threading.Lock, depth: int) -> None:
+        """Bounded read-ahead window: up to ``depth`` location reads AND
+        up to ``depth`` grouped data fetches outstanding at once on the
+        shared pipelined connection, completions drained oldest-first.
+        This is the structure the reference's speedup comes from — many
+        one-sided READs in flight per channel (:82-83) — mapped onto the
+        transport's req-id multiplexing.
+
+        Budget interplay: a data fetch is only ISSUED once its bytes fit
+        the ``max_bytes_in_flight`` gate. When the gate is full and this
+        window still holds issued fetches, the oldest is completed first
+        (its enqueue lets the consumer drain and release budget) — never
+        block on the gate while holding completions, or the release that
+        would unblock it could never happen."""
+        maps = list(maps)
+        self._rng.shuffle(maps)  # randomized order (:74-79)
+        loc_pending: deque = deque()  # (map_id, AsyncFetch, t_issue)
+        ready: deque = deque()        # (_PendingFetch, t_ready)
+        inflight: deque = deque()     # (_PendingFetch, AsyncFetch,
+        #                                t_ready, t_issue)
+        mi = 0
+        try:
+            while mi < len(maps) or loc_pending or ready or inflight:
+                if self._aborted.is_set():
+                    raise _Aborted()
+                # top up STEP-2 read-ahead: overlap location reads with
+                # everything else
+                while mi < len(maps) and len(loc_pending) < depth:
+                    m = maps[mi]
+                    mi += 1
+                    loc_pending.append((
+                        m,
+                        self.endpoint.fetch_output_range_async(
+                            peer, self.shuffle_id, m,
+                            self.start_partition, self.end_partition),
+                        time.monotonic()))
+                # harvest landed location reads in issue order
+                while loc_pending and loc_pending[0][1].done():
+                    self._harvest_locations(exec_idx, loc_pending.popleft(),
+                                            ready, count_lock)
+                # issue STEP-3 data fetches while the window has room and
+                # the in-flight byte budget admits them. With an empty
+                # window the acquire may block (same as the sequential
+                # path — nothing of ours is withheld from the consumer);
+                # with fetches in flight it must not: the release that
+                # would unblock it needs their completions enqueued first.
+                while ready and len(inflight) < depth:
+                    fetch, t_ready = ready[0]
+                    if not self._try_acquire_in_flight(
+                            fetch.total_bytes, nonblocking=bool(inflight)):
+                        break
+                    ready.popleft()
+                    t_issue = time.monotonic()
+                    handle = self.endpoint.fetch_blocks_async(
+                        peer, self.shuffle_id, fetch.blocks)
+                    inflight.append((fetch, handle, t_ready, t_issue))
+                    self.pipeline.record_issue(exec_idx, len(inflight),
+                                               t_issue - t_ready)
+                # complete: whenever the window holds fetches the oldest
+                # completion is both the progress path and the budget-
+                # release path; with an empty window, block on the oldest
+                # location read instead
+                if inflight:
+                    self._complete_oldest(exec_idx, inflight)
+                elif loc_pending:
+                    self._harvest_locations(exec_idx, loc_pending.popleft(),
+                                            ready, count_lock)
+        except BaseException:
+            # window-held budget must not outlive the window: the issued-
+            # but-uncompleted fetches' bytes were acquired above and their
+            # results will never reach the consumer (who releases on
+            # dequeue). The abandoned handles are cancelled too — a
+            # pending request holds a send-budget slot on the SHARED
+            # connection until its future resolves, so walking away
+            # without cancelling would leak one slot per abandoned fetch
+            # on every failed attempt (the sequential path's blocking
+            # request() cancels on timeout for the same reason)
+            for _m, handle, _t in loc_pending:
+                handle.cancel()
+            for fetch, handle, _tr, _ti in inflight:
+                handle.cancel()
+                self._release_in_flight(fetch.total_bytes)
+            raise
+
+    def _harvest_locations(self, exec_idx: int, entry, ready: deque,
+                           count_lock: threading.Lock) -> None:
+        m, handle, t_issue = entry
+        locs = handle.result()
+        if self.tracer.enabled:
+            # same span the sequential path brackets around its blocking
+            # location read — STEP-2 latency stays measurable in the
+            # mode built to hide it
+            end_us = self.tracer.now_us()
+            start_us = end_us - (time.monotonic() - t_issue) * 1e6
+            self.tracer.complete_span("fetch.locations", "fetch",
+                                      start_us, end_us,
+                                      map=m, peer=exec_idx)
+        groups = self._group_locations(exec_idx, m, locs)
+        # randomized issue order within the map (:74-79), like the
+        # sequential path's shuffle of `pending` — without it every
+        # reducer walks each map's groups in identical ascending
+        # partition order and hotspots the same serving range
+        self._rng.shuffle(groups)
+        with count_lock:
+            self._expected_results += len(groups)
+        now = time.monotonic()
+        ready.extend((g, now) for g in groups)
+
+    def _complete_oldest(self, exec_idx: int, inflight: deque) -> None:
+        """Finish the window's oldest data fetch: decode on this thread,
+        record metrics + issue→wire→complete trace spans, enqueue."""
+        fetch, handle, t_ready, t_issue = inflight[0]
+        try:
+            data = handle.result()
+        except (TransportError, AssertionError) as e:
+            # this entry's budget is released here; the rest of the
+            # window is released by _fetch_pipelined's unwind
+            inflight.popleft()
+            self._release_in_flight(fetch.total_bytes)
+            raise FetchFailedError(self.shuffle_id, fetch.map_id,
+                                   exec_idx, str(e)) from e
+        inflight.popleft()
+        now = time.monotonic()
+        dt = now - t_issue
+        self.metrics.record_remote(len(data), dt)
+        if self.reader_stats is not None:
+            self.reader_stats.update(exec_idx, dt)
+        if self.tracer.enabled:
+            end_us = self.tracer.now_us()
+            issue_us = end_us - (now - t_issue) * 1e6
+            ready_us = end_us - (now - t_ready) * 1e6
+            wire_us = (end_us - (now - handle.wire_done_s) * 1e6
+                       if handle.wire_done_s is not None else end_us)
+            # the stamp rides the future's done-callback, which can run
+            # AFTER result() already returned — clamp so a late stamp
+            # can't put the wire phase outside [issue, complete]
+            wire_us = min(max(wire_us, issue_us), end_us)
+            self.tracer.complete_span(
+                "fetch.issue", "fetch", ready_us, issue_us,
+                map=fetch.map_id, peer=exec_idx)
+            # the wire phase keeps the sequential path's span name so
+            # existing trace consumers see one contract either way
+            self.tracer.complete_span(
+                "fetch.blocks", "fetch", issue_us, wire_us,
+                map=fetch.map_id, peer=exec_idx, bytes=fetch.total_bytes)
+            self.tracer.complete_span(
+                "fetch.complete", "fetch", wire_us, end_us,
+                map=fetch.map_id, peer=exec_idx)
+        self._results.put(FetchResult(
+            fetch.map_id, fetch.start_partition, fetch.end_partition,
+            data))
+
     # -- flow control ----------------------------------------------------
 
     def _acquire_in_flight(self, nbytes: int) -> None:
@@ -303,6 +489,23 @@ class ShuffleFetcher:
             if self._aborted.is_set():
                 raise _Aborted()
             self._in_flight += nbytes
+
+    def _try_acquire_in_flight(self, nbytes: int,
+                               nonblocking: bool) -> bool:
+        """Window-aware acquire: blocking when the caller holds no
+        outstanding completions (identical to ``_acquire_in_flight``,
+        single-oversized escape included), one-shot when it does."""
+        if not nonblocking:
+            self._acquire_in_flight(nbytes)
+            return True
+        with self._in_flight_cv:
+            if self._aborted.is_set():
+                raise _Aborted()
+            if (self._in_flight > 0
+                    and self._in_flight + nbytes > self.conf.max_bytes_in_flight):
+                return False
+            self._in_flight += nbytes
+            return True
 
     def _release_in_flight(self, nbytes: int) -> None:
         with self._in_flight_cv:

@@ -1,0 +1,55 @@
+"""jax API-surface compatibility shims.
+
+The package speaks the modern spelling — ``jax.shard_map`` with the
+varying-axes check named ``check_vma`` — but must also run on
+interpreters whose jax ships it as
+``jax.experimental.shard_map.shard_map`` with the check named
+``check_rep`` (<= 0.4.x). Callers import ``shard_map`` from here and
+always use the new names; the wrapper renames for the legacy entry
+point.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as _np
+
+try:
+    jax.ShapeDtypeStruct((1,), _np.int32, vma=frozenset())
+    _HAS_VMA = True
+except TypeError:
+    _HAS_VMA = False
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` carrying the varying-manual-axes
+    annotation when this jax knows it; the legacy rep-based checker has
+    no such field and needs none (callers pairing this with
+    ``check_vma=False`` get ``check_rep=False`` from the shard_map shim
+    below)."""
+    if vma is not None and _HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams``, spelled ``TPUCompilerParams`` on
+    <= 0.4.x. Imported lazily: pallas is only needed by callers that are
+    about to build a kernel."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)

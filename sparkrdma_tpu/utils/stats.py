@@ -49,6 +49,81 @@ class FetchHistogram:
         }
 
 
+class DepthHistogram:
+    """Power-of-two outstanding-depth buckets: bucket i counts samples
+    with depth in [2^i, 2^(i+1)). Depth 0 (idle issue) lands in bucket
+    0 with depth 1 — what matters is how full the read-ahead window ran,
+    and the window is never larger than a few thousand."""
+
+    NUM_BUCKETS = 16  # covers depth up to 2^15; deeper clamps
+
+    def __init__(self):
+        self.buckets = [0] * self.NUM_BUCKETS
+        self.count = 0
+        self.max_depth = 0
+        self._total = 0
+
+    def add(self, depth: int) -> None:
+        depth = max(0, int(depth))
+        idx = min(max(depth, 1).bit_length() - 1, self.NUM_BUCKETS - 1)
+        self.buckets[idx] += 1
+        self.count += 1
+        self._total += depth
+        self.max_depth = max(self.max_depth, depth)
+
+    def summary(self) -> dict:
+        edges = [f"[{1 << i},{(1 << (i + 1)) - 1}]"
+                 for i in range(self.NUM_BUCKETS)]
+        return {
+            "count": self.count,
+            "max": self.max_depth,
+            "mean": round(self._total / self.count, 2) if self.count else 0.0,
+            "buckets": {e: b for e, b in zip(edges, self.buckets) if b},
+        }
+
+
+class FetchPipelineStats:
+    """Per-peer read-ahead telemetry for the pipelined fetch dataplane:
+    how deep the outstanding window actually ran at each issue
+    (``DepthHistogram``), and how long each grouped fetch sat queued
+    between becoming ready and hitting the wire (window slot +
+    in-flight-budget wait; millisecond-bucket ``FetchHistogram``).
+
+    The reference has no equivalent — its queue depth is fixed by the
+    sendQueueDepth/cores split (RdmaShuffleFetcherIterator.scala:82-83)
+    and unobservable; here both are measured so a mis-tuned
+    ``read_ahead_depth`` shows up in the snapshot, not in a guess."""
+
+    def __init__(self, queue_wait_bucket_ms: int = 1,
+                 queue_wait_num_buckets: int = 20):
+        self._bucket_ms = queue_wait_bucket_ms
+        self._num_buckets = queue_wait_num_buckets
+        self._depth: Dict[int, DepthHistogram] = {}
+        self._queue_wait: Dict[int, FetchHistogram] = {}
+        self._lock = threading.Lock()
+
+    def record_issue(self, exec_index: int, outstanding_depth: int,
+                     queue_wait_s: float) -> None:
+        with self._lock:
+            depth = self._depth.get(exec_index)
+            if depth is None:
+                depth = self._depth[exec_index] = DepthHistogram()
+                self._queue_wait[exec_index] = FetchHistogram(
+                    self._bucket_ms, self._num_buckets)
+            depth.add(outstanding_depth)
+            self._queue_wait[exec_index].add(queue_wait_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "per_peer": {
+                    str(i): {"depth": self._depth[i].summary(),
+                             "queue_wait": self._queue_wait[i].summary()}
+                    for i in sorted(self._depth)
+                },
+            }
+
+
 class ShuffleReaderStats:
     """Per-remote + global histograms (RdmaShuffleReaderStats.scala:32-81)."""
 
@@ -59,6 +134,9 @@ class ShuffleReaderStats:
         self._per_remote: Dict[int, FetchHistogram] = {}
         self._global = FetchHistogram(self._bucket_ms, self._num_buckets)
         self._lock = threading.Lock()
+        # pipelined-fetch telemetry rides the same stats object so one
+        # snapshot shows latency AND pipeline behavior per remote
+        self.pipeline = FetchPipelineStats()
 
     def update(self, exec_index: int, latency_s: float) -> None:
         with self._lock:
@@ -71,11 +149,15 @@ class ShuffleReaderStats:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            snap = {
                 "global": self._global.summary(),
                 "per_remote": {str(k): v.summary()
                                for k, v in sorted(self._per_remote.items())},
             }
+        pipeline = self.pipeline.snapshot()
+        if pipeline["per_peer"]:
+            snap["pipeline"] = pipeline
+        return snap
 
     def log_summary(self, logger) -> None:
         """Printed at stop (RdmaShuffleReaderStats.scala:55-81)."""
@@ -112,6 +194,15 @@ class MemStats:
                         break
         except (OSError, IndexError, ValueError):
             pass
+        if peak_kb == 0:
+            # sandboxed /proc (gVisor-style) omits VmHWM; getrusage's
+            # ru_maxrss is already KiB on Linux
+            try:
+                import resource
+                peak_kb = resource.getrusage(
+                    resource.RUSAGE_SELF).ru_maxrss
+            except (ImportError, OSError, ValueError):
+                pass
         return {"minor_faults": minflt, "major_faults": majflt,
                 "peak_rss_kb": peak_kb}
 

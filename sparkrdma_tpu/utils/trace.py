@@ -59,6 +59,31 @@ class Tracer:
                         "args": args,
                     })
 
+    def now_us(self) -> float:
+        """Current trace-clock timestamp, for ``complete_span``: async
+        callers stamp boundaries as they happen (issue, wire landing,
+        completion) and emit the spans afterwards — a context manager
+        can't bracket work whose two ends live on different threads."""
+        return self._now_us()
+
+    def complete_span(self, name: str, category: str, start_us: float,
+                      end_us: float, **args) -> None:
+        """Record a span with explicit trace-clock endpoints (from
+        ``now_us``). Used by the pipelined fetcher to emit separate
+        issue→wire→complete phases of one asynchronous fetch."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._events) >= self.MAX_EVENTS:
+                self.dropped += 1
+                return
+            self._events.append({
+                "name": name, "cat": category, "ph": "X",
+                "ts": start_us, "dur": max(0.0, end_us - start_us),
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "args": args,
+            })
+
     def instant(self, name: str, category: str = "shuffle", **args) -> None:
         if not self.enabled:
             return

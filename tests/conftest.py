@@ -21,3 +21,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: longer than the tier-1 wall-clock budget on a CPU host; "
+        "excluded by the default `-m 'not slow'` run, exercised "
+        "explicitly and on hardware rounds")

@@ -1,0 +1,140 @@
+"""Pipelined-fetch tier-1 tests: the read-ahead window's win, measured
+deterministically on CPU loopback.
+
+The reference's speedup comes from keeping ``sendQueueDepth / cores``
+one-sided READs in flight per channel
+(RdmaShuffleFetcherIterator.scala:82-83); these tests drive the same
+structure through the Python dataplane with a fixed service delay
+standing in for wire latency (shuffle/fetch_bench.py), so the pipelining
+win is asserted — not just eyeballed — without TPU hardware, and depth 1
+is pinned to today's fully sequential behavior as the regression escape
+hatch.
+"""
+
+import os
+import time
+
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.shuffle.fetch_bench import run_fetch_microbench
+
+
+def test_read_ahead_depth_resolution():
+    """0 = auto (sendQueueDepth / cores, the reference's division),
+    explicit values pass through, floor at 1."""
+    auto = TpuShuffleConf(send_queue_depth=4096, read_ahead_depth=0)
+    assert auto.resolved_read_ahead_depth() == \
+        max(1, 4096 // max(1, os.cpu_count() or 1))
+    assert TpuShuffleConf(read_ahead_depth=1).resolved_read_ahead_depth() == 1
+    assert TpuShuffleConf(read_ahead_depth=7).resolved_read_ahead_depth() == 7
+    # auto can never resolve to 0, however many cores the host has
+    tiny = TpuShuffleConf(send_queue_depth=16, read_ahead_depth=0)
+    assert tiny.resolved_read_ahead_depth() >= 1
+
+
+def test_pipelined_fetch_faster_and_byte_identical(tmp_path):
+    """The acceptance gate: depth >= 4 beats depth 1 by >= 1.5x on a
+    latency-injected loopback cluster, fetching byte-identical data.
+
+    96 grouped fetches x 6 ms service delay ~= 1.4 s serialized; a
+    window of 8 overlaps the delays on the serving pool (observed ~2.8x
+    here), so the margin over the asserted 1.5x is wide and
+    deterministic."""
+    res = run_fetch_microbench(str(tmp_path), depths=(1, 8), delay_s=0.006,
+                               num_partitions=48, num_maps=2,
+                               serve_threads=8, reps=2)
+    assert res["identical"], "read-ahead changed the fetched bytes"
+    assert res["fetches"] > 0
+    assert res["speedup"] >= 1.5, res
+    # the deep run must actually have run deep: the per-peer depth
+    # histogram (utils/stats.py) saw the window above 1
+    per_peer = res["pipeline"]["per_peer"]
+    assert any(p["depth"]["max"] >= 2 for p in per_peer.values()), res
+
+
+def test_pipelined_fetch_emits_phase_spans(tmp_path):
+    """With tracing on, a pipelined fetch emits separate
+    issue -> wire -> complete spans (utils/trace.py complete_span) so a
+    profile can tell queue wait from wire time from decode. The wire
+    phase keeps the sequential path's "fetch.blocks" name — one trace
+    contract either way."""
+    import numpy as np
+
+    from sparkrdma_tpu.shuffle.manager import (
+        PartitionerSpec, TpuShuffleManager)
+    from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
+    from sparkrdma_tpu.utils.trace import Tracer
+
+    conf_kw = dict(connect_timeout_ms=20000, use_cpp_runtime=False)
+    driver = TpuShuffleManager(TpuShuffleConf(**conf_kw), is_driver=True)
+    execs = [TpuShuffleManager(TpuShuffleConf(**conf_kw),
+                               driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(2)]
+    try:
+        for ex in execs:
+            ex.executor.wait_for_members(2)
+        handle = driver.register_shuffle(7, 1, 8, PartitionerSpec("modulo"),
+                                         row_payload_bytes=8)
+        w = execs[0].get_writer(handle, 0)
+        keys = np.arange(64, dtype=np.uint64) % 8
+        w.write_batch(keys, np.ones((64, 8), dtype=np.uint8))
+        w.close()
+        tracer = Tracer()
+        reader = TpuShuffleReader(
+            execs[1].executor, execs[1].resolver,
+            TpuShuffleConf(**dict(conf_kw, read_ahead_depth=4)),
+            handle.shuffle_id, 1, 0, 8, 8, tracer=tracer)
+        reader.read_all()
+        names = {e["name"] for e in tracer._events}
+        assert {"fetch.locations", "fetch.issue", "fetch.blocks",
+                "fetch.complete"} <= names, names
+        # spans carry sane non-negative durations
+        for e in tracer._events:
+            if e["name"].startswith("fetch."):
+                assert e["dur"] >= 0.0
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
+
+
+@pytest.mark.parametrize("enabled", [True, False])
+def test_connection_pre_warming(tmp_path, enabled):
+    """With pre_warm_connections on, an executor dials its peers the
+    moment the announce names them — before any fetch — so the first
+    fetch pays no handshake. With it off, no ahead-of-fetch dials
+    happen (the lazy path stays intact)."""
+    from sparkrdma_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf(connect_timeout_ms=20000, use_cpp_runtime=False,
+                          pre_warm_connections=enabled)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(2)]
+    try:
+        for ex in execs:
+            ex.executor.wait_for_members(2)
+        if enabled:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if all(ex.executor.prewarm_dials >= 1 for ex in execs):
+                    break
+                time.sleep(0.02)
+            for ex in execs:
+                assert ex.executor.prewarm_dials >= 1
+                # the dialed connection is in the client cache, live
+                assert any(not c.closed for c in
+                           ex.executor._clients._conns.values())
+        else:
+            time.sleep(0.3)  # give a buggy eager dial time to show up
+            for ex in execs:
+                assert ex.executor.prewarm_dials == 0
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()

@@ -562,6 +562,29 @@ def test_wide_union_composes_downstream(ctx):
         {0: 10, 1: 10, 2: 10, 9: 99}
 
 
+def test_wide_union_shuffles_each_side_once(ctx):
+    """A wide union consumed twice in one job compiles ONE identity
+    exchange per side: the per-side _Shuffled wrappers are memoized on
+    the _Union node (like _Coalesce._shuffled), so the _shuffle_stage
+    memo can dedupe across consumptions instead of shuffling each
+    side's data twice."""
+    from sparkrdma_tpu.rdd import _chain
+
+    pairs = ctx.parallelize([(i % 3, 1) for i in range(30)], 3) \
+        .reduce_by_key(lambda x, y: x + y, 3)
+    extra = ctx.parallelize([(9, 99)], 1)
+    u = pairs.union(extra)
+    memo: dict = {}
+    _, stages1 = _chain(u._node, memo, u._ctx)
+    _, stages2 = _chain(u._node, memo, u._ctx)
+    assert stages1 and [id(s) for s in stages1] == [id(s) for s in stages2]
+    # and end-to-end: a self-join (the union consumed on both cogroup
+    # sides of one job) still produces the right records
+    got = sorted(u.join(u).collect())
+    assert got == [(0, (10, 10)), (1, (10, 10)), (2, (10, 10)),
+                   (9, (99, 99))]
+
+
 def test_coalesce_below_shuffle_boundary(ctx):
     """coalesce after a wide op compiles to an identity-routed exchange
     (tasks here read only their own partition) — records survive and

@@ -206,11 +206,19 @@ print("ALS-REHEARSAL-OK")
 """
 
 
+@pytest.mark.slow
 def test_als_zipf_rehearsal_memory_bounded():
     """Config #5 at environment scale: >=512 MB of zipf-skewed ratings
     through one full alternating sweep (two skewed shuffles) with the
     address space capped — the bounded-round exchange must hold its
-    memory contract at data sizes where a leak aborts the run."""
+    memory contract at data sizes where a leak aborts the run.
+
+    Marked slow: the sweep's ~800 bounded exchange rounds take longer
+    than the entire tier-1 wall-clock budget on a CPU host, which
+    starved every alphabetically-later test file out of the tier-1 run
+    entirely. The default `-m 'not slow'` filter skips it; run it
+    explicitly (or at reduced REHEARSAL_ALS_MB) when touching the
+    exchange or ALS paths."""
     size_mb = int(os.environ.get("REHEARSAL_ALS_MB", "512"))
     script = _ALS_SCRIPT.format(repo=_REPO, size_mb=size_mb)
     env = dict(os.environ)

@@ -77,3 +77,63 @@ def test_chunked_exchange_over_ring_transport(mesh):
     assert r1 == r2
     for d in range(D):
         np.testing.assert_array_equal(via_ring[d], via_collective[d])
+
+
+# -- shipped ring entry points: make_shuffle_exchange / make_terasort_step --
+
+def _run_shuffle_impl(mesh, data, dest, out_factor, impl):
+    from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
+    exchange = make_shuffle_exchange(mesh, "shuffle", impl=impl,
+                                     out_factor=out_factor)
+    sharding = NamedSharding(mesh, P("shuffle"))
+    received, counts, offsets, overflowed = jax.block_until_ready(
+        exchange(jax.device_put(data, sharding),
+                 jax.device_put(dest, sharding)))
+    return (np.asarray(received), np.asarray(counts), np.asarray(offsets),
+            np.asarray(overflowed))
+
+
+def test_shuffle_exchange_ring_parity_no_overflow(mesh):
+    """No pair over its slot: the ring transport's shuffle exchange is
+    bit-identical to gather AND dense — same received rows, counts,
+    offsets, and clear overflow flags."""
+    capacity = 32
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 2**31, size=(D * capacity, 2), dtype=np.int32)
+    dest = rng.integers(0, D, size=D * capacity).astype(np.int32)
+    ring = _run_shuffle_impl(mesh, data, dest, 2, "ring_interpret")
+    for other in ("gather", "dense"):
+        ref = _run_shuffle_impl(mesh, data, dest, 2, other)
+        np.testing.assert_array_equal(ring[1], ref[1])  # counts
+        np.testing.assert_array_equal(ring[2], ref[2])  # offsets
+        np.testing.assert_array_equal(ring[0], ref[0])  # received rows
+        assert not ring[3].any() and not ref[3].any()
+
+
+def test_shuffle_exchange_ring_overflow_flag_agreement(mesh):
+    """Everyone floods device 5 past its pair slot: the ring transport
+    must raise the same per-device overflow flags as dense (they share
+    the slot layout), never silently truncate."""
+    capacity = 32
+    data = np.arange(D * capacity, dtype=np.int32)
+    dest = np.full(D * capacity, 5, np.int32)
+    ring = _run_shuffle_impl(mesh, data, dest, 2, "ring_interpret")
+    dense = _run_shuffle_impl(mesh, data, dest, 2, "dense")
+    np.testing.assert_array_equal(ring[3], dense[3])
+    assert ring[3].any(), "flood past the pair slot must overflow"
+
+
+def test_terasort_ring_parity(mesh):
+    """make_terasort_step over the ring transport sorts bit-identically
+    to the gather and dense transports on the same rows."""
+    from sparkrdma_tpu.models.terasort import (
+        TeraSortConfig, generate_rows, run_terasort)
+    cfg = TeraSortConfig(rows_per_device=256, payload_words=2, out_factor=2)
+    rows = generate_rows(cfg, D, seed=4)
+    out_ring, counts_ring, _ = run_terasort(mesh, cfg, impl="ring_interpret",
+                                            rows=rows)
+    for other in ("gather", "dense"):
+        out_ref, counts_ref, _ = run_terasort(mesh, cfg, impl=other,
+                                              rows=rows)
+        np.testing.assert_array_equal(counts_ring, counts_ref)
+        np.testing.assert_array_equal(out_ring, out_ref)

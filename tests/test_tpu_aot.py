@@ -22,7 +22,17 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkrdma_tpu.utils.compat import shard_map
+
 AXIS = "shuffle"
+
+# the opcode these tests compiler-validate arrived in jax 0.5.x; an older
+# interpreter can only ever watch resolve_impl fall back to dense, so the
+# native-path assertions are environment-gated (same spirit as the
+# tpu_mesh fixture's topology skip)
+requires_ragged = pytest.mark.skipif(
+    not hasattr(jax.lax, "ragged_all_to_all"),
+    reason="this jax lacks lax.ragged_all_to_all (the opcode under test)")
 
 
 @functools.lru_cache(maxsize=1)
@@ -52,6 +62,7 @@ def _lower_compile(jitted, *args):
     return text, compiled
 
 
+@requires_ragged
 def test_native_exchange_compiles_with_ragged_opcode(tpu_mesh):
     """The full 8-device native exchange AOT-compiles for v5e and actually
     lowers to the ragged-all-to-all opcode (not a silent decomposition)."""
@@ -66,6 +77,7 @@ def test_native_exchange_compiles_with_ragged_opcode(tpu_mesh):
     assert "ragged_all_to_all" in text, "native path decomposed away"
 
 
+@requires_ragged
 def test_terasort_step_compiles_for_tpu(tpu_mesh):
     """The flagship multi-chip step (partition + native ragged exchange +
     sort) passes the real XLA:TPU compiler at v5e layouts."""
@@ -136,6 +148,7 @@ def test_chunked_ring_round_compiles(tpu_mesh):
     _lower_compile(round_fn, grouped, counts, 0)
 
 
+@requires_ragged
 def test_2d_mesh_exchange_compiles(tpu_mesh):
     """dp x shuffle composition (the embedding a host engine uses) compiles
     for v5e — collectives ride the inner mesh axis only."""
@@ -145,7 +158,7 @@ def test_2d_mesh_exchange_compiles(tpu_mesh):
     mesh2 = Mesh(devs, ("dp", AXIS))
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh2,
+    @functools.partial(shard_map, mesh=mesh2,
                        in_specs=(P("dp", AXIS),) * 2,
                        out_specs=P("dp", AXIS))
     def exchange2d(data, dest):
@@ -160,6 +173,7 @@ def test_2d_mesh_exchange_compiles(tpu_mesh):
     assert "ragged_all_to_all" in text
 
 
+@requires_ragged
 def test_tpcds_step_compiles_for_tpu(tpu_mesh):
     """The 5-exchange star-join step (the TPC-DS-class plan) compiles for
     v5e with all exchanges on the native opcode."""
@@ -175,6 +189,7 @@ def test_tpcds_step_compiles_for_tpu(tpu_mesh):
     assert text.count("ragged_all_to_all") >= 5
 
 
+@requires_ragged
 def test_scale_up_topologies_resolve_and_compile():
     """The v5e compiler accepts ragged-all-to-all only up to 16 chips
     (32+ have limited ICI routing and reject the opcode — discovered by
@@ -205,6 +220,7 @@ def test_scale_up_topologies_resolve_and_compile():
             assert "all_to_all" in text, name
 
 
+@requires_ragged
 def test_native_parity_where_backend_executes():
     """Bit-identity of impl='native' vs the gather oracle, on any running
     backend that honors the opcode (today: real multi-chip TPU; XLA:CPU
