@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Seeded chaos sweep: run the fault-injection scenario matrix
+# (tests/test_chaos.py, `chaos` marker — including the `slow` wide
+# matrix) across a set of injector seeds. Each scenario asserts
+# byte-identical reduce output under its faults and embeds the seed in
+# any failure message, so a red sweep replays exactly:
+#
+#     CHAOS_SEED=<seed> python -m pytest tests/test_chaos.py -m chaos
+#
+# Usage: scripts/run_chaos.sh [seed ...]
+#   CHAOS_SEEDS="0 1 2"   alternative way to pass the seed list
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SEEDS=${*:-${CHAOS_SEEDS:-"0 1 2 3 4 5 6 7"}}
+failed=()
+for seed in $SEEDS; do
+  echo "=== chaos sweep: seed ${seed} ==="
+  if ! CHAOS_SEED="${seed}" JAX_PLATFORMS=cpu \
+       python -m pytest tests/test_chaos.py -q -m chaos \
+         -p no:cacheprovider -p no:randomly; then
+    echo "!!! seed ${seed} FAILED — replay with:"
+    echo "    CHAOS_SEED=${seed} python -m pytest tests/test_chaos.py -m chaos"
+    failed+=("${seed}")
+  fi
+done
+
+if [ "${#failed[@]}" -gt 0 ]; then
+  echo "chaos sweep: FAILED seeds: ${failed[*]}"
+  exit 1
+fi
+echo "chaos sweep: all seeds green"

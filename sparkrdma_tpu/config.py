@@ -154,6 +154,43 @@ _KEYS = [
              "(Spark's executor task slots analogue)."),
     _Key("task_timeout_ms", 600_000, "int", 1000, 86_400_000,
          doc="Driver-side wait budget for one shipped task."),
+    # --- fault tolerance (TPU-only: the reference's whole failure story is
+    # "surface FetchFailedException and recompute"; these keys harden the
+    # path that gets there — see docs/FAULT_TOLERANCE.md)
+    _Key("heartbeat_interval_ms", 2000, "int", 0, 3600_000,
+         doc="Peer-health heartbeat period for peers with fetches in "
+             "flight; 0 disables the monitor. A peer missing "
+             "heartbeat_misses consecutive beats is declared suspect and "
+             "its outstanding fetches fail immediately instead of waiting "
+             "out a TCP timeout."),
+    _Key("heartbeat_misses", 3, "int", 1, 100,
+         doc="Consecutive missed heartbeats before a peer is declared "
+             "suspect (worst-case detection ~ 2 x interval x misses)."),
+    _Key("fetch_retry_budget", 2, "int", 0, 100,
+         doc="Refetch attempts per remote call beyond the first for "
+             "TRANSIENT failures (connect refusal, request deadline, "
+             "checksum mismatch, transient server error). Fatal outcomes "
+             "(suspect/tombstoned peer, authoritative unknown-map/shuffle) "
+             "escalate to FetchFailed immediately."),
+    _Key("retry_backoff_base_ms", 50, "int", 1, 60_000,
+         doc="Exponential-backoff base between retries (connect re-dials "
+             "and fetch retries); attempt k sleeps in [s/2, s] with "
+             "s = min(cap, base * 2^k) — equal jitter, so the retry "
+             "budget provably spans wall-clock time."),
+    _Key("retry_backoff_cap_ms", 2000, "int", 1, 3600_000,
+         doc="Exponential-backoff ceiling between retries."),
+    _Key("fetch_checksum", True, "bool",
+         doc="CRC32 per block on control-path fetch responses (FLAG_CRC32 "
+             "trailer, computed before compression/codec). Mismatches "
+             "refetch within fetch_retry_budget before escalating to "
+             "FetchFailed. Native block-server responses are unchecksummed "
+             "and verified only when the flag is present."),
+    _Key("request_deadline_ms", 0, "int", 0, 3600_000,
+         doc="Per-request completion deadline on the control plane "
+             "(request/AsyncFetch waits); 0 = fall back to "
+             "connect_timeout_ms. A response landing after the deadline is "
+             "routed to the orphan path so flow-control credits still "
+             "heal."),
 ]
 
 _KEY_MAP: Dict[str, _Key] = {k.name: k for k in _KEYS}
@@ -208,6 +245,13 @@ class TpuShuffleConf:
         if name in _KEY_MAP:
             return self._get(name)
         raise AttributeError(f"unknown config key: {name}")
+
+    def resolved_request_deadline_s(self) -> float:
+        """Per-request completion deadline in seconds: the configured
+        ``request_deadline_ms``, or (when 0) the connect timeout — the
+        pre-deadline behavior, so existing deployments see no change."""
+        ms = self.request_deadline_ms
+        return (ms if ms > 0 else self.connect_timeout_ms) / 1000
 
     def resolved_read_ahead_depth(self) -> int:
         """The effective per-peer read-ahead window: the configured depth,

@@ -33,13 +33,16 @@ from sparkrdma_tpu.config import TpuShuffleConf
 from sparkrdma_tpu.parallel import messages as M
 from sparkrdma_tpu.parallel.rpc_msg import AnnounceMsg, HelloMsg, RpcMsg
 from sparkrdma_tpu.parallel.transport import (
+    ChecksumError,
     Connection,
     ConnectionCache,
     ControlServer,
+    FetchStatusError,
     TransportError,
     await_response,
 )
 from sparkrdma_tpu.shuffle.map_output import DriverTable, MapTaskOutput
+from sparkrdma_tpu.utils import trace as trace_mod
 from sparkrdma_tpu.utils.ids import ShuffleManagerId
 
 log = logging.getLogger(__name__)
@@ -240,6 +243,8 @@ class DriverEndpoint:
             if blob is None:
                 return M.GetBroadcastResp(msg.req_id, M.STATUS_ERROR, b"")
             return M.GetBroadcastResp(msg.req_id, M.STATUS_OK, blob)
+        if isinstance(msg, M.PingMsg):
+            return M.PongMsg(msg.req_id)
         log.warning("driver: unexpected %s", type(msg).__name__)
         return None
 
@@ -499,9 +504,11 @@ class ExecutorEndpoint:
                  driver_addr: Tuple[str, int],
                  data_source: Optional[ShuffleDataSource] = None,
                  conf: Optional[TpuShuffleConf] = None,
-                 engine_port: int = 0, block_port: int = 0):
+                 engine_port: int = 0, block_port: int = 0,
+                 tracer=None):
         self.conf = conf or TpuShuffleConf()
         self.data_source = data_source
+        self.tracer = tracer or trace_mod.NULL
         self.server = ControlServer(manager_id_host, self.conf.executor_port,
                                     self.conf, self._handle,
                                     name=f"exec-{executor}")
@@ -574,6 +581,17 @@ class ExecutorEndpoint:
         self._credit_worker: Optional[threading.Thread] = None
         self._credit_worker_lock = threading.Lock()
         self.prewarm_dials = 0  # audit: successful ahead-of-fetch dials
+        # peer-health monitor: heartbeats go only to peers with fetch
+        # interest registered (watch_peer), so an idle cluster sends no
+        # health traffic; the thread starts lazily on first watch
+        self._hb_lock = threading.Lock()
+        self._hb_watch: Dict[int, Tuple[ShuffleManagerId, int]] = {}
+        self._hb_misses: Dict[int, int] = {}
+        self._hb_suspects: set = set()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_wake = threading.Event()
+        self.suspect_events = 0    # audit: peers declared suspect
+        self.checksum_failures = 0  # audit: CRC32 mismatches on fetches
 
     # -- lifecycle -------------------------------------------------------
 
@@ -590,6 +608,7 @@ class ExecutorEndpoint:
         # before close_all drains it — no window where a fresh dial can
         # outlive this teardown
         self._stopping = True
+        self._hb_wake.set()  # ends the heartbeat monitor, if started
         if self._task_pool is not None:
             self._task_pool.shutdown(wait=False, cancel_futures=True)
         if self._serve_pool is not None:
@@ -636,6 +655,131 @@ class ExecutorEndpoint:
         if m == TOMBSTONE:
             raise DeadExecutorError(f"executor slot {index} was lost")
         return m
+
+    # -- peer health (heartbeat monitor) ---------------------------------
+
+    def watch_peer(self, exec_index: int, peer: ShuffleManagerId) -> None:
+        """Register fetch interest in a peer: the monitor pings watched
+        peers every ``heartbeat_interval_ms`` and declares one suspect
+        after ``heartbeat_misses`` consecutive missed beats — failing its
+        outstanding fetches promptly instead of letting them wait out a
+        TCP timeout. Refcounted; pair with :meth:`unwatch_peer`."""
+        if self.conf.heartbeat_interval_ms <= 0 or self._stopping:
+            return
+        with self._hb_lock:
+            _, count = self._hb_watch.get(exec_index, (peer, 0))
+            self._hb_watch[exec_index] = (peer, count + 1)
+            if self._hb_thread is None:
+                self._hb_thread = threading.Thread(
+                    target=self._hb_loop, daemon=True,
+                    name=f"hb-{self.manager_id.executor_id.executor}")
+                self._hb_thread.start()
+
+    def unwatch_peer(self, exec_index: int) -> None:
+        with self._hb_lock:
+            entry = self._hb_watch.get(exec_index)
+            if entry is None:
+                return
+            peer, count = entry
+            if count <= 1:
+                self._hb_watch.pop(exec_index, None)
+                self._hb_misses.pop(exec_index, None)
+            else:
+                self._hb_watch[exec_index] = (peer, count - 1)
+
+    def peer_suspect(self, exec_index: int) -> bool:
+        """True once the monitor has declared this slot dead: fetchers
+        fail fast into FetchFailed (stage retry) instead of retrying."""
+        with self._hb_lock:
+            return exec_index in self._hb_suspects
+
+    def declare_suspect(self, exec_index: int, peer: ShuffleManagerId,
+                        reason: str) -> None:
+        """The monitor's verdict (also callable by tests/engines that
+        learned of a death out of band): mark the slot, then close the
+        cached connections to the peer so every outstanding request on
+        them fails NOW — ``_fail_pending`` turns a silent peer death into
+        immediate TransportErrors for the whole in-flight window."""
+        with self._hb_lock:
+            if exec_index in self._hb_suspects:
+                return
+            self._hb_suspects.add(exec_index)
+            self.suspect_events += 1
+        log.warning("%s: peer slot %d (%s:%s) declared suspect: %s",
+                    self.manager_id.executor_id.executor, exec_index,
+                    peer.rpc_host, peer.rpc_port, reason)
+        self.tracer.instant("peer.suspect", "fault", peer=exec_index,
+                            reason=reason)
+        self.tracer.counter("peer.suspects", self.suspect_events, "fault")
+        self._clients.drop(peer.rpc_host, peer.rpc_port)
+        if peer.block_port:
+            self._clients.drop(peer.rpc_host, peer.block_port)
+
+    def health_snapshot(self) -> dict:
+        with self._hb_lock:
+            return {
+                "watched": {i: n for i, (_, n) in self._hb_watch.items()},
+                "misses": dict(self._hb_misses),
+                "suspects": sorted(self._hb_suspects),
+                "suspect_events": self.suspect_events,
+            }
+
+    def _hb_loop(self) -> None:
+        interval = self.conf.heartbeat_interval_ms / 1000
+        while not self._stopping and not self.server.stopped:
+            if self._hb_wake.wait(interval):
+                return  # stop() woke us
+            with self._hb_lock:
+                targets = [(i, peer) for i, (peer, _) in
+                           self._hb_watch.items()
+                           if i not in self._hb_suspects]
+            pings = []
+            for i, peer in targets:
+                if self._stopping:
+                    return
+                # peek, never dial: the monitor exists for peers the
+                # fetch path is ALREADY talking to over a looks-alive
+                # connection. Dialing here would stall the whole beat on
+                # one unreachable peer's connect budget (and could mint a
+                # fresh connection after stop()'s close_all); a missing
+                # connection means the fetch path is dialing itself and
+                # its own failure handling owns reachability.
+                conn = self._clients.peek(peer.rpc_host, peer.rpc_port)
+                if conn is None:
+                    continue
+                try:
+                    pings.append((i, peer, conn.request_async(
+                        M.PingMsg(conn.next_req_id()))))
+                except TransportError:
+                    self._hb_miss(i, peer, "send failed")
+            # collect pongs within one interval so a silent peer costs
+            # exactly one beat, not a stacked-timeout multiple of it
+            deadline = time.monotonic() + interval
+            for i, peer, fut in pings:
+                try:
+                    resp = await_response(
+                        fut, max(0.001, deadline - time.monotonic()))
+                    if not isinstance(resp, M.PongMsg):
+                        # wrong echo counts as a miss, never kills the
+                        # monitor thread
+                        raise TransportError(
+                            f"bad pong: {type(resp).__name__}")
+                    with self._hb_lock:
+                        self._hb_misses.pop(i, None)
+                except (TimeoutError, TransportError):
+                    # await_response cancelled the future on timeout, so
+                    # a late pong lands on the unsolicited path harmlessly
+                    self._hb_miss(i, peer, "missed beat")
+
+    def _hb_miss(self, exec_index: int, peer: ShuffleManagerId,
+                 kind: str) -> None:
+        with self._hb_lock:
+            n = self._hb_misses.get(exec_index, 0) + 1
+            self._hb_misses[exec_index] = n
+        if n >= self.conf.heartbeat_misses:
+            self.declare_suspect(
+                exec_index, peer,
+                f"{n} consecutive missed heartbeats ({kind})")
 
     # -- connection pre-warming ------------------------------------------
 
@@ -722,6 +866,18 @@ class ExecutorEndpoint:
             return None
         if isinstance(msg, M.RunTaskReq):
             return self._on_run_task(conn, msg)
+        if isinstance(msg, M.PingMsg):
+            return M.PongMsg(msg.req_id)
+        if isinstance(msg, M.PongMsg):
+            return None  # pong landed after its ping's deadline: stale
+        if isinstance(msg, (M.FetchOutputResp, M.FetchTableResp)):
+            # orphan of a cancelled/timed-out request (the fetcher
+            # cancels whole read-ahead windows on failure); unlike block
+            # responses these carry no credits, so dropping is complete
+            log.debug("%s: stale %s (requester gave up)",
+                      self.manager_id.executor_id.executor,
+                      type(msg).__name__)
+            return None
         log.warning("%s: unexpected %s", self.manager_id.executor_id.executor,
                     type(msg).__name__)
         return None
@@ -915,6 +1071,16 @@ class ExecutorEndpoint:
             parts.append(data)
         payload = b"".join(parts)
         flags = 0
+        if self.conf.fetch_checksum and msg.blocks:
+            # per-block CRC32 trailer, appended BEFORE compression/codec
+            # so the check spans server read -> client consume (a zlib or
+            # codec layer already fails loudly on ITS OWN wire bytes, but
+            # says nothing about corruption before the encode)
+            import struct
+            import zlib
+            flags |= M.FLAG_CRC32
+            payload += struct.pack(f"<{len(parts)}I",
+                                   *(zlib.crc32(p) for p in parts))
         # DCN wire compression — the analogue of the engine-level shuffle
         # block compression the reference inherits from Spark's serializer
         # (scala/RdmaShuffleReader.scala:54-69 wraps streams the same way).
@@ -923,7 +1089,10 @@ class ExecutorEndpoint:
             import zlib
             compressed = zlib.compress(payload, level=1)
             if len(compressed) < len(payload):
-                payload, flags = compressed, M.FLAG_ZLIB
+                # OR into flags: the CRC32 trailer (if any) rides inside
+                # the compressed bytes and must stay flagged for the
+                # reader to verify and strip after decompressing
+                payload, flags = compressed, flags | M.FLAG_ZLIB
         if self._codec is not None:
             flags |= M.FLAG_WRAPPED
             payload = self._codec.wrap(payload, self._codec_key,
@@ -1000,13 +1169,28 @@ class ExecutorEndpoint:
             self._table_cache.pop(shuffle_id, None)
             self._table_gen += 1
 
+    def _failed_fetch(self, exc: TransportError) -> AsyncFetch:
+        """An AsyncFetch that already failed (the dial threw before a
+        request existed): issue paths stay non-raising so EVERY transport
+        failure — connect refusal included — surfaces at ``result()``,
+        where the fetcher's one retry envelope owns the policy."""
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        fut.set_exception(exc)
+        return AsyncFetch(fut, self.conf.resolved_request_deadline_s(),
+                          lambda resp: resp)
+
     def fetch_output_range_async(self, peer: ShuffleManagerId,
                                  shuffle_id: int, map_id: int, start: int,
                                  end: int) -> AsyncFetch:
         """Issue one block-location read without waiting for it: the
         fetcher's read-ahead window keeps several of these in flight per
         peer over the pipelined connection."""
-        conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        try:
+            conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        except TransportError as e:
+            return self._failed_fetch(e)
         fut = conn.request_async(
             M.FetchOutputReq(conn.next_req_id(), shuffle_id, map_id,
                              start, end))
@@ -1014,10 +1198,14 @@ class ExecutorEndpoint:
         def complete(resp):
             assert isinstance(resp, M.FetchOutputResp)
             if resp.status != M.STATUS_OK:
-                raise TransportError(f"fetch_output status={resp.status}")
+                # the owner answered authoritatively: it does not have the
+                # map/range the driver table promised — a refetch re-fails
+                # identically, only a recompute heals it
+                raise FetchStatusError("fetch_output", resp.status,
+                                       retryable=False)
             return MapTaskOutput.locations_from_range(resp.entries)
 
-        return AsyncFetch(fut, self.conf.connect_timeout_ms / 1000,
+        return AsyncFetch(fut, self.conf.resolved_request_deadline_s(),
                           complete)
 
     def fetch_output_range(self, peer: ShuffleManagerId, shuffle_id: int,
@@ -1132,7 +1320,10 @@ class ExecutorEndpoint:
                 if peer.block_port and not self.conf.wire_compress
                 and self._codec is None
                 else peer.rpc_port)
-        conn = self._clients.get(peer.rpc_host, port)
+        try:
+            conn = self._clients.get(peer.rpc_host, port)
+        except TransportError as e:
+            return self._failed_fetch(e)
         req = M.FetchBlocksReq(conn.next_req_id(), shuffle_id, blocks)
         registered = self._register_credit(conn, req,
                                            credited=port == peer.rpc_port)
@@ -1175,10 +1366,16 @@ class ExecutorEndpoint:
                                               credited=True)
                 assert isinstance(resp, M.FetchBlocksResp)
             if resp.status != M.STATUS_OK:
-                raise TransportError(f"fetch_blocks status={resp.status}")
+                # STATUS_ERROR is the transient class (credit-window
+                # expiry under a stalled consumer, serving hiccup) — a
+                # refetch usually heals it; unknown-token/shuffle and
+                # bad-range answers are authoritative re-failures
+                raise FetchStatusError(
+                    "fetch_blocks", resp.status,
+                    retryable=resp.status == M.STATUS_ERROR)
             return self._decode_blocks_resp(final_req, resp)
 
-        return AsyncFetch(fut, self.conf.connect_timeout_ms / 1000,
+        return AsyncFetch(fut, self.conf.resolved_request_deadline_s(),
                           complete)
 
     def fetch_blocks(self, peer: ShuffleManagerId, shuffle_id: int,
@@ -1209,5 +1406,43 @@ class ExecutorEndpoint:
                 raise TransportError(f"fetch_blocks unwrap failed: {e}") from e
         if resp.flags & M.FLAG_ZLIB:
             import zlib
-            return zlib.decompress(data)
+            try:
+                data = zlib.decompress(data)
+            except zlib.error as e:
+                # a wire bit-flip lands here on compressed payloads; the
+                # retryable-checksum class routes it into the bounded
+                # refetch path like an uncompressed CRC mismatch
+                raise ChecksumError(
+                    f"fetch_blocks payload failed to decompress: {e}") from e
+        if resp.flags & M.FLAG_CRC32:
+            data = self._verify_block_crcs(req, data)
         return data
+
+    def _verify_block_crcs(self, req: "M.FetchBlocksReq",
+                           data: bytes) -> bytes:
+        """Check and strip the per-block CRC32 trailer. Block lengths come
+        from the REQUEST (both sides derive the layout independently —
+        the trailer can't lie about where blocks start). Raises the
+        retryable :class:`ChecksumError`; the fetcher refetches within
+        its budget before escalating to FetchFailed."""
+        import struct
+        import zlib
+        n = len(req.blocks)
+        lengths = [length for _, _, length in req.blocks]
+        body_len = len(data) - 4 * n
+        if body_len != sum(lengths):
+            self.checksum_failures += 1
+            raise ChecksumError(
+                f"fetch_blocks payload size mismatch: {body_len} data "
+                f"bytes for {sum(lengths)} requested")
+        crcs = struct.unpack_from(f"<{n}I", data, body_len)
+        body = memoryview(data)[:body_len]
+        pos = 0
+        for i, length in enumerate(lengths):
+            if zlib.crc32(body[pos:pos + length]) != crcs[i]:
+                self.checksum_failures += 1
+                raise ChecksumError(
+                    f"fetch_blocks block {i}/{n} failed CRC32 "
+                    f"(corruption in flight or at the server)")
+            pos += length
+        return bytes(body)

@@ -1,0 +1,271 @@
+"""Deterministic fault injection for the control-plane transport.
+
+The chaos harness the hardened failure path is tested with: a
+:class:`FaultInjector` wraps a live :class:`ConnectionCache` (and every
+``Connection`` it mints) and injects seeded, scenario-scripted faults at
+the exact layers real failures enter — the dial, the send, and the
+receive dispatch — so every failure mode the fetch path must survive
+(connect refusal, mid-stream disconnect, response delay, payload
+bit-flips, blackhole/partition) is reproducible in-process over plain
+sockets.
+
+Faults match on ``(kind, peer, message type, direction)`` with
+``after``/``times`` windows and an optional per-match probability drawn
+from the injector's seeded RNG, so probabilistic scenarios replay
+exactly from their seed (``scripts/run_chaos.sh`` prints the seed of a
+failing sweep for replay). The shim leaves everything above it untouched
+— endpoints, fetcher, recovery — which is the point: the failure path
+under test is the production one, not a mock of it.
+
+The reference has no equivalent; its fault story was never testable
+below "kill a JVM and watch Spark recompute" (SURVEY §7 hard part #4).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple, Type
+
+from sparkrdma_tpu.parallel.transport import (
+    Connection,
+    ConnectionCache,
+    TransportError,
+)
+
+log = logging.getLogger(__name__)
+
+Addr = Tuple[str, int]
+
+# Fault kinds.
+REFUSE_CONNECT = "refuse_connect"  # the dial raises ConnectionRefusedError
+DISCONNECT = "disconnect"          # the connection closes when the match
+#                                    fires (recv: response lost + whole
+#                                    window failed; send: reset mid-send)
+DELAY = "delay"                    # hold the matched message delay_s on
+#                                    the delivering/sending thread
+CORRUPT = "corrupt"                # flip bits of the matched message's
+#                                    payload attribute before delivery
+BLACKHOLE = "blackhole"            # drop the matched message silently
+#                                    (partition: the requester's deadline
+#                                    or heartbeat owns detection)
+
+KINDS = (REFUSE_CONNECT, DISCONNECT, DELAY, CORRUPT, BLACKHOLE)
+
+
+@dataclass
+class Fault:
+    """One scripted fault. Matching is AND across the set criteria;
+    unset criteria match anything. ``after`` skips the first N matches
+    (arm the fault mid-run), ``times`` bounds firings (a burst),
+    ``prob`` gates each firing on the injector's seeded RNG."""
+
+    kind: str
+    peer: Optional[Addr] = None
+    msg_type: Optional[Type] = None   # ignored by refuse_connect
+    on: str = "recv"                  # "recv" | "send" (non-connect kinds)
+    after: int = 0
+    times: Optional[int] = None
+    prob: float = 1.0
+    delay_s: float = 0.0              # DELAY
+    flip_bits: int = 1                # CORRUPT
+    attr: str = "data"                # CORRUPT: message field to mutate
+    seen: int = 0                     # matches observed (post-filter)
+    fired: int = 0                    # faults actually injected
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+class FaultInjector:
+    """Seeded chaos shim over one or more ``ConnectionCache``s.
+
+    Thread-safe: connection reader threads, fetch threads, and the
+    heartbeat monitor all consult the same fault table. ``install`` is
+    reversible per cache (``uninstall``); connections already wrapped
+    stay wrapped until closed, which chaos tests do anyway.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self._lock = threading.RLock()
+        self._faults: List[Fault] = []
+        self._installed: List[Tuple[ConnectionCache, Callable]] = []
+        self.fired: Dict[str, int] = {}
+
+    # -- scripting -------------------------------------------------------
+
+    def add(self, kind: str, **kw) -> Fault:
+        fault = Fault(kind, **kw)
+        with self._lock:
+            self._faults.append(fault)
+        return fault
+
+    def clear(self) -> None:
+        with self._lock:
+            self._faults.clear()
+
+    def fired_count(self, kind: Optional[str] = None) -> int:
+        with self._lock:
+            if kind is not None:
+                return self.fired.get(kind, 0)
+            return sum(self.fired.values())
+
+    # -- installation ----------------------------------------------------
+
+    def install(self, cache: ConnectionCache) -> None:
+        """Shadow the cache's per-attempt ``_dial`` (connect faults) and
+        its ``_connect`` (to wrap each minted ``Connection``'s send and
+        dispatch). Idempotent per cache."""
+        with self._lock:
+            if any(c is cache for c, _ in self._installed):
+                return
+
+            orig_dial = cache._dial
+            orig_connect = cache._connect
+
+            def dial(addr, timeout, _orig=orig_dial):
+                if self._match(REFUSE_CONNECT, peer=addr) is not None:
+                    raise ConnectionRefusedError(
+                        f"fault injection: connect to {addr} refused")
+                return _orig(addr, timeout)
+
+            def connect(addr, _orig=orig_connect):
+                conn = _orig(addr)
+                self._wrap_conn(conn, addr)
+                return conn
+
+            orig_get = cache.get
+
+            def get(host, port, _orig=orig_get):
+                # ensure-wrap on every lookup (idempotent): a dial that
+                # was already in flight when install() ran — prewarm
+                # threads race exactly this way — inserts its connection
+                # past both the connect shim and the snapshot below
+                conn = _orig(host, port)
+                self._wrap_conn(conn, (host, port))
+                return conn
+
+            # instance attributes shadow the class methods; _connect's
+            # internal self._dial lookup resolves to the shim
+            cache._dial = dial
+            cache._connect = connect
+            cache.get = get
+
+            def restore(cache=cache):
+                cache.__dict__.pop("_dial", None)
+                cache.__dict__.pop("_connect", None)
+                cache.__dict__.pop("get", None)
+
+            self._installed.append((cache, restore))
+            # connections minted before install get wrapped too, so a
+            # mid-run install sees pre-warmed/cached peers
+            with cache._lock:
+                existing = list(cache._conns.items())
+        for addr, conn in existing:
+            self._wrap_conn(conn, addr)
+
+    def install_endpoint(self, endpoint) -> None:
+        """Convenience: shim an endpoint's client-side connection cache
+        (covers fetches, heartbeats, and driver traffic it originates)."""
+        self.install(endpoint._clients)
+
+    def uninstall(self) -> None:
+        with self._lock:
+            installed, self._installed = self._installed, []
+        for _cache, restore in installed:
+            restore()
+
+    # -- fault application -----------------------------------------------
+
+    def _wrap_conn(self, conn: Connection, addr: Addr) -> None:
+        if getattr(conn, "_fault_wrapped", False):
+            return
+        conn._fault_wrapped = True
+        orig_dispatch = conn._dispatch
+        orig_send = conn.send
+
+        def dispatch(msg, _orig=orig_dispatch, _addr=addr):
+            fault = self._match(DELAY, peer=_addr, msg=msg, on="recv")
+            if fault is not None:
+                # on the reader thread on purpose: later messages on this
+                # connection stall behind the delay, exactly like a
+                # congested or GC-pausing peer — the window the
+                # claim-back-race tests pin open
+                time.sleep(fault.delay_s)
+            if self._match(BLACKHOLE, peer=_addr, msg=msg,
+                           on="recv") is not None:
+                log.debug("fault injection: blackholed %s from %s",
+                          type(msg).__name__, _addr)
+                return
+            fault = self._match(CORRUPT, peer=_addr, msg=msg, on="recv")
+            if fault is not None:
+                self._corrupt(msg, fault)
+            if self._match(DISCONNECT, peer=_addr, msg=msg,
+                           on="recv") is not None:
+                log.debug("fault injection: disconnect from %s before "
+                          "delivering %s", _addr, type(msg).__name__)
+                conn.close()
+                return
+            _orig(msg)
+
+        def send(msg, _orig=orig_send, _addr=addr):
+            fault = self._match(DELAY, peer=_addr, msg=msg, on="send")
+            if fault is not None:
+                time.sleep(fault.delay_s)
+            if self._match(BLACKHOLE, peer=_addr, msg=msg,
+                           on="send") is not None:
+                return  # peer never sees it; the deadline owns the rest
+            if self._match(DISCONNECT, peer=_addr, msg=msg,
+                           on="send") is not None:
+                conn.close()
+                raise TransportError(
+                    f"{conn.name}: fault injection: reset mid-send")
+            _orig(msg)
+
+        conn._dispatch = dispatch
+        conn.send = send
+
+    def _corrupt(self, msg, fault: Fault) -> None:
+        data = getattr(msg, fault.attr, None)
+        if not data:
+            return
+        buf = bytearray(data)
+        for _ in range(max(1, fault.flip_bits)):
+            with self._lock:
+                i = self.rng.randrange(len(buf))
+                bit = 1 << self.rng.randrange(8)
+            buf[i] ^= bit
+        setattr(msg, fault.attr, bytes(buf))
+        log.debug("fault injection: flipped %d bit(s) in %s.%s",
+                  max(1, fault.flip_bits), type(msg).__name__, fault.attr)
+
+    def _match(self, kind: str, peer: Addr, msg=None,
+               on: str = "recv") -> Optional[Fault]:
+        with self._lock:
+            for fault in self._faults:
+                if fault.kind != kind:
+                    continue
+                if kind != REFUSE_CONNECT and fault.on != on:
+                    continue
+                if fault.peer is not None and fault.peer != peer:
+                    continue
+                if (fault.msg_type is not None
+                        and not isinstance(msg, fault.msg_type)):
+                    continue
+                fault.seen += 1
+                if fault.seen <= fault.after:
+                    continue
+                if fault.times is not None and fault.fired >= fault.times:
+                    continue
+                if fault.prob < 1.0 and self.rng.random() >= fault.prob:
+                    continue
+                fault.fired += 1
+                self.fired[kind] = self.fired.get(kind, 0) + 1
+                return fault
+        return None

@@ -176,6 +176,12 @@ FLAG_ZLIB = 1     # FetchBlocksResp.flags: payload is zlib-compressed
 FLAG_WRAPPED = 2  # payload passed through the configured wire codec
                   # (utils/codecs.py; applied after compression, so
                   # readers unwrap first)
+FLAG_CRC32 = 4    # the logical payload carries a trailer of one
+                  # little-endian u32 CRC32 per requested block, appended
+                  # BEFORE compression/codec so the check is end-to-end
+                  # (server read -> client consume). Readers verify and
+                  # strip; responders that can't checksum (native block
+                  # server) simply don't set the flag.
 
 _QII = struct.Struct("<qii")
 
@@ -296,6 +302,41 @@ class GetBroadcastResp(RpcMsg):
     def from_payload(cls, payload: bytes) -> "GetBroadcastResp":
         req_id, status = struct.unpack_from("<qi", payload, 0)
         return cls(req_id, status, payload[12:])
+
+
+@register(16)
+class PingMsg(RpcMsg):
+    """Peer-health probe (endpoint heartbeat monitor): carries a
+    ``req_id`` so it rides the same ``request_async`` pipelining as
+    fetches — a pong is just the echoed completion. Deliberately tiny:
+    the monitor's cost must stay negligible next to data traffic."""
+
+    def __init__(self, req_id: int):
+        self.req_id = req_id
+
+    def payload(self) -> bytes:
+        return _Q.pack(self.req_id)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "PingMsg":
+        (req_id,) = _Q.unpack_from(payload, 0)
+        return cls(req_id)
+
+
+@register(17)
+class PongMsg(RpcMsg):
+    """Echoed heartbeat completion."""
+
+    def __init__(self, req_id: int):
+        self.req_id = req_id
+
+    def payload(self) -> bytes:
+        return _Q.pack(self.req_id)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "PongMsg":
+        (req_id,) = _Q.unpack_from(payload, 0)
+        return cls(req_id)
 
 
 # Status codes shared by responses.

@@ -25,8 +25,10 @@ from __future__ import annotations
 
 import itertools
 import logging
+import random
 import socket
 import threading
+import time
 from concurrent.futures import Future, InvalidStateError
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Callable, Dict, Optional, Tuple
@@ -40,7 +42,67 @@ Addr = Tuple[str, int]
 
 
 class TransportError(RuntimeError):
-    pass
+    """Base transport failure. ``retryable`` classifies the outcome for
+    the fetch retry envelope: connection loss / connect failure default to
+    retryable (a re-dial or refetch usually heals); subclasses and raisers
+    that know better override it (an authoritative unknown-map answer
+    re-fails identically — retrying just doubles failure-path load)."""
+
+    retryable = True
+
+
+class ChecksumError(TransportError):
+    """A fetch payload failed its CRC32 verification (bit-flip on the
+    wire, or corruption at the server between read and send). Always
+    retryable: the refetch re-reads the source bytes."""
+
+
+class FetchStatusError(TransportError):
+    """A peer answered a fetch with a non-OK status. The raiser sets
+    ``retryable`` from the status semantics it knows: transient
+    server-side failures (credit-window expiry) heal on refetch,
+    authoritative rejections (unknown map/shuffle, bad range) do not."""
+
+    def __init__(self, what: str, status: int, retryable: bool = True):
+        super().__init__(f"{what} status={status}")
+        self.status = status
+        self.retryable = retryable
+
+
+class Backoff:
+    """Exponential backoff with equal jitter: attempt ``k`` (0-based)
+    sleeps in ``[s/2, s]`` where ``s = min(cap, base * 2^k)``. Equal
+    jitter rather than full jitter so a retry budget provably spans
+    wall-clock time (full jitter can draw ~0 on every attempt, turning
+    the budget back into the hot-spin it exists to prevent) while still
+    decorrelating the retry storms of many peers. A seeded ``rng`` makes
+    chaos scenarios replay exactly."""
+
+    def __init__(self, base_s: float, cap_s: float,
+                 rng: Optional[random.Random] = None):
+        self.base_s = max(0.0, base_s)
+        self.cap_s = max(self.base_s, cap_s)
+        self._rng = rng if rng is not None else random
+
+    @classmethod
+    def from_conf(cls, conf: TpuShuffleConf,
+                  rng: Optional[random.Random] = None) -> "Backoff":
+        return cls(conf.retry_backoff_base_ms / 1000,
+                   conf.retry_backoff_cap_ms / 1000, rng)
+
+    def delay(self, attempt: int) -> float:
+        span = min(self.cap_s, self.base_s * (1 << max(0, min(attempt, 60))))
+        return span / 2 + self._rng.uniform(0, span / 2)
+
+    def sleep(self, attempt: int,
+              interrupt: Optional[threading.Event] = None) -> bool:
+        """Sleep out attempt ``attempt``'s delay; with ``interrupt``, an
+        abort wakes the sleep early (returns True iff interrupted)."""
+        d = self.delay(attempt)
+        if interrupt is not None:
+            return interrupt.wait(d)
+        time.sleep(d)
+        return False
 
 
 def await_response(fut: Future, timeout: Optional[float]) -> RpcMsg:
@@ -157,9 +219,12 @@ class Connection:
         return fut
 
     def request(self, msg: RpcMsg, timeout: Optional[float] = None) -> RpcMsg:
-        """Send a req_id-bearing message and wait for the echoed response."""
+        """Send a req_id-bearing message and wait for the echoed response
+        (default wait: the per-request deadline, ``request_deadline_ms``,
+        falling back to the connect timeout)."""
         fut = self.request_async(msg)
-        tmo = timeout if timeout is not None else self._conf.connect_timeout_ms / 1000
+        tmo = (timeout if timeout is not None
+               else self._conf.resolved_request_deadline_s())
         return await_response(fut, tmo)
 
     # -- receiving -------------------------------------------------------
@@ -285,7 +350,20 @@ class ControlServer:
             conn = Connection(sock, self._conf, on_message=self._handler,
                              name=f"{self.name}<-{addr[0]}:{addr[1]}")
             with self._conns_lock:
+                # reap connections whose reader died (peer went away):
+                # accepted conns are otherwise append-only and a
+                # long-lived server accumulates one dead entry per client
+                # lifetime, without bound
+                self._conns = [c for c in self._conns if not c.closed]
                 self._conns.append(conn)
+
+    def live_connections(self) -> int:
+        """Count of accepted connections whose reader is still alive
+        (reaps dead entries as a side effect — the audit surface for the
+        leak the accept-time reap closes)."""
+        with self._conns_lock:
+            self._conns = [c for c in self._conns if not c.closed]
+            return len(self._conns)
 
     @property
     def stopped(self) -> bool:
@@ -340,14 +418,26 @@ class ConnectionCache:
             self._conns[addr] = conn
         return conn
 
+    def _dial(self, addr: Addr, timeout: float) -> socket.socket:
+        """One connect attempt, separated from the retry loop so the
+        fault shim can refuse/delay individual dials."""
+        sock = socket.create_connection(addr, timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        return sock
+
     def _connect(self, addr: Addr) -> Connection:
         timeout = self._conf.connect_timeout_ms / 1000
+        backoff = Backoff.from_conf(self._conf)
         last: Optional[Exception] = None
         for attempt in range(max(1, self._conf.max_connection_attempts)):
+            if attempt:
+                # between attempts only — a refused dial re-tried with
+                # zero sleep burns the whole budget in microseconds, so
+                # the budget never spans the restart it exists to ride out
+                backoff.sleep(attempt - 1)
             try:
-                sock = socket.create_connection(addr, timeout=timeout)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                sock.settimeout(None)
+                sock = self._dial(addr, timeout)
                 return Connection(sock, self._conf, on_message=self._on_message,
                                   name=f"->{addr[0]}:{addr[1]}")
             except OSError as e:
@@ -355,6 +445,28 @@ class ConnectionCache:
         raise TransportError(
             f"connect to {addr} failed after "
             f"{self._conf.max_connection_attempts} attempts: {last}")
+
+    def peek(self, host: str, port: int) -> Optional[Connection]:
+        """The cached live connection to ``(host, port)``, or None —
+        never dials (the heartbeat monitor pings only over connections
+        the fetch path already holds; a monitor that dialed would stall
+        a whole beat on one unreachable peer's connect budget)."""
+        with self._lock:
+            conn = self._conns.get((host, port))
+        return conn if conn is not None and not conn.closed else None
+
+    def drop(self, host: str, port: int) -> bool:
+        """Close and forget the cached connection to ``(host, port)``
+        WITHOUT dialing (the peer-health monitor's suspect path: closing
+        fails every outstanding request on it immediately instead of
+        letting them wait out a TCP timeout). Returns True if a cached
+        connection existed."""
+        with self._lock:
+            conn = self._conns.pop((host, port), None)
+        if conn is None:
+            return False
+        conn.close()
+        return True
 
     def close_all(self) -> None:
         with self._lock:

@@ -41,7 +41,11 @@ from sparkrdma_tpu.parallel.endpoints import (
     DeadExecutorError,
     ExecutorEndpoint,
 )
-from sparkrdma_tpu.parallel.transport import TransportError
+from sparkrdma_tpu.parallel.transport import (
+    Backoff,
+    ChecksumError,
+    TransportError,
+)
 from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
 from sparkrdma_tpu.utils.stats import FetchPipelineStats
 
@@ -90,6 +94,11 @@ class ReadMetrics:
     local_fetches: int = 0
     fetch_wait_s: float = 0.0
     fetch_latencies_s: List[float] = field(default_factory=list)
+    # failure path: transient retries absorbed, CRC mismatches refetched,
+    # terminal failures escalated to FetchFailed (stage retry)
+    retries: int = 0
+    checksum_failures: int = 0
+    failed_fetches: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_remote(self, nbytes: int, latency_s: float) -> None:
@@ -102,6 +111,18 @@ class ReadMetrics:
         with self._lock:
             self.local_bytes += nbytes
             self.local_fetches += 1
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def record_checksum_failure(self) -> None:
+        with self._lock:
+            self.checksum_failures += 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failed_fetches += 1
 
 
 @dataclass
@@ -147,6 +168,9 @@ class ShuffleFetcher:
         self._failed = False
         self._aborted = threading.Event()
         self._rng = random.Random(seed)
+        # retry backoff shares the fetcher seed so a chaos scenario's
+        # sleep schedule replays with it
+        self._backoff = Backoff.from_conf(conf, rng=random.Random(seed))
         self._threads: List[threading.Thread] = []
 
     # -- setup: plan + launch (initialize/startAsyncRemoteFetches) -------
@@ -232,11 +256,19 @@ class ShuffleFetcher:
         try:
             peer = self.endpoint.member_at(exec_idx)
             depth = self.conf.resolved_read_ahead_depth()
-            if depth <= 1:
-                self._fetch_sequential(peer, exec_idx, maps, count_lock)
-            else:
-                self._fetch_pipelined(peer, exec_idx, maps, count_lock,
-                                      depth)
+            # register heartbeat interest for the duration of the fetch:
+            # if the peer dies silently mid-window, the monitor closes the
+            # connection (failing the window NOW) and marks the slot
+            # suspect so the retry envelope escalates instead of re-dialing
+            self.endpoint.watch_peer(exec_idx, peer)
+            try:
+                if depth <= 1:
+                    self._fetch_sequential(peer, exec_idx, maps, count_lock)
+                else:
+                    self._fetch_pipelined(peer, exec_idx, maps, count_lock,
+                                          depth)
+            finally:
+                self.endpoint.unwatch_peer(exec_idx)
         except _Aborted:
             pass  # consumer went away; exit quietly
         except Exception as e:  # noqa: BLE001 — ANY peer-thread failure must
@@ -282,6 +314,81 @@ class ShuffleFetcher:
                 self.start_partition + len(locs), group, group_bytes))
         return pending
 
+    # -- retry envelope (deadline + backoff, transient vs fatal) ---------
+
+    def _suspect_check(self, exec_idx: int, map_id: int) -> None:
+        if self.endpoint.peer_suspect(exec_idx):
+            raise FetchFailedError(
+                self.shuffle_id, map_id, exec_idx,
+                "peer declared suspect by the heartbeat monitor")
+
+    def _note_transient(self, e: BaseException, what: str, exec_idx: int,
+                        map_id: int, will_retry: bool, attempt: int) -> None:
+        if isinstance(e, ChecksumError):
+            self.metrics.record_checksum_failure()
+            if self.reader_stats is not None:
+                self.reader_stats.failures.incr("checksum_mismatches")
+        if will_retry:
+            self.metrics.record_retry()
+            if self.reader_stats is not None:
+                self.reader_stats.failures.incr("fetch_retries")
+            self.tracer.instant("fetch.retry", "fault", what=what,
+                                peer=exec_idx, map=map_id,
+                                attempt=attempt, error=type(e).__name__)
+            log.debug("fetch retry %d (%s, map %d, peer %d): %s",
+                      attempt, what, map_id, exec_idx, e)
+
+    def _fail(self, what: str, exec_idx: int, map_id: int, consumed: int,
+              err: BaseException):
+        self.metrics.record_failure()
+        if self.reader_stats is not None:
+            self.reader_stats.failures.incr("fetch_failures")
+        raise FetchFailedError(
+            self.shuffle_id, map_id, exec_idx,
+            f"{what} failed after {consumed} attempt(s): {err}") from err
+
+    def _with_retries(self, what: str, exec_idx: int, map_id: int, fn,
+                      first_error: Optional[BaseException] = None):
+        """Run one remote call under the failure policy: TRANSIENT
+        outcomes (connection loss, connect refusal, request deadline,
+        CRC mismatch, transient server status) retry with exponential
+        backoff + jitter up to ``fetch_retry_budget``; FATAL outcomes
+        (suspect peer, authoritative non-OK status, protocol bugs)
+        escalate immediately as :class:`FetchFailedError` so
+        ``run_reduce_with_retry`` recomputes the stage. ``first_error``
+        charges an already-failed async attempt against the budget (the
+        pipelined window's in-flight issue was attempt one)."""
+        attempts = 1 + max(0, self.conf.fetch_retry_budget)
+        consumed = 0
+        if first_error is not None:
+            consumed = 1
+            retryable = (getattr(first_error, "retryable", True)
+                         and not isinstance(first_error, AssertionError))
+            self._note_transient(first_error, what, exec_idx, map_id,
+                                 retryable and consumed < attempts, consumed)
+            if not retryable or consumed >= attempts:
+                self._fail(what, exec_idx, map_id, consumed, first_error)
+            self._suspect_check(exec_idx, map_id)
+            if self._aborted.wait(self._backoff.delay(consumed - 1)):
+                raise _Aborted()
+        while True:
+            if self._aborted.is_set():
+                raise _Aborted()
+            self._suspect_check(exec_idx, map_id)
+            try:
+                return fn()
+            except (TransportError, TimeoutError, AssertionError) as e:
+                consumed += 1
+                retryable = (getattr(e, "retryable", True)
+                             and not isinstance(e, AssertionError))
+                self._note_transient(e, what, exec_idx, map_id,
+                                     retryable and consumed < attempts,
+                                     consumed)
+                if not retryable or consumed >= attempts:
+                    self._fail(what, exec_idx, map_id, consumed, e)
+                if self._aborted.wait(self._backoff.delay(consumed - 1)):
+                    raise _Aborted()
+
     def _fetch_sequential(self, peer, exec_idx: int, maps: List[int],
                           count_lock: threading.Lock) -> None:
         """``read_ahead_depth=1``: the fully serialized fetch — every
@@ -291,11 +398,14 @@ class ShuffleFetcher:
         pending: List[_PendingFetch] = []
         for m in maps:
             # STEP 2: block locations (:293-315).
-            with self.tracer.span("fetch.locations", "fetch",
-                                  map=m, peer=exec_idx):
-                locs = self.endpoint.fetch_output_range(
-                    peer, self.shuffle_id, m,
-                    self.start_partition, self.end_partition)
+            def read_locs(m=m):
+                with self.tracer.span("fetch.locations", "fetch",
+                                      map=m, peer=exec_idx):
+                    return self.endpoint.fetch_output_range(
+                        peer, self.shuffle_id, m,
+                        self.start_partition, self.end_partition)
+
+            locs = self._with_retries("locations", exec_idx, m, read_locs)
             pending.extend(self._group_locations(exec_idx, m, locs))
         self._rng.shuffle(pending)
         with count_lock:
@@ -305,16 +415,22 @@ class ShuffleFetcher:
                 raise _Aborted()
             self._acquire_in_flight(fetch.total_bytes)
             t0 = time.monotonic()
-            try:
+
+            def read_blocks(fetch=fetch):
                 with self.tracer.span("fetch.blocks", "fetch",
                                       map=fetch.map_id, peer=exec_idx,
                                       bytes=fetch.total_bytes):
-                    data = self.endpoint.fetch_blocks(
+                    return self.endpoint.fetch_blocks(
                         peer, self.shuffle_id, fetch.blocks)
-            except (TransportError, AssertionError) as e:
+
+            try:
+                data = self._with_retries("blocks", exec_idx, fetch.map_id,
+                                          read_blocks)
+            except BaseException:
+                # envelope exhausted (FetchFailedError) or abort: this
+                # fetch's budget must not leak past its failure
                 self._release_in_flight(fetch.total_bytes)
-                raise FetchFailedError(self.shuffle_id, fetch.map_id,
-                                       exec_idx, str(e)) from e
+                raise
             dt = time.monotonic() - t0
             self.metrics.record_remote(len(data), dt)
             if self.reader_stats is not None:
@@ -362,7 +478,8 @@ class ShuffleFetcher:
                         time.monotonic()))
                 # harvest landed location reads in issue order
                 while loc_pending and loc_pending[0][1].done():
-                    self._harvest_locations(exec_idx, loc_pending.popleft(),
+                    self._harvest_locations(peer, exec_idx,
+                                            loc_pending.popleft(),
                                             ready, count_lock)
                 # issue STEP-3 data fetches while the window has room and
                 # the in-flight byte budget admits them. With an empty
@@ -387,9 +504,10 @@ class ShuffleFetcher:
                 # release path; with an empty window, block on the oldest
                 # location read instead
                 if inflight:
-                    self._complete_oldest(exec_idx, inflight)
+                    self._complete_oldest(peer, exec_idx, inflight)
                 elif loc_pending:
-                    self._harvest_locations(exec_idx, loc_pending.popleft(),
+                    self._harvest_locations(peer, exec_idx,
+                                            loc_pending.popleft(),
                                             ready, count_lock)
         except BaseException:
             # window-held budget must not outlive the window: the issued-
@@ -408,10 +526,21 @@ class ShuffleFetcher:
                 self._release_in_flight(fetch.total_bytes)
             raise
 
-    def _harvest_locations(self, exec_idx: int, entry, ready: deque,
+    def _harvest_locations(self, peer, exec_idx: int, entry, ready: deque,
                            count_lock: threading.Lock) -> None:
         m, handle, t_issue = entry
-        locs = handle.result()
+        try:
+            locs = handle.result()
+        except (TransportError, TimeoutError, AssertionError) as e:
+            # the windowed async issue was attempt one; run the remaining
+            # retry budget synchronously (re-queueing into the window
+            # would reorder the drain for no benefit)
+            locs = self._with_retries(
+                "locations", exec_idx, m,
+                lambda: self.endpoint.fetch_output_range(
+                    peer, self.shuffle_id, m,
+                    self.start_partition, self.end_partition),
+                first_error=e)
         if self.tracer.enabled:
             # same span the sequential path brackets around its blocking
             # location read — STEP-2 latency stays measurable in the
@@ -432,20 +561,39 @@ class ShuffleFetcher:
         now = time.monotonic()
         ready.extend((g, now) for g in groups)
 
-    def _complete_oldest(self, exec_idx: int, inflight: deque) -> None:
+    def _complete_oldest(self, peer, exec_idx: int, inflight: deque) -> None:
         """Finish the window's oldest data fetch: decode on this thread,
-        record metrics + issue→wire→complete trace spans, enqueue."""
+        record metrics + issue→wire→complete trace spans, enqueue. A
+        transient failure retries synchronously within the budget (each
+        window entry heals independently — one bit-flipped response costs
+        one refetch, not the whole window); exhaustion unwinds the window
+        via the FetchFailedError."""
         fetch, handle, t_ready, t_issue = inflight[0]
+        wire_done_s = None
         try:
             data = handle.result()
-        except (TransportError, AssertionError) as e:
-            # this entry's budget is released here; the rest of the
-            # window is released by _fetch_pipelined's unwind
+            wire_done_s = handle.wire_done_s
+        except (TransportError, TimeoutError, AssertionError) as e:
             inflight.popleft()
-            self._release_in_flight(fetch.total_bytes)
-            raise FetchFailedError(self.shuffle_id, fetch.map_id,
-                                   exec_idx, str(e)) from e
-        inflight.popleft()
+            # re-stamp the issue time: the recorded latency should cover
+            # the retry that actually served the bytes, not the failed
+            # wait + backoff sleeps (which would skew the histograms the
+            # pipeline analysis reads); the failed handle's wire stamp is
+            # stale for the same reason
+            t_issue = time.monotonic()
+            try:
+                data = self._with_retries(
+                    "blocks", exec_idx, fetch.map_id,
+                    lambda: self.endpoint.fetch_blocks(
+                        peer, self.shuffle_id, fetch.blocks),
+                    first_error=e)
+            except BaseException:
+                # this entry's budget is released here; the rest of the
+                # window is released by _fetch_pipelined's unwind
+                self._release_in_flight(fetch.total_bytes)
+                raise
+        else:
+            inflight.popleft()
         now = time.monotonic()
         dt = now - t_issue
         self.metrics.record_remote(len(data), dt)
@@ -455,8 +603,8 @@ class ShuffleFetcher:
             end_us = self.tracer.now_us()
             issue_us = end_us - (now - t_issue) * 1e6
             ready_us = end_us - (now - t_ready) * 1e6
-            wire_us = (end_us - (now - handle.wire_done_s) * 1e6
-                       if handle.wire_done_s is not None else end_us)
+            wire_us = (end_us - (now - wire_done_s) * 1e6
+                       if wire_done_s is not None else end_us)
             # the stamp rides the future's done-callback, which can run
             # AFTER result() already returned — clamp so a late stamp
             # can't put the wire phase outside [issue, complete]

@@ -130,7 +130,8 @@ class TpuShuffleManager:
             self.executor = ExecutorEndpoint(
                 host, executor_id, driver_addr, data_source=self.resolver,
                 conf=self.conf,
-                block_port=self.block_server.port if self.block_server else 0)
+                block_port=self.block_server.port if self.block_server else 0,
+                tracer=self.tracer)
             self.executor.start()
             if num_executors_hint:
                 self.executor.wait_for_members(num_executors_hint)
@@ -218,6 +219,10 @@ class TpuShuffleManager:
         # quiesce traffic sources before destroying the pool: outstanding
         # readers hold views into pool memory
         if self.executor is not None:
+            if self.executor.suspect_events or self.executor.checksum_failures:
+                log.warning("peer health at stop: %s (checksum failures: %d)",
+                            self.executor.health_snapshot(),
+                            self.executor.checksum_failures)
             self.executor.stop()
         if self.resolver is not None:
             self.resolver.stop()

@@ -17,6 +17,7 @@ collective"; the recovery mirrors the reference's: drop the dead member
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Dict, List, Sequence, TypeVar
 
 from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
@@ -38,7 +39,8 @@ def run_map_stage(executors: Sequence[TpuShuffleManager],
                   placement: Dict[int, int] = None) -> Dict[int, int]:
     """Run map tasks round-robin (or per ``placement``); returns the
     executor index that ran each map."""
-    live = [i for i, ex in enumerate(executors) if ex.executor is not None]
+    live = [i for i, ex in enumerate(executors)
+            if ex.executor is not None and not ex.executor.server.stopped]
     ran: Dict[int, int] = {}
     ids = list(map_ids) if map_ids else list(range(handle.num_maps))
     for k, m in enumerate(ids):
@@ -50,10 +52,46 @@ def run_map_stage(executors: Sequence[TpuShuffleManager],
     return ran
 
 
+def _tombstone_slot(driver: object, dead_slot: int) -> None:
+    """Mark the failed slot lost at the driver (no-op without a driver
+    handle, on an unknown slot, or on a slot already tombstoned —
+    remove_member converges).
+
+    A FetchFailedError names a slot, but exhausted TRANSIENT retries
+    against an overloaded-yet-alive peer produce the same exception as a
+    real death — and a tombstone is permanent (the slot becomes
+    unroutable for every shuffle). Corroborate with one cheap dial probe
+    before evicting: refused/timed-out means gone (tombstone), accepted
+    means alive (the recompute alone repairs this reduce)."""
+    if driver is None or dead_slot < 0:
+        return
+    endpoint = getattr(driver, "driver", driver)  # manager or endpoint
+    if endpoint is None or not hasattr(endpoint, "remove_member"):
+        return
+    from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+    members = endpoint.members()
+    if dead_slot >= len(members) or members[dead_slot] == TOMBSTONE:
+        return
+    dead = members[dead_slot]
+    import socket
+    try:
+        probe = socket.create_connection((dead.rpc_host, dead.rpc_port),
+                                         timeout=1.0)
+        probe.close()
+        log.warning("slot %d (%s:%s) still accepts connections; not "
+                    "tombstoning a live executor over a transient failure",
+                    dead_slot, dead.rpc_host, dead.rpc_port)
+        return
+    except OSError:
+        pass
+    endpoint.remove_member(dead)
+
+
 def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
                           handle: ShuffleHandle, map_fn: MapTask,
                           reduce_fn: ReduceTask, reducer_index: int,
-                          max_stage_retries: int = 2) -> T:
+                          max_stage_retries: int = 2,
+                          driver: object = None) -> T:
     """Reduce; on FetchFailed, recompute the lost maps elsewhere and retry.
 
     The failed map is identified from the exception; since publishes are
@@ -61,6 +99,12 @@ def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
     repairs the driver table — stragglers fetching concurrently see either
     the old (dead) or new (live) owner, and the dead one fails them into
     this same retry path.
+
+    ``driver`` (a ``TpuShuffleManager`` driver role or ``DriverEndpoint``),
+    when given, is told about the dead slot before the recompute: the
+    tombstone announce makes every OTHER reducer's ``member_at`` fail fast
+    on that slot instead of each independently burning a heartbeat/connect
+    budget discovering the same death.
     """
     attempt = 0
     while True:
@@ -73,6 +117,7 @@ def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
             # every map currently owned by the failed slot must be
             # recomputed, not just the one that tripped the fetch
             dead_slot = e.exec_index
+            _tombstone_slot(driver, dead_slot)
             table = executors[reducer_index].executor.get_driver_table(
                 handle.shuffle_id, 0, timeout=5)
             lost_maps: List[int] = []
@@ -84,10 +129,15 @@ def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
                 lost_maps = [e.map_id]
             log.warning("stage retry %d: recomputing maps %s lost with "
                         "executor slot %d", attempt, lost_maps, dead_slot)
-            # survivors = executors whose endpoint slot is not the dead one
+            # survivors = executors whose endpoint slot is not the dead
+            # one AND whose server is still up: with TWO dead executors,
+            # the first repair must not place recomputes on the second
+            # (its resolver would happily write, its publishes would
+            # advertise an unreachable owner, and the reduce would burn a
+            # whole extra stage retry discovering it)
             survivors = []
             for i, ex in enumerate(executors):
-                if ex.executor is None:
+                if ex.executor is None or ex.executor.server.stopped:
                     continue
                 try:
                     if ex.executor.exec_index(timeout=1) != dead_slot:
@@ -99,5 +149,26 @@ def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
             placement = {m: survivors[k % len(survivors)]
                          for k, m in enumerate(lost_maps)}
             run_map_stage(executors, handle, map_fn, lost_maps, placement)
+            # publishes are one-sided (no ack) and a repair OVERWRITE
+            # doesn't change the publish count, so the long-poll can't
+            # sync on it: poll until the table visibly stops naming the
+            # dead slot, else the next attempt races the in-flight
+            # republish, reads the stale entry, and burns a whole stage
+            # retry on the same failure (engine.py's recovery waits the
+            # same way)
+            ep = executors[reducer_index].executor
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                ep.invalidate_shuffle(handle.shuffle_id)
+                table = ep.get_driver_table(handle.shuffle_id, 0, timeout=5)
+                entries = [table.entry(m) for m in lost_maps]
+                if all(e is not None and e[1] != dead_slot
+                       for e in entries):
+                    break
+                time.sleep(0.005)
+            else:
+                log.warning("repair publishes for shuffle %d maps %s not "
+                            "visible within 5s; the retry may re-fail",
+                            handle.shuffle_id, lost_maps)
             # the repaired table must be re-read, not served from cache
-            executors[reducer_index].executor.invalidate_shuffle(handle.shuffle_id)
+            ep.invalidate_shuffle(handle.shuffle_id)

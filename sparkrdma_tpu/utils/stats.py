@@ -124,6 +124,32 @@ class FetchPipelineStats:
             }
 
 
+class FailureCounters:
+    """Failure-path counters for the hardened fetch dataplane: retries
+    issued, checksum mismatches, peers declared suspect, terminal fetch
+    failures. The reference has no failure observability at all (its only
+    signal is the FetchFailedException itself); here every rung of the
+    escalation ladder is counted so an ops dashboard can tell "healthy
+    retries absorbing blips" from "about to escalate to stage retry"."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def incr(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            return self._counts[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(sorted(self._counts.items()))
+
+
 class ShuffleReaderStats:
     """Per-remote + global histograms (RdmaShuffleReaderStats.scala:32-81)."""
 
@@ -137,6 +163,9 @@ class ShuffleReaderStats:
         # pipelined-fetch telemetry rides the same stats object so one
         # snapshot shows latency AND pipeline behavior per remote
         self.pipeline = FetchPipelineStats()
+        # failure-path counters ride along too: one snapshot answers both
+        # "how fast" and "how rough"
+        self.failures = FailureCounters()
 
     def update(self, exec_index: int, latency_s: float) -> None:
         with self._lock:
@@ -157,17 +186,22 @@ class ShuffleReaderStats:
         pipeline = self.pipeline.snapshot()
         if pipeline["per_peer"]:
             snap["pipeline"] = pipeline
+        failures = self.failures.snapshot()
+        if failures:
+            snap["failures"] = failures
         return snap
 
     def log_summary(self, logger) -> None:
         """Printed at stop (RdmaShuffleReaderStats.scala:55-81)."""
         snap = self.snapshot()
-        if snap["global"]["count"] == 0:
+        if snap["global"]["count"] == 0 and "failures" not in snap:
             return
         logger.info("shuffle fetch latency (global): %s", snap["global"])
         for remote, summary in snap["per_remote"].items():
             logger.info("shuffle fetch latency (executor %s): %s",
                         remote, summary)
+        if "failures" in snap:
+            logger.info("shuffle fetch failure path: %s", snap["failures"])
 
 
 class MemStats:

@@ -84,6 +84,24 @@ class Tracer:
                 "args": args,
             })
 
+    def counter(self, name: str, value: float,
+                category: str = "fault") -> None:
+        """Chrome "C"-phase counter sample: running totals (retries,
+        suspicions) render as a stepped series that lines up against the
+        fetch spans, so "retry burst at t=..." is visible next to the
+        fetches it delayed."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._events) >= self.MAX_EVENTS:
+                self.dropped += 1
+                return
+            self._events.append({
+                "name": name, "cat": category, "ph": "C",
+                "ts": self._now_us(), "pid": os.getpid(),
+                "args": {"value": value},
+            })
+
     def instant(self, name: str, category: str = "shuffle", **args) -> None:
         if not self.enabled:
             return

@@ -29,3 +29,8 @@ def pytest_configure(config):
         "slow: longer than the tier-1 wall-clock budget on a CPU host; "
         "excluded by the default `-m 'not slow'` run, exercised "
         "explicitly and on hardware rounds")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection scenario (parallel/faults.py); "
+        "fast ones run in tier-1, the wide sweep is chaos+slow and "
+        "driven by scripts/run_chaos.sh across CHAOS_SEED values")

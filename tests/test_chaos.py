@@ -1,0 +1,342 @@
+"""Seeded chaos scenarios: end-to-end shuffle reduces under injected
+faults, asserting byte-identical results via refetch/recompute.
+
+Every scenario builds a real driver + multi-executor cluster over
+loopback, scripts faults through the :mod:`sparkrdma_tpu.parallel.faults`
+shim (seeded — a failing run replays exactly from the seed printed in
+the assertion message), runs a reduce through the hardened path, and
+checks the result against the fault-free ground truth.
+
+Fast scenarios run in tier-1 (marked ``chaos``); the wide sweep is
+``chaos + slow`` and driven by ``scripts/run_chaos.sh``, which iterates
+seeds via ``CHAOS_SEED``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel.faults import (
+    BLACKHOLE,
+    CORRUPT,
+    DELAY,
+    DISCONNECT,
+    REFUSE_CONNECT,
+    FaultInjector,
+)
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+from sparkrdma_tpu.shuffle.recovery import run_map_stage, run_reduce_with_retry
+
+pytestmark = pytest.mark.chaos
+
+SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+def _conf(**kw):
+    base = dict(connect_timeout_ms=3000, max_connection_attempts=2,
+                retry_backoff_base_ms=10, retry_backoff_cap_ms=80,
+                fetch_retry_budget=3, use_cpp_runtime=False,
+                pre_warm_connections=False,
+                collect_shuffle_reader_stats=True)
+    base.update(kw)
+    return TpuShuffleConf(**base)
+
+
+def _cluster(tmp_path, n=3, **kw):
+    conf = _conf(**kw)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(n)]
+    for ex in execs:
+        ex.executor.wait_for_members(n)
+    return driver, execs
+
+
+def _map_fn(writer, map_id):
+    rng = np.random.default_rng(1000 + map_id)
+    keys = rng.integers(0, 5000, size=500).astype(np.uint64)
+    writer.write_batch(keys)
+
+
+def _reduce_fn(mgr, handle):
+    reader = mgr.get_reader(handle, 0, handle.num_partitions)
+    keys, _ = reader.read_all()
+    return np.sort(keys)
+
+
+def _expected(num_maps):
+    return np.sort(np.concatenate(
+        [np.random.default_rng(1000 + m).integers(0, 5000, 500)
+         for m in range(num_maps)]).astype(np.uint64))
+
+
+def _shutdown(driver, execs):
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+# -- tier-1 chaos scenarios (fast, deterministic counts) -----------------
+
+
+def test_chaos_corruption_healed_by_refetch(tmp_path):
+    """Bit-flipped fetch payloads are caught by the CRC32 trailer and
+    refetched within the budget; the reduce is byte-identical and the
+    failure counters show the retries that absorbed it."""
+    driver, execs = _cluster(tmp_path)
+    injector = FaultInjector(seed=SEED)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        injector.install_endpoint(execs[0].executor)
+        injector.add(CORRUPT, msg_type=M.FetchBlocksResp, times=3)
+
+        reader = execs[0].get_reader(handle, 0, handle.num_partitions)
+        keys, _ = reader.read_all()
+        np.testing.assert_array_equal(np.sort(keys), _expected(6),
+                                      err_msg=f"seed={SEED}")
+        assert injector.fired_count(CORRUPT) == 3, f"seed={SEED}"
+        assert reader.metrics.checksum_failures >= 3, f"seed={SEED}"
+        assert reader.metrics.retries >= 3, f"seed={SEED}"
+        assert reader.metrics.failed_fetches == 0, f"seed={SEED}"
+        snap = execs[0].reader_stats.snapshot()
+        assert snap["failures"]["checksum_mismatches"] >= 3, snap
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def test_chaos_connect_refusal_burst(tmp_path):
+    """A refusal burst at fetch time is absorbed by connect retries with
+    backoff plus the fetch retry envelope — no stage retry needed."""
+    driver, execs = _cluster(tmp_path)
+    injector = FaultInjector(seed=SEED)
+    map_runs = []
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        injector.install_endpoint(execs[0].executor)
+        injector.add(REFUSE_CONNECT, times=3)
+
+        def counting_map_fn(writer, map_id):
+            map_runs.append(map_id)
+            _map_fn(writer, map_id)
+
+        got = run_reduce_with_retry(execs, handle, counting_map_fn,
+                                    _reduce_fn, reducer_index=0,
+                                    driver=driver)
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        assert injector.fired_count(REFUSE_CONNECT) == 3, f"seed={SEED}"
+        assert map_runs == [], \
+            f"seed={SEED}: transient refusals must not escalate to recompute"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def test_chaos_transient_disconnect_absorbed(tmp_path):
+    """One mid-stream disconnect (response cut on the wire) fails the
+    whole in-flight window, but the retry envelope re-dials and refetches
+    — byte-identical, no recompute."""
+    driver, execs = _cluster(tmp_path, read_ahead_depth=4)
+    injector = FaultInjector(seed=SEED)
+    map_runs = []
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        injector.install_endpoint(execs[0].executor)
+        injector.add(DISCONNECT, msg_type=M.FetchBlocksResp, times=1)
+
+        def counting_map_fn(writer, map_id):
+            map_runs.append(map_id)
+            _map_fn(writer, map_id)
+
+        got = run_reduce_with_retry(execs, handle, counting_map_fn,
+                                    _reduce_fn, reducer_index=0,
+                                    driver=driver)
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        assert injector.fired_count(DISCONNECT) == 1, f"seed={SEED}"
+        assert map_runs == [], f"seed={SEED}"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def test_chaos_peer_kill_mid_fetch_recompute(tmp_path):
+    """A map-output owner dies while the reducer's window is in flight:
+    location reads from the victim succeed, then every data response
+    disconnects mid-stream and every re-dial is refused (a peer that
+    died between STEP 2 and STEP 3). The failure exhausts the retry
+    budget, escalates to FetchFailed, the stage retry recomputes on
+    survivors — never on the dead slot — and the reduce completes
+    byte-identical."""
+    driver, execs = _cluster(tmp_path, read_ahead_depth=4,
+                             fetch_retry_budget=1)
+    injector = FaultInjector(seed=SEED)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        victim_slot = execs[2].executor.exec_index()
+        victim_addr = (execs[2].executor.manager_id.rpc_host,
+                       execs[2].executor.manager_id.rpc_port)
+        injector.install_endpoint(execs[0].executor)
+        injector.add(DISCONNECT, peer=victim_addr,
+                     msg_type=M.FetchBlocksResp)
+        # after=1: the first dial (location reads) succeeds — the peer
+        # "dies" between STEP 2 and STEP 3; every re-dial then bounces
+        injector.add(REFUSE_CONNECT, peer=victim_addr, after=1)
+
+        # the REAL server dies the instant the injected disconnect fires,
+        # so the recovery loop's reachability probe (which uses a raw
+        # socket, not the shimmed cache) also sees a dead peer and the
+        # tombstone gate opens
+        done = threading.Event()
+
+        def kill_on_disconnect():
+            while (injector.fired_count(DISCONNECT) == 0
+                   and not done.wait(0.005)):
+                pass
+            execs[2].executor.server.stop()
+
+        killer = threading.Thread(target=kill_on_disconnect)
+        killer.start()
+        try:
+            got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
+                                        reducer_index=0, driver=driver)
+        finally:
+            done.set()
+            killer.join()
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        assert injector.fired_count(DISCONNECT) >= 1, f"seed={SEED}"
+        table = execs[0].executor.get_driver_table(1, 6, timeout=5)
+        for m in range(6):
+            assert table.entry(m)[1] != victim_slot, f"seed={SEED}"
+        # the driver handle fed the tombstone path
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        assert driver.driver.members()[victim_slot] == TOMBSTONE, \
+            f"seed={SEED}"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def test_chaos_blackhole_partition_heartbeat_escalates(tmp_path):
+    """A silently partitioned peer (requests vanish, nothing bounces) is
+    detected by the heartbeat monitor well before the 10 s request
+    deadline; the suspect verdict fails the fetch into the recompute
+    loop and the reduce still completes."""
+    interval_ms = 200
+    driver, execs = _cluster(tmp_path, request_deadline_ms=10000,
+                             heartbeat_interval_ms=interval_ms,
+                             heartbeat_misses=2, fetch_retry_budget=2)
+    injector = FaultInjector(seed=SEED)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        victim = execs[1].executor.manager_id
+        injector.install_endpoint(execs[0].executor)
+        # partition: everything the victim sends back is dropped
+        injector.add(BLACKHOLE, peer=(victim.rpc_host, victim.rpc_port))
+
+        t0 = time.monotonic()
+        got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
+                                    reducer_index=0, driver=driver)
+        wall = time.monotonic() - t0
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        ep = execs[0].executor
+        assert ep.suspect_events >= 1, f"seed={SEED}: heartbeat never fired"
+        # detection + recompute must ride the heartbeat, not the 10 s
+        # request deadline (let alone a TCP-scale timeout)
+        assert wall < 8.0, \
+            f"seed={SEED}: {wall:.1f}s — waited out deadlines instead of " \
+            f"heartbeat (2x interval = {2 * interval_ms / 1000:.1f}s)"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+# -- the wide sweep (chaos + slow; scripts/run_chaos.sh) -----------------
+
+
+def _scenario_faults(name, injector, victim_addr):
+    if name == "corrupt_1pct":
+        injector.add(CORRUPT, msg_type=M.FetchBlocksResp, prob=0.01)
+    elif name == "refuse_burst":
+        injector.add(REFUSE_CONNECT, times=3)
+        injector.add(REFUSE_CONNECT, after=10, times=2)
+    elif name == "delay_storm":
+        injector.add(DELAY, msg_type=M.FetchBlocksResp, delay_s=0.05,
+                     prob=0.2)
+    elif name == "flaky_victim":
+        injector.add(DISCONNECT, peer=victim_addr,
+                     msg_type=M.FetchBlocksResp, times=2)
+        injector.add(DELAY, peer=victim_addr, msg_type=M.FetchOutputResp,
+                     delay_s=0.03, prob=0.5)
+    elif name == "mixed":
+        injector.add(CORRUPT, msg_type=M.FetchBlocksResp, prob=0.02)
+        injector.add(DELAY, msg_type=M.FetchBlocksResp, delay_s=0.02,
+                     prob=0.1)
+        injector.add(REFUSE_CONNECT, times=2)
+    else:  # pragma: no cover - scenario list and matrix stay in sync
+        raise AssertionError(name)
+
+
+def _map_fn_big(writer, map_id):
+    rng = np.random.default_rng(1000 + map_id)
+    keys = rng.integers(0, 50_000, size=3000).astype(np.uint64)
+    writer.write_batch(keys)
+
+
+def _expected_big(num_maps):
+    return np.sort(np.concatenate(
+        [np.random.default_rng(1000 + m).integers(0, 50_000, 3000)
+         for m in range(num_maps)]).astype(np.uint64))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ["corrupt_1pct", "refuse_burst",
+                                      "delay_storm", "flaky_victim",
+                                      "mixed"])
+def test_chaos_matrix(tmp_path, scenario):
+    """The sweep: ~a hundred small grouped fetches (tiny read block size,
+    3000 rows per map) under probabilistic faults drawn from the seeded
+    injector RNG. Replay a failure with
+    ``CHAOS_SEED=<seed> pytest tests/test_chaos.py -m chaos``
+    (the seed is in the assertion message)."""
+    driver, execs = _cluster(tmp_path, shuffle_read_block_size=1024,
+                             read_ahead_depth=4)
+    injector = FaultInjector(seed=SEED)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=8,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn_big)
+        victim_addr = (execs[2].executor.manager_id.rpc_host,
+                       execs[2].executor.manager_id.rpc_port)
+        injector.install_endpoint(execs[0].executor)
+        _scenario_faults(scenario, injector, victim_addr)
+
+        got = run_reduce_with_retry(execs, handle, _map_fn_big, _reduce_fn,
+                                    reducer_index=0, max_stage_retries=3,
+                                    driver=driver)
+        np.testing.assert_array_equal(
+            got, _expected_big(6),
+            err_msg=f"scenario={scenario} seed={SEED}")
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)

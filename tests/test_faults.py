@@ -1,0 +1,429 @@
+"""Fault-injection shim + hardened failure-path unit tests.
+
+Covers the chaos shim itself (parallel/faults.py), the backoff helper
+and the connect-retry wall-clock fix, the ControlServer connection reap,
+the ``await_response`` claim-back race and ``_fail_pending`` vs
+caller-cancel interleavings (pinned deterministically), checksummed
+fetches healing a bit-flip via bounded refetch, and heartbeat-based
+suspicion failing outstanding fetches long before any TCP-scale
+timeout. The full scenario matrix lives in tests/test_chaos.py.
+"""
+
+import socket
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel.endpoints import DriverEndpoint, ExecutorEndpoint
+from sparkrdma_tpu.parallel.faults import (
+    BLACKHOLE,
+    CORRUPT,
+    DELAY,
+    DISCONNECT,
+    REFUSE_CONNECT,
+    FaultInjector,
+)
+from sparkrdma_tpu.parallel.transport import (
+    Backoff,
+    ChecksumError,
+    Connection,
+    ConnectionCache,
+    ControlServer,
+    TransportError,
+    await_response,
+)
+from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+
+
+class FakeSource:
+    """In-memory ShuffleDataSource keyed by token (test_control_plane's)."""
+
+    def __init__(self):
+        self.tables: Dict[Tuple[int, int], MapTaskOutput] = {}
+        self.buffers: Dict[int, bytes] = {}
+
+    def get_output_table(self, shuffle_id, map_id) -> Optional[MapTaskOutput]:
+        return self.tables.get((shuffle_id, map_id))
+
+    def read_block(self, shuffle_id, buf_token, offset, length):
+        buf = self.buffers.get(buf_token)
+        if buf is None or offset + length > len(buf):
+            return None
+        return buf[offset:offset + length]
+
+
+# -- backoff helper ------------------------------------------------------
+
+
+def test_backoff_bounds_and_determinism():
+    import random
+
+    b1 = Backoff(0.1, 0.4, rng=random.Random(42))
+    b2 = Backoff(0.1, 0.4, rng=random.Random(42))
+    d1 = [b1.delay(k) for k in range(6)]
+    d2 = [b2.delay(k) for k in range(6)]
+    assert d1 == d2, "same seed must replay the same sleep schedule"
+    for k, d in enumerate(d1):
+        span = min(0.4, 0.1 * (1 << k))
+        # equal jitter: never below half the span (the wall-clock floor),
+        # never above the capped span
+        assert span / 2 <= d <= span, (k, d)
+
+
+def test_backoff_sleep_interruptible():
+    b = Backoff(5.0, 5.0)
+    ev = threading.Event()
+    ev.set()
+    t0 = time.monotonic()
+    assert b.sleep(0, interrupt=ev) is True
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_connect_retry_backoff_spans_wall_clock():
+    """The satellite fix: re-dials must sleep between attempts — the
+    budget has to span real time, not burn out in microseconds."""
+    conf = TpuShuffleConf(connect_timeout_ms=2000,
+                          max_connection_attempts=3,
+                          retry_backoff_base_ms=80,
+                          retry_backoff_cap_ms=200)
+    cache = ConnectionCache(conf)
+    t0 = time.monotonic()
+    with pytest.raises(TransportError):
+        cache.get("127.0.0.1", 1)  # nothing listens on port 1
+    dt = time.monotonic() - t0
+    # two inter-attempt sleeps floored at span/2: >= 40ms + 80ms
+    assert dt >= 0.12, f"retry loop still hot-spins ({dt:.4f}s)"
+    assert dt < 5, dt
+
+
+# -- chaos shim: connect faults -----------------------------------------
+
+
+def test_refuse_connect_burst_absorbed_by_retry_budget():
+    conf = TpuShuffleConf(connect_timeout_ms=2000,
+                          max_connection_attempts=4,
+                          retry_backoff_base_ms=5, retry_backoff_cap_ms=20)
+    server = ControlServer("127.0.0.1", 0, conf, handler=lambda c, m: None)
+    cache = ConnectionCache(conf)
+    injector = FaultInjector(seed=7)
+    injector.install(cache)
+    try:
+        injector.add(REFUSE_CONNECT, times=2)
+        conn = cache.get(server.host, server.port)
+        assert not conn.closed
+        assert injector.fired_count(REFUSE_CONNECT) == 2
+    finally:
+        injector.uninstall()
+        cache.close_all()
+        server.stop()
+
+
+def test_refuse_connect_exhausts_budget():
+    conf = TpuShuffleConf(connect_timeout_ms=2000,
+                          max_connection_attempts=2,
+                          retry_backoff_base_ms=5, retry_backoff_cap_ms=20)
+    server = ControlServer("127.0.0.1", 0, conf, handler=lambda c, m: None)
+    cache = ConnectionCache(conf)
+    injector = FaultInjector(seed=7)
+    injector.install(cache)
+    try:
+        injector.add(REFUSE_CONNECT, times=None)  # every dial refused
+        with pytest.raises(TransportError):
+            cache.get(server.host, server.port)
+        # uninstall restores the real dial path
+        injector.uninstall()
+        assert not cache.get(server.host, server.port).closed
+    finally:
+        injector.uninstall()
+        cache.close_all()
+        server.stop()
+
+
+# -- ControlServer connection reap (satellite) ---------------------------
+
+
+def test_control_server_reaps_dead_connections():
+    conf = TpuShuffleConf(connect_timeout_ms=2000)
+    server = ControlServer("127.0.0.1", 0, conf, handler=lambda c, m: None)
+    try:
+        socks = [socket.create_connection((server.host, server.port))
+                 for _ in range(5)]
+        deadline = time.monotonic() + 5
+        while server.live_connections() < 5 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert server.live_connections() == 5
+        for s in socks[:4]:
+            s.close()
+        while server.live_connections() > 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert server.live_connections() == 1, \
+            "closed peers must be reaped, not accumulated forever"
+        socks[4].close()
+    finally:
+        server.stop()
+
+
+# -- claim-back race + teardown/cancel interleavings (satellite) ---------
+
+
+def test_await_response_claim_back_race():
+    """The reader completes the future in the window between the wait
+    timing out and the caller's cancel(): the landed response must be
+    returned, not dropped (a credited fetch would leak server window)."""
+    marker = object()
+    fut = Future()
+    orig_cancel = fut.cancel
+
+    def racing_cancel():
+        fut.set_result(marker)  # the reader wins the race window
+        return orig_cancel()
+
+    fut.cancel = racing_cancel
+    assert await_response(fut, timeout=0.01) is marker
+
+
+def test_await_response_timeout_poisons_future():
+    fut = Future()
+    with pytest.raises(TimeoutError):
+        await_response(fut, timeout=0.01)
+    assert fut.cancelled()
+
+
+def _socketpair_conn(conf=None, on_message=None):
+    a, b = socket.socketpair()
+    conn = Connection(a, conf or TpuShuffleConf(), on_message=on_message,
+                      name="race-test")
+    return conn, b
+
+
+def test_fail_pending_vs_caller_cancel_interleaving():
+    """Teardown's _fail_pending loses the race to a caller cancel between
+    its done() check and set_exception — pinned by a future whose done()
+    cancels itself. Must not raise, and the budget slot must recycle."""
+    conn, raw = _socketpair_conn()
+    try:
+        class RacingFuture(Future):
+            def done(self):
+                r = super().done()
+                if not r:
+                    super().cancel()  # the caller's cancel lands HERE
+                return r
+
+        fut = RacingFuture()
+        with conn._pending_lock:
+            conn._pending[99] = fut
+        conn._fail_pending(TransportError("teardown"))  # must not raise
+        assert fut.cancelled()
+        # normal ordering still fails pending futures
+        fut2 = conn.request_async(M.FetchTableReq(conn.next_req_id(), 1))
+        conn._fail_pending(TransportError("teardown"))
+        with pytest.raises(TransportError):
+            fut2.result(timeout=1)
+    finally:
+        conn.close()
+        raw.close()
+
+
+def test_cancelled_request_reroutes_late_response_to_orphan_path():
+    """A response landing on a poisoned (cancelled) future must reach
+    the unsolicited-message path, not vanish — that path owns credit
+    healing for orphaned fetches."""
+    orphans = []
+    conn, raw = _socketpair_conn(
+        on_message=lambda c, m: orphans.append(m) or None)
+    try:
+        req = M.FetchTableReq(conn.next_req_id(), 7)
+        fut = conn.request_async(req)
+        raw.recv(1 << 16)  # drain the request off the socketpair
+        assert fut.cancel()
+        raw.sendall(M.FetchTableResp(req.req_id, 3, b"").encode())
+        deadline = time.monotonic() + 5
+        while not orphans and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert orphans and isinstance(orphans[0], M.FetchTableResp)
+        assert orphans[0].req_id == req.req_id
+    finally:
+        conn.close()
+        raw.close()
+
+
+# -- endpoint clusters for checksum / heartbeat / orphan credits ---------
+
+
+@pytest.fixture
+def pair():
+    """driver + two executors; exec[1] serves a 400-byte buffer 55."""
+    conf = TpuShuffleConf(connect_timeout_ms=20000,
+                          heartbeat_interval_ms=0,  # per-test override
+                          retry_backoff_base_ms=5, retry_backoff_cap_ms=20)
+    yield from _make_pair(conf)
+
+
+def _make_pair(conf):
+    driver = DriverEndpoint(conf)
+    src = FakeSource()
+    src.buffers[55] = np.arange(400, dtype=np.uint8).tobytes()
+    table = MapTaskOutput(4)
+    for r in range(4):
+        table.put(r, offset=r * 100, length=100, buf=55)
+    src.tables[(3, 0)] = table
+    execs = [ExecutorEndpoint("127.0.0.1", str(i), driver.address,
+                              data_source=src, conf=conf)
+             for i in range(2)]
+    for ex in execs:
+        ex.start()
+    for ex in execs:
+        ex.wait_for_members(2)
+    yield driver, execs, src
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def test_fetch_blocks_carries_and_verifies_crc32(pair):
+    _, execs, src = pair
+    peer = execs[1].manager_id
+    data = execs[0].fetch_blocks(peer, 3, [(55, 0, 100), (55, 300, 100)])
+    assert data == src.buffers[55][0:100] + src.buffers[55][300:400]
+
+
+def test_crc32_composes_with_compression_and_codec():
+    """The trailer rides INSIDE the compressed/wrapped bytes: every flag
+    must survive the compression branch (a dropped FLAG_CRC32 leaves the
+    trailer embedded in the payload — 4 extra bytes per block corrupting
+    every downstream row decode)."""
+    for extra in ({"wire_compress": True, "wire_compress_min": 16},
+                  {"wire_codec": "hmac-sha256", "wire_codec_key": "ab" * 16},
+                  {"wire_compress": True, "wire_compress_min": 16,
+                   "wire_codec": "hmac-sha256", "wire_codec_key": "ab" * 16}):
+        conf = TpuShuffleConf(connect_timeout_ms=20000, **extra)
+        gen = _make_pair(conf)
+        _driver, execs, src = next(gen)
+        try:
+            peer = execs[1].manager_id
+            # a compressible payload (arange bytes repeat mod 256)
+            data = execs[0].fetch_blocks(peer, 3,
+                                         [(55, 0, 200), (55, 200, 200)])
+            assert data == src.buffers[55], extra
+        finally:
+            for _ in gen:
+                pass
+
+
+def test_corrupted_payload_raises_checksum_error(pair):
+    _, execs, _ = pair
+    peer = execs[1].manager_id
+    injector = FaultInjector(seed=11)
+    injector.install_endpoint(execs[0])
+    try:
+        injector.add(CORRUPT, msg_type=M.FetchBlocksResp, times=1)
+        with pytest.raises(ChecksumError):
+            execs[0].fetch_blocks(peer, 3, [(55, 0, 100)])
+        assert execs[0].checksum_failures >= 1
+        # the next (clean) fetch succeeds on the same connection
+        assert execs[0].fetch_blocks(peer, 3, [(55, 0, 100)]) \
+            == bytes(range(100))
+    finally:
+        injector.uninstall()
+
+
+def test_late_response_after_deadline_heals_credits():
+    """Per-request deadline + the orphan path end to end: the response
+    lands after the deadline, the claim-back fails, and the orphaned
+    response still reports its credits so the server window heals."""
+    conf = TpuShuffleConf(connect_timeout_ms=20000, request_deadline_ms=120,
+                          retry_backoff_base_ms=5, retry_backoff_cap_ms=20)
+    gen = _make_pair(conf)
+    driver, execs, src = next(gen)
+    injector = FaultInjector(seed=5)
+    injector.install_endpoint(execs[0])
+    try:
+        peer = execs[1].manager_id
+        injector.add(DELAY, msg_type=M.FetchBlocksResp, delay_s=0.5, times=1)
+        with pytest.raises(TimeoutError):
+            execs[0].fetch_blocks(peer, 3, [(55, 0, 100)])
+        # the delayed response lands orphaned; its pending credit entry
+        # must drain via the orphan report
+        conn = execs[0]._clients.get(peer.rpc_host, peer.rpc_port)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with execs[0]._fetch_credit_lock:
+                if not execs[0]._fetch_credit_pending.get(conn):
+                    break
+            time.sleep(0.02)
+        with execs[0]._fetch_credit_lock:
+            assert not execs[0]._fetch_credit_pending.get(conn)
+        # window healed: a clean fetch still goes through
+        assert execs[0].fetch_blocks(peer, 3, [(55, 0, 100)]) \
+            == bytes(range(100))
+    finally:
+        injector.uninstall()
+        for _ in gen:
+            pass
+
+
+def test_heartbeat_declares_silent_peer_suspect():
+    """A blackholed (partitioned) peer is detected by missed beats in
+    ~2 x interval x misses — not the 20 s connect/request deadline — and
+    its outstanding fetch fails the moment suspicion lands."""
+    interval_ms = 150
+    conf = TpuShuffleConf(connect_timeout_ms=20000,
+                          heartbeat_interval_ms=interval_ms,
+                          heartbeat_misses=2,
+                          retry_backoff_base_ms=5, retry_backoff_cap_ms=20)
+    gen = _make_pair(conf)
+    driver, execs, src = next(gen)
+    injector = FaultInjector(seed=13)
+    injector.install_endpoint(execs[0])
+    try:
+        idx1 = execs[1].exec_index()
+        peer = execs[0].member_at(idx1)
+        # partition: everything the peer sends back vanishes
+        injector.add(BLACKHOLE, peer=(peer.rpc_host, peer.rpc_port))
+        handle = execs[0].fetch_blocks_async(peer, 3, [(55, 0, 100)])
+        t0 = time.monotonic()
+        execs[0].watch_peer(idx1, peer)
+        with pytest.raises(TransportError):
+            handle.result(timeout=15)
+        detect_s = time.monotonic() - t0
+        assert execs[0].peer_suspect(idx1)
+        assert execs[0].suspect_events == 1
+        bound = 2 * (conf.heartbeat_misses + 1) * interval_ms / 1000 + 1.5
+        assert detect_s < bound, \
+            f"detection took {detect_s:.2f}s (heartbeat should beat TCP)"
+        execs[0].unwatch_peer(idx1)
+        snap = execs[0].health_snapshot()
+        assert snap["suspects"] == [idx1]
+    finally:
+        injector.uninstall()
+        for _ in gen:
+            pass
+
+
+def test_transient_disconnect_is_transparent_to_endpoint_retry():
+    """A mid-stream disconnect fails the in-flight request with a
+    retryable TransportError; a fresh call re-dials and succeeds."""
+    conf = TpuShuffleConf(connect_timeout_ms=20000,
+                          retry_backoff_base_ms=5, retry_backoff_cap_ms=20)
+    gen = _make_pair(conf)
+    driver, execs, src = next(gen)
+    injector = FaultInjector(seed=17)
+    injector.install_endpoint(execs[0])
+    try:
+        peer = execs[1].manager_id
+        injector.add(DISCONNECT, msg_type=M.FetchBlocksResp, times=1)
+        with pytest.raises(TransportError) as ei:
+            execs[0].fetch_blocks(peer, 3, [(55, 0, 100)])
+        assert getattr(ei.value, "retryable", True)
+        assert execs[0].fetch_blocks(peer, 3, [(55, 0, 100)]) \
+            == bytes(range(100))
+    finally:
+        injector.uninstall()
+        for _ in gen:
+            pass

@@ -3,6 +3,7 @@ stage-retry loop recomputes its maps on survivors and the reduce completes
 with exactly the right data (reference behavior: FetchFailed -> recompute,
 scala/RdmaShuffleFetcherIterator.scala:376-381)."""
 
+import threading
 import time
 
 import numpy as np
@@ -70,6 +71,108 @@ def test_reduce_survives_executor_loss(tmp_path):
         table = execs[0].executor.get_driver_table(1, 6, timeout=5)
         for m in range(6):
             assert table.entry(m)[1] != lost_slot
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
+
+
+def _expected(num_maps):
+    return np.sort(np.concatenate(
+        [np.random.default_rng(1000 + m).integers(0, 5000, 500)
+         for m in range(num_maps)]).astype(np.uint64))
+
+
+def _make_cluster(tmp_path, n, **conf_kw):
+    conf = TpuShuffleConf(connect_timeout_ms=1000, max_connection_attempts=2,
+                          retry_backoff_base_ms=10, retry_backoff_cap_ms=50,
+                          **conf_kw)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(n)]
+    for ex in execs:
+        ex.executor.wait_for_members(n)
+    return driver, execs
+
+
+def test_two_successive_executor_losses(tmp_path):
+    """Multi-failure recovery: TWO map-output owners die before the
+    reduce. Each FetchFailed names one dead slot; the retry loop must
+    repair twice within its budget WITHOUT placing the first repair's
+    recomputes on the second (also-dead) executor."""
+    driver, execs = _make_cluster(tmp_path, 4)
+    try:
+        handle = driver.register_shuffle(1, num_maps=8, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        expect = _expected(8)
+        np.testing.assert_array_equal(_reduce_fn(execs[0], handle), expect)
+
+        dead_slots = []
+        for k in (1, 2):
+            dead_slots.append(execs[k].executor.exec_index())
+            execs[k].executor.stop()
+        execs[0].executor.invalidate_shuffle(1)
+
+        got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
+                                    reducer_index=0, max_stage_retries=2,
+                                    driver=driver)
+        np.testing.assert_array_equal(got, expect)
+
+        # both dead slots are repaired out of the table and tombstoned
+        table = execs[0].executor.get_driver_table(1, 8, timeout=5)
+        for m in range(8):
+            assert table.entry(m)[1] not in dead_slots
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        members = driver.driver.members()
+        for slot in dead_slots:
+            assert members[slot] == TOMBSTONE
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
+
+
+def test_straggler_fetching_mid_repair(tmp_path):
+    """recovery.py's "old or new owner" claim under actual concurrency:
+    while one reducer's retry loop is repairing the dead slot's maps, a
+    straggler reducer starts fetching. It must see either the old (dead)
+    owner — failing into its own retry — or the new one, and both
+    reducers must finish byte-identical."""
+    driver, execs = _make_cluster(tmp_path, 3)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        expect = _expected(6)
+
+        execs[1].executor.stop()
+        for ex in (execs[0], execs[2]):
+            ex.executor.invalidate_shuffle(1)
+
+        results = {}
+        errors = []
+
+        def reduce_on(idx, delay_s):
+            try:
+                time.sleep(delay_s)
+                results[idx] = run_reduce_with_retry(
+                    execs, handle, _map_fn, _reduce_fn, reducer_index=idx,
+                    max_stage_retries=3, driver=driver)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append((idx, e))
+
+        threads = [threading.Thread(target=reduce_on, args=(0, 0.0)),
+                   threading.Thread(target=reduce_on, args=(2, 0.15))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        np.testing.assert_array_equal(results[0], expect)
+        np.testing.assert_array_equal(results[2], expect)
     finally:
         for ex in execs:
             ex.stop()
