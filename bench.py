@@ -513,6 +513,23 @@ def _bench_fetch_pipeline(detail: dict) -> None:
             f"depth{d}": t for d, t in res["wall_s"].items()}
     except Exception as e:  # noqa: BLE001
         detail["fetch_pipeline_error"] = f"{type(e).__name__}: {e}"[:120]
+    # the coalesced dataplane's RPC-count reduction on a many-small-maps
+    # shuffle (64 maps x 8 partitions at equal bytes, request frames
+    # counted per dataplane) — the metric the per-peer batching exists for
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.fetch_bench import run_coalesce_microbench
+
+        with tempfile.TemporaryDirectory(prefix="coalescebench_") as td:
+            cres = run_coalesce_microbench(td)
+        if not cres["identical"]:
+            detail["fetch_rpc_error"] = "dataplanes fetched different bytes"
+            return
+        detail["fetch_rpc_reduction"] = cres["rpc_reduction"]
+        detail["fetch_rpc_requests"] = cres["requests"]
+    except Exception as e:  # noqa: BLE001
+        detail["fetch_rpc_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def main() -> None:

@@ -16,7 +16,13 @@
 // messages — frames of [total:4][type:4][payload], request type 9
 // (FetchBlocksReq: req_id q, shuffle_id i, count I, blocks (I,Q,I)*),
 // response type 10 (FetchBlocksResp: req_id q, status i, flags i, data).
-// Responses always use flags=0 (no compression on the native path).
+// Requests are VECTORED: the block list may span any mix of registered
+// tokens (different maps' spill files), gathered in request order into one
+// response. With bs_set_checksum(1) responses carry the same per-block
+// CRC32 trailer as the Python server (FLAG_CRC32=4, one little-endian u32
+// per requested block appended after the data) so a client can isolate a
+// corrupt sub-range to one block — and therefore one map — instead of
+// refetching the whole vectored response; otherwise flags=0.
 //
 // Exposed as a C ABI for ctypes.
 
@@ -73,6 +79,29 @@ struct MappedFile {
   uint64_t size;
 };
 
+// CRC-32 (IEEE 802.3, the zlib polynomial) — table-driven, computed inline
+// so the shared library needs no zlib link. Must match Python's
+// zlib.crc32: init 0xFFFFFFFF, reflected 0xEDB88320, final complement.
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+const Crc32Table kCrc32;
+
+uint32_t crc32_ieee(const uint8_t* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = kCrc32.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+constexpr uint32_t kFlagCrc32 = 4;  // messages.FLAG_CRC32
+
 struct Conn {
   int fd;
   std::vector<uint8_t> in;   // accumulated unparsed bytes
@@ -103,6 +132,7 @@ struct Server {
   std::deque<Worker> workers;
   std::atomic<uint32_t> next_worker{0};
   std::atomic<bool> stop{false};
+  std::atomic<bool> checksum{false};  // append per-block CRC32 trailers
   std::mutex files_mu;
   std::unordered_map<uint32_t, MappedFile> files;
   std::atomic<uint64_t> bytes_served{0};
@@ -179,8 +209,11 @@ bool process_frames(Server* s, Conn* c) {
       if (resp_len > kMaxRespPayload && status == kStatusOk)
         status = kStatusBadRange;
       if (status != kStatusOk) resp_len = 0;
-      // frame: [total][type][req_id q][status i][flags i][data]
-      uint32_t out_total = (uint32_t)(8 + 16 + resp_len);
+      // frame: [total][type][req_id q][status i][flags i][data][crc32*]
+      bool crc = s->checksum.load(std::memory_order_relaxed) &&
+                 status == kStatusOk && count > 0;
+      size_t trailer = crc ? (size_t)count * 4 : 0;
+      uint32_t out_total = (uint32_t)(8 + 16 + resp_len + trailer);
       size_t base = c->out.size();
       c->out.resize(base + out_total);
       uint8_t* o = c->out.data() + base;
@@ -188,9 +221,10 @@ bool process_frames(Server* s, Conn* c) {
       memcpy(o + 4, &kRespType, 4);
       memcpy(o + 8, &req_id, 8);
       memcpy(o + 16, &status, 4);
-      uint32_t flags = 0;
+      uint32_t flags = crc ? kFlagCrc32 : 0;
       memcpy(o + 20, &flags, 4);
       uint8_t* data = o + 24;
+      uint8_t* crcs = o + 24 + resp_len;
       if (status == kStatusOk) {
         for (uint32_t i = 0; i < count; ++i) {
           uint32_t token, length;
@@ -200,6 +234,12 @@ bool process_frames(Server* s, Conn* c) {
           memcpy(&length, blocks + i * 16 + 12, 4);
           const MappedFile& f = s->files.at(token);
           memcpy(data, (const char*)f.base + offset, length);
+          if (crc) {
+            // checksum the RESPONSE copy, not the mapped file: the check
+            // must cover this server's own read+copy, end to end
+            uint32_t sum = crc32_ieee(data, length);
+            memcpy(crcs + (size_t)i * 4, &sum, 4);
+          }
           data += length;
         }
         s->bytes_served += resp_len;
@@ -415,6 +455,12 @@ void* bs_create(const char* host, uint16_t port, int num_threads,
 }
 
 uint16_t bs_port(void* handle) { return ((Server*)handle)->port; }
+
+// Toggle per-block CRC32 response trailers (FLAG_CRC32). Plumbed from the
+// fetch_checksum config key so both serving paths speak one contract.
+void bs_set_checksum(void* handle, int enabled) {
+  ((Server*)handle)->checksum.store(enabled != 0);
+}
 
 // mmap `path` and serve it under `token`. Returns 0 on success.
 int bs_register_file(void* handle, uint32_t token, const char* path) {

@@ -1,32 +1,41 @@
 #!/usr/bin/env bash
 # Seeded chaos sweep: run the fault-injection scenario matrix
 # (tests/test_chaos.py, `chaos` marker — including the `slow` wide
-# matrix) across a set of injector seeds. Each scenario asserts
+# matrix) across a set of injector seeds, on BOTH fetch dataplanes
+# (coalesced vectored reads and the per-map fallback — the failure paths
+# differ, so the matrix covers each). Every scenario asserts
 # byte-identical reduce output under its faults and embeds the seed in
 # any failure message, so a red sweep replays exactly:
 #
-#     CHAOS_SEED=<seed> python -m pytest tests/test_chaos.py -m chaos
+#     CHAOS_SEED=<seed> CHAOS_COALESCE=<0|1> \
+#         python -m pytest tests/test_chaos.py -m chaos
 #
 # Usage: scripts/run_chaos.sh [seed ...]
 #   CHAOS_SEEDS="0 1 2"   alternative way to pass the seed list
+#   CHAOS_COALESCE_MODES="0 1"  dataplanes to sweep (default both)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 SEEDS=${*:-${CHAOS_SEEDS:-"0 1 2 3 4 5 6 7"}}
+MODES=${CHAOS_COALESCE_MODES:-"1 0"}
 failed=()
-for seed in $SEEDS; do
-  echo "=== chaos sweep: seed ${seed} ==="
-  if ! CHAOS_SEED="${seed}" JAX_PLATFORMS=cpu \
-       python -m pytest tests/test_chaos.py -q -m chaos \
-         -p no:cacheprovider -p no:randomly; then
-    echo "!!! seed ${seed} FAILED — replay with:"
-    echo "    CHAOS_SEED=${seed} python -m pytest tests/test_chaos.py -m chaos"
-    failed+=("${seed}")
-  fi
+for coalesce in $MODES; do
+  for seed in $SEEDS; do
+    echo "=== chaos sweep: seed ${seed} coalesce=${coalesce} ==="
+    if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
+         JAX_PLATFORMS=cpu \
+         python -m pytest tests/test_chaos.py -q -m chaos \
+           -p no:cacheprovider -p no:randomly; then
+      echo "!!! seed ${seed} coalesce=${coalesce} FAILED — replay with:"
+      echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
+           "python -m pytest tests/test_chaos.py -m chaos"
+      failed+=("${seed}/c${coalesce}")
+    fi
+  done
 done
 
 if [ "${#failed[@]}" -gt 0 ]; then
-  echo "chaos sweep: FAILED seeds: ${failed[*]}"
+  echo "chaos sweep: FAILED (seed/dataplane): ${failed[*]}"
   exit 1
 fi
-echo "chaos sweep: all seeds green"
+echo "chaos sweep: all seeds green on both dataplanes"

@@ -84,6 +84,28 @@ _KEYS = [
              "(send_queue_depth // cores, the reference's division, "
              "RdmaShuffleFetcherIterator.scala:82-83); 1 = fully sequential "
              "fetch (pre-pipelining behavior, the regression escape hatch)."),
+    _Key("coalesce_reads", True, "bool",
+         doc="Per-peer batching at both fetch levels: ONE batched "
+             "location RPC per (shuffle, peer) covering every map the "
+             "reducer needs there (FetchOutputsReq — O(peers) instead of "
+             "O(maps) metadata round trips), and VECTORED data reads "
+             "merging block ranges across maps bound for the same peer "
+             "into single request frames. Off = the per-map dataplane "
+             "(one location RPC per map, data groups never span maps) — "
+             "today's exact wire traffic, kept as the regression escape "
+             "hatch and the mixed-version fallback."),
+    _Key("max_vectored_bytes", "1m", "bytes", 1024, 1 << 34,
+         doc="Max payload bytes of one coalesced (cross-map) vectored "
+             "read; floored at shuffle_read_block_size. Per-map grouping "
+             "still caps at shuffle_read_block_size — this bounds how "
+             "many such groups one request frame may carry."),
+    _Key("max_fetch_blocks", 0, "int", 0, 1 << 20,
+         doc="Max (buf, offset, length) ranges in one data request frame; "
+             "0 = auto-derive from the native block server's inbound "
+             "frame cap (csrc/blockserver.cpp kMaxReqFrame, mirrored as "
+             "messages.NATIVE_MAX_REQ_FRAME) with an 8x safety margin so "
+             "a wide, mostly-empty partition range can never build a "
+             "frame the C++ server rejects."),
     _Key("pre_warm_connections", True, "bool",
          doc="Dial peer control connections the moment an announce names "
              "them (ref pre-connects requestor channels on announce, "
@@ -263,6 +285,25 @@ class TpuShuffleConf:
         if depth <= 0:
             depth = self.send_queue_depth // max(1, os.cpu_count() or 1)
         return max(1, depth)
+
+    def resolved_max_fetch_blocks(self) -> int:
+        """Block-count bound for one data request frame: the configured
+        value, or (when 0/auto) derived from the native server's inbound
+        frame cap — ``(kMaxReqFrame / 8 - fixed) / block_size`` — so the
+        Python planner can never build a request the C++ server rejects,
+        with the same 8x margin the old hardcoded 8192 kept below the
+        server's in-flight buffering high-water mark."""
+        from sparkrdma_tpu.parallel import messages as M
+
+        explicit = self.max_fetch_blocks
+        derived = ((M.NATIVE_MAX_REQ_FRAME // 8 - M.BLOCKS_REQ_FIXED_BYTES)
+                   // M.BLOCK_WIRE_BYTES)
+        # even an explicit value is clamped to what ONE native frame can
+        # physically carry: past it the C++ server drops the connection
+        # as a protocol error, which no retry heals
+        hard = ((M.NATIVE_MAX_REQ_FRAME - M.BLOCKS_REQ_FIXED_BYTES)
+                // M.BLOCK_WIRE_BYTES)
+        return max(1, min(explicit if explicit > 0 else derived, hard))
 
     def prealloc_spec(self) -> Dict[int, int]:
         """Parse 'size:count,size:count' into {bytes: count}.

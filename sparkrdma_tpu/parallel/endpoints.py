@@ -853,6 +853,8 @@ class ExecutorEndpoint:
             return None
         if isinstance(msg, M.FetchOutputReq):
             return self._on_fetch_output(msg)
+        if isinstance(msg, M.FetchOutputsReq):
+            return self._on_fetch_outputs(msg)
         if isinstance(msg, M.FetchBlocksReq):
             if not self.conf.sw_flow_control:
                 return self._on_fetch_blocks(msg)
@@ -870,7 +872,8 @@ class ExecutorEndpoint:
             return M.PongMsg(msg.req_id)
         if isinstance(msg, M.PongMsg):
             return None  # pong landed after its ping's deadline: stale
-        if isinstance(msg, (M.FetchOutputResp, M.FetchTableResp)):
+        if isinstance(msg, (M.FetchOutputResp, M.FetchOutputsResp,
+                            M.FetchTableResp)):
             # orphan of a cancelled/timed-out request (the fetcher
             # cancels whole read-ahead windows on failure); unlike block
             # responses these carry no credits, so dropping is complete
@@ -930,6 +933,35 @@ class ExecutorEndpoint:
             return M.FetchOutputResp(msg.req_id, M.STATUS_BAD_RANGE, b"")
         return M.FetchOutputResp(msg.req_id, M.STATUS_OK,
                                  table.get_range(msg.start_partition, msg.end_partition))
+
+    def _on_fetch_outputs(self, msg: M.FetchOutputsReq) -> RpcMsg:
+        """Serve MANY maps' 16B location entries in one response (the
+        batched metadata read of the coalesced dataplane). Per-map
+        statuses answer each map authoritatively — one unpublished map
+        doesn't hide the others' entries — while structural problems
+        (no data source, a response past the payload cap) fail the whole
+        request."""
+        if self.data_source is None:
+            return M.FetchOutputsResp(msg.req_id, M.STATUS_ERROR, [])
+        from sparkrdma_tpu.shuffle.map_output import ENTRY_SIZE
+
+        span = msg.end_partition - msg.start_partition
+        if (msg.start_partition < 0 or span < 0
+                or span * ENTRY_SIZE * max(1, len(msg.map_ids))
+                > self._MAX_RESP_PAYLOAD):
+            return M.FetchOutputsResp(msg.req_id, M.STATUS_BAD_RANGE, [])
+        records = []
+        for map_id in msg.map_ids:
+            table = self.data_source.get_output_table(msg.shuffle_id, map_id)
+            if table is None:
+                records.append((map_id, M.STATUS_UNKNOWN_MAP, b""))
+            elif not (msg.start_partition <= msg.end_partition
+                      <= table.num_partitions):
+                records.append((map_id, M.STATUS_BAD_RANGE, b""))
+            else:
+                records.append((map_id, M.STATUS_OK, table.get_range(
+                    msg.start_partition, msg.end_partition)))
+        return M.FetchOutputsResp(msg.req_id, M.STATUS_OK, records)
 
     # Response-payload caps, mirroring the native server's kMaxRespPayload:
     # reject before reading so an oversized request can't build a frame the
@@ -1213,6 +1245,66 @@ class ExecutorEndpoint:
         return self.fetch_output_range_async(peer, shuffle_id, map_id,
                                              start, end).result()
 
+    # One batched-location response stays well under the serving payload
+    # cap; the client chunks its map list so even a 100k-map shuffle with a
+    # wide reduce range asks in a few bounded requests, not one huge one.
+    _MAX_OUTPUTS_BATCH_BYTES = 4 << 20
+
+    def outputs_batch_maps(self, start: int, end: int) -> int:
+        """How many maps one FetchOutputsReq may carry for this reduce
+        range (entry bytes bounded by ``_MAX_OUTPUTS_BATCH_BYTES``)."""
+        from sparkrdma_tpu.shuffle.map_output import ENTRY_SIZE
+
+        span_bytes = max(1, (end - start) * ENTRY_SIZE)
+        return max(1, self._MAX_OUTPUTS_BATCH_BYTES // span_bytes)
+
+    def fetch_outputs_async(self, peer: ShuffleManagerId, shuffle_id: int,
+                            map_ids, start: int, end: int) -> AsyncFetch:
+        """Issue ONE batched location read covering many maps of one peer
+        (the metadata half of the coalesced dataplane). ``result()``
+        returns ``{map_id: [BlockLocation, ...]}``; any per-map non-OK
+        status raises a non-retryable :class:`FetchStatusError` carrying
+        ``map_id`` so the fetcher blames the right map (the owner
+        answered authoritatively — only a recompute heals it)."""
+        map_ids = list(map_ids)
+        try:
+            conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        except TransportError as e:
+            return self._failed_fetch(e)
+        fut = conn.request_async(
+            M.FetchOutputsReq(conn.next_req_id(), shuffle_id, map_ids,
+                              start, end))
+
+        def complete(resp):
+            assert isinstance(resp, M.FetchOutputsResp)
+            if resp.status != M.STATUS_OK:
+                raise FetchStatusError("fetch_outputs", resp.status,
+                                       retryable=False)
+            out = {}
+            for map_id, mstatus, entries in resp.records:
+                if mstatus != M.STATUS_OK:
+                    err = FetchStatusError(f"fetch_outputs map {map_id}",
+                                           mstatus, retryable=False)
+                    err.map_id = map_id
+                    raise err
+                out[map_id] = MapTaskOutput.locations_from_range(entries)
+            missing = [m for m in map_ids if m not in out]
+            if missing:
+                # a malformed/short reply must not silently truncate the
+                # reduce input; treat like a lost response (refetchable)
+                raise TransportError(
+                    f"fetch_outputs reply missing maps {missing[:4]}"
+                    f"{'...' if len(missing) > 4 else ''}")
+            return out
+
+        return AsyncFetch(fut, self.conf.resolved_request_deadline_s(),
+                          complete)
+
+    def fetch_outputs(self, peer: ShuffleManagerId, shuffle_id: int,
+                      map_ids, start: int, end: int):
+        return self.fetch_outputs_async(peer, shuffle_id, map_ids,
+                                        start, end).result()
+
     def _register_credit(self, conn: Connection,
                          req: "M.FetchBlocksReq", credited: bool) -> bool:
         """Receipt-credit accounting, issue half: remember the request's
@@ -1423,8 +1515,11 @@ class ExecutorEndpoint:
         """Check and strip the per-block CRC32 trailer. Block lengths come
         from the REQUEST (both sides derive the layout independently —
         the trailer can't lie about where blocks start). Raises the
-        retryable :class:`ChecksumError`; the fetcher refetches within
-        its budget before escalating to FetchFailed."""
+        retryable :class:`ChecksumError`; every block is checked (not
+        fail-fast) so the error carries the FULL list of bad block
+        indices plus the stripped body — a vectored fetch salvages the
+        clean sub-ranges and refetches only the corrupt ones, blaming the
+        map that owns them."""
         import struct
         import zlib
         n = len(req.blocks)
@@ -1437,12 +1532,17 @@ class ExecutorEndpoint:
                 f"bytes for {sum(lengths)} requested")
         crcs = struct.unpack_from(f"<{n}I", data, body_len)
         body = memoryview(data)[:body_len]
+        bad = []
         pos = 0
         for i, length in enumerate(lengths):
             if zlib.crc32(body[pos:pos + length]) != crcs[i]:
-                self.checksum_failures += 1
-                raise ChecksumError(
-                    f"fetch_blocks block {i}/{n} failed CRC32 "
-                    f"(corruption in flight or at the server)")
+                bad.append(i)
             pos += length
+        if bad:
+            self.checksum_failures += len(bad)
+            raise ChecksumError(
+                f"fetch_blocks blocks {bad[:8]}"
+                f"{'...' if len(bad) > 8 else ''} of {n} failed CRC32 "
+                f"(corruption in flight or at the server)",
+                bad_blocks=bad, body=bytes(body))
         return bytes(body)

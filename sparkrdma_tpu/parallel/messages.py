@@ -13,9 +13,22 @@ remain exactly the reference's three-level scheme:
 * ``FetchOutputReq/Resp`` — the per-(map, reduce-range) block-location READ
                         of 16-byte entries out of the owning executor
                         (scala/RdmaShuffleFetcherIterator.scala:293-315).
+* ``FetchOutputsReq/Resp`` — the batched form: ONE request returns the
+                        16-byte location entries of MANY maps' output
+                        tables for one reduce range — O(peers) instead of
+                        O(maps) metadata round trips, the role the
+                        reference's fetch-a-peer's-whole-address-table-once
+                        plays (scala/RdmaShuffleManager.scala:341-376).
+                        The per-map form stays as the mixed-version
+                        fallback.
 * ``FetchBlocksReq/Resp`` — the scatter data READ (DCN fallback path; on-mesh
                         traffic rides the ICI ragged all-to-all instead)
                         (scala/RdmaShuffleFetcherIterator.scala:119-180).
+                        The block list may span different maps and buffer
+                        tokens — one VECTORED request per coalesced window
+                        of cross-map ranges; both the Python and native
+                        servers gather the ranges in request order into a
+                        single response with a per-sub-block CRC32 trailer.
 
 All carry a ``req_id`` echo so clients can pipeline requests per connection
 the way the reference pipelines work requests on a QP.
@@ -32,6 +45,16 @@ _QIII = struct.Struct("<qiii")
 _QI = struct.Struct("<qi")
 _Q = struct.Struct("<q")
 _BLOCK = struct.Struct("<IQI")  # (buf token, offset, length)
+
+# Native block-server request-frame geometry, mirrored from
+# csrc/blockserver.cpp so Python-side request planning can be DERIVED from
+# the C++ limit instead of hardcoding a constant that silently drifts
+# (tests/test_fetch_coalesced.py greps the .cpp to keep them in lockstep):
+#   kMaxReqFrame — hard cap on one inbound frame on the data port;
+#   frame layout — [total:4][type:4][req_id:8][shuffle:4][count:4][blocks].
+NATIVE_MAX_REQ_FRAME = 1 << 20          # csrc/blockserver.cpp kMaxReqFrame
+BLOCKS_REQ_FIXED_BYTES = 8 + _QI.size + 4   # header + req_id/shuffle + count
+BLOCK_WIRE_BYTES = _BLOCK.size          # one (buf, offset, length) range
 
 
 @register(3)
@@ -180,8 +203,12 @@ FLAG_CRC32 = 4    # the logical payload carries a trailer of one
                   # little-endian u32 CRC32 per requested block, appended
                   # BEFORE compression/codec so the check is end-to-end
                   # (server read -> client consume). Readers verify and
-                  # strip; responders that can't checksum (native block
-                  # server) simply don't set the flag.
+                  # strip; both the Python responder and the native block
+                  # server (bs_set_checksum) set it, and a responder that
+                  # can't checksum simply doesn't set the flag. Per-BLOCK
+                  # granularity is what lets a vectored (cross-map) read
+                  # isolate a corrupt sub-range to one map and refetch
+                  # only the affected ranges.
 
 _QII = struct.Struct("<qii")
 
@@ -337,6 +364,72 @@ class PongMsg(RpcMsg):
     def from_payload(cls, payload: bytes) -> "PongMsg":
         (req_id,) = _Q.unpack_from(payload, 0)
         return cls(req_id)
+
+
+@register(18)
+class FetchOutputsReq(RpcMsg):
+    """Batched block-location read: the 16B entries [start, end) of MANY
+    maps' output tables in one round trip (one per (shuffle, peer) for
+    reducers with coalesced reads on — the metadata half of the RPC-count
+    reduction). ``map_ids`` is explicit rather than a range: a reducer
+    only asks for the maps the driver table routed to this peer."""
+
+    def __init__(self, req_id: int, shuffle_id: int, map_ids: List[int],
+                 start_partition: int, end_partition: int):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+        self.map_ids = list(map_ids)
+        self.start_partition = start_partition
+        self.end_partition = end_partition
+
+    def payload(self) -> bytes:
+        head = (_QIII.pack(self.req_id, self.shuffle_id,
+                           self.start_partition, self.end_partition)
+                + struct.pack("<I", len(self.map_ids)))
+        return head + struct.pack(f"<{len(self.map_ids)}i", *self.map_ids)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchOutputsReq":
+        req_id, shuffle_id, start, end = _QIII.unpack_from(payload, 0)
+        (n,) = struct.unpack_from("<I", payload, _QIII.size)
+        map_ids = list(struct.unpack_from(f"<{n}i", payload, _QIII.size + 4))
+        return cls(req_id, shuffle_id, map_ids, start, end)
+
+
+@register(19)
+class FetchOutputsResp(RpcMsg):
+    """Per-map records ``(map_id, status, entries)`` in request order.
+    ``status`` is the overall verdict (a non-OK overall status carries no
+    records); per-map statuses let one unknown map answer authoritatively
+    without hiding the other maps' entries."""
+
+    def __init__(self, req_id: int, status: int,
+                 records: List[Tuple[int, int, bytes]]):
+        self.req_id = req_id
+        self.status = status
+        self.records = list(records)
+
+    def payload(self) -> bytes:
+        out = [_QI.pack(self.req_id, self.status),
+               struct.pack("<I", len(self.records))]
+        for map_id, status, entries in self.records:
+            out.append(struct.pack("<iiI", map_id, status, len(entries)))
+            out.append(entries)
+        return b"".join(out)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchOutputsResp":
+        req_id, status = _QI.unpack_from(payload, 0)
+        off = _QI.size
+        (n,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        records = []
+        for _ in range(n):
+            map_id, mstatus, nbytes = struct.unpack_from("<iiI", payload, off)
+            off += 12
+            records.append((map_id, mstatus, payload[off:off + nbytes]))
+            off += nbytes
+        return cls(req_id, status, records)
 
 
 # Status codes shared by responses.

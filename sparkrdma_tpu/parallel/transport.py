@@ -54,7 +54,20 @@ class TransportError(RuntimeError):
 class ChecksumError(TransportError):
     """A fetch payload failed its CRC32 verification (bit-flip on the
     wire, or corruption at the server between read and send). Always
-    retryable: the refetch re-reads the source bytes."""
+    retryable: the refetch re-reads the source bytes.
+
+    When the verifier can tell WHICH blocks failed it attaches
+    ``bad_blocks`` (request-order indices) and ``body`` (the full
+    trailer-stripped payload): a vectored (cross-map) fetch then salvages
+    every clean sub-range and refetches only the ranges that actually
+    failed, attributing the retry to the map that owns them. Both stay
+    ``None`` for failures with no per-block verdict (decompress/unwrap
+    errors, size mismatches) — those retry whole-request."""
+
+    def __init__(self, msg: str, bad_blocks=None, body=None):
+        super().__init__(msg)
+        self.bad_blocks = bad_blocks
+        self.body = body
 
 
 class FetchStatusError(TransportError):

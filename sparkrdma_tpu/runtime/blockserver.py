@@ -34,7 +34,8 @@ class BlockServer:
     """
 
     def __init__(self, port: int = 0, host: str = "",
-                 threads: int = 1, cpus: Sequence[int] = ()):
+                 threads: int = 1, cpus: Sequence[int] = (),
+                 checksum: bool = False):
         if not native.available():
             raise RuntimeError("native runtime not built (make -C csrc)")
         addr = socket.gethostbyname(host) if host else ""
@@ -46,6 +47,26 @@ class BlockServer:
                           f":{port}")
         self._lock = threading.Lock()
         self._stopped = False
+        if checksum:
+            self.set_checksum(True)
+
+    def set_checksum(self, enabled: bool) -> None:
+        """Per-block CRC32 response trailers (FLAG_CRC32), matching the
+        Python serving path — what lets a client isolate a corrupt
+        sub-range of a vectored response to one block/map. Requires a
+        .so built with ``bs_set_checksum``; a stale library degrades to
+        unchecksummed responses (clients verify only when the flag is
+        present)."""
+        with self._lock:
+            if self._stopped:
+                return
+            fn = getattr(native.LIB, "bs_set_checksum", None)
+            if fn is None:  # pre-CRC .so
+                log.warning("libtpushuffle.so predates bs_set_checksum; "
+                            "native responses stay unchecksummed "
+                            "(rebuild with make -C csrc)")
+                return
+            fn(self._h, int(enabled))
 
     @property
     def port(self) -> int:
@@ -102,7 +123,7 @@ def maybe_create(conf, host: str = "") -> Optional[BlockServer]:
                             "%r (expected a comma-separated core list)", part)
         try:
             return BlockServer(host=host, threads=conf.block_server_threads,
-                               cpus=cpus)
+                               cpus=cpus, checksum=conf.fetch_checksum)
         except (OSError, socket.gaierror) as e:
             log.warning("native block server unavailable, serving via the "
                         "control path instead: %s", e)

@@ -68,6 +68,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bs_create.restype = vp
     lib.bs_port.argtypes = [vp]
     lib.bs_port.restype = u16
+    # optional symbol: a pre-CRC .so must degrade to unchecksummed native
+    # responses (BlockServer.set_checksum warns), not disable the whole
+    # native runtime the way a missing REQUIRED symbol does
+    if hasattr(lib, "bs_set_checksum"):
+        lib.bs_set_checksum.argtypes = [vp, ctypes.c_int]
+        lib.bs_set_checksum.restype = None
     lib.bs_register_file.argtypes = [vp, ctypes.c_uint32, cp]
     lib.bs_register_file.restype = ctypes.c_int
     lib.bs_unregister_file.argtypes = [vp, ctypes.c_uint32]

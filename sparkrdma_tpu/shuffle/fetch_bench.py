@@ -49,9 +49,15 @@ def run_fetch_microbench(spill_root: str,
     """
     import os
 
+    # coalescing off ON PURPOSE: this harness measures the read-ahead
+    # window's overlap of many per-map requests; the coalesced dataplane
+    # would merge them into a handful of vectored frames and measure
+    # nothing (its RPC-count win has its own harness below,
+    # run_coalesce_microbench)
     conf_kw = dict(connect_timeout_ms=20000,
                    shuffle_read_block_size=block_bytes,
                    serve_threads=serve_threads,
+                   coalesce_reads=False,
                    use_cpp_runtime=False)
     driver = TpuShuffleManager(TpuShuffleConf(**conf_kw), is_driver=True)
     execs = [TpuShuffleManager(TpuShuffleConf(**conf_kw),
@@ -122,6 +128,89 @@ def run_fetch_microbench(spill_root: str,
             "fetches": fetch_count,
             "delay_s": delay_s,
             "pipeline": pipeline_snap,
+        }
+    finally:
+        for ex in execs:
+            ex.stop()
+        driver.stop()
+
+
+def run_coalesce_microbench(spill_root: str,
+                            num_maps: int = 64,
+                            num_partitions: int = 8,
+                            block_bytes: int = 4096,
+                            read_ahead_depth: int = 8) -> Dict:
+    """The coalesced dataplane's RPC-count win, measured: a many-small-maps
+    shuffle (the workload "RPC Considered Harmful" names — request/response
+    cycles dominate, not bandwidth) drained twice over loopback at equal
+    total bytes, once per-map and once coalesced. Returns::
+
+        {"requests": {"per_map": N, "coalesced": N},
+         "rpc_reduction": per_map / coalesced,
+         "identical": bool, "bytes": total_payload_bytes}
+
+    ``requests`` counts REQUEST FRAMES on the wire (location RPCs + data
+    reads, via ``ReadMetrics.requests_per_reduce``); ``identical`` is the
+    byte-level parity gate. Shared by ``bench.py`` (the
+    ``fetch_rpc_reduction`` secondary) and the tier-1 test asserting the
+    >=5x reduction."""
+    import os
+
+    conf_kw = dict(connect_timeout_ms=20000,
+                   shuffle_read_block_size=block_bytes,
+                   read_ahead_depth=read_ahead_depth,
+                   use_cpp_runtime=False)
+    driver = TpuShuffleManager(TpuShuffleConf(**conf_kw), is_driver=True)
+    execs = [TpuShuffleManager(TpuShuffleConf(**conf_kw),
+                               driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=os.path.join(spill_root, f"c{i}"))
+             for i in range(2)]
+    try:
+        for ex in execs:
+            ex.executor.wait_for_members(2)
+        payload_w = 24  # 8B key + 24B payload = 32B rows
+        handle = driver.register_shuffle(2, num_maps, num_partitions,
+                                         PartitionerSpec("modulo"),
+                                         row_payload_bytes=payload_w)
+        rng = np.random.default_rng(1)
+        keys = np.repeat(np.arange(num_partitions, dtype=np.uint64), 4)
+        for m in range(num_maps):
+            w = execs[0].get_writer(handle, m)
+            w.write_batch(keys, rng.integers(
+                0, 255, (len(keys), payload_w), dtype=np.uint64
+            ).astype(np.uint8))
+            w.close()
+
+        requests: Dict[str, int] = {}
+        fetched: Dict[str, list] = {}
+        total_bytes = 0
+        for mode, coalesce in (("per_map", False), ("coalesced", True)):
+            conf_m = TpuShuffleConf(**dict(conf_kw, coalesce_reads=coalesce))
+            reader = TpuShuffleReader(
+                execs[1].executor, execs[1].resolver, conf_m,
+                handle.shuffle_id, num_maps, 0, num_partitions, payload_w)
+            results = []
+            reader.fetcher.start()
+            try:
+                for r in reader.fetcher:
+                    results.append((r.map_id, r.start_partition,
+                                    r.end_partition, bytes(r.data)))
+                    r.free()
+            finally:
+                reader.fetcher.close()
+            requests[mode] = reader.metrics.requests_per_reduce
+            fetched[mode] = sorted(results)
+            total_bytes = sum(len(d) for _, _, _, d in results)
+        return {
+            "requests": requests,
+            "rpc_reduction": (round(requests["per_map"]
+                                    / requests["coalesced"], 2)
+                              if requests["coalesced"] else 0.0),
+            "identical": fetched["per_map"] == fetched["coalesced"],
+            "bytes": total_bytes,
+            "maps": num_maps,
+            "partitions": num_partitions,
         }
     finally:
         for ex in execs:

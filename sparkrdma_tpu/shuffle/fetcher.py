@@ -22,7 +22,23 @@ point by point:
   the ``sendQueueDepth / cores`` in-flight split that the reference's
   whole speedup rides on (:82-83). ``read_ahead_depth=1`` reproduces the
   fully sequential pre-pipelining behavior exactly (regression escape
-  hatch).
+  hatch);
+* **coalesced reads** (``coalesce_reads``, on by default): per-peer
+  batching at BOTH fetch levels. STEP 2 becomes ONE batched location RPC
+  per (shuffle, peer) covering every map this reducer needs there —
+  O(peers) instead of O(maps) metadata round trips, the unit the
+  reference fetches when it READs a peer's whole address table once
+  (RdmaShuffleManager.scala:341-376). STEP 3 becomes VECTORED reads:
+  per-map groups bound for the same peer merge across maps into single
+  request frames (up to ``max_vectored_bytes``/frame caps), each landing
+  in one refcounted multi-view pool lease the way the reference lands
+  one scatter-READ of many blocks in a single registration
+  (java/RdmaRegisteredBuffer.java:28-87). Per-map attribution is kept:
+  every vectored response is sliced back into per-(map, range) results,
+  and a corrupt sub-block (per-block CRC trailer) refetches ONLY the
+  affected ranges, blaming the owning map. A peer that fails the first
+  batched call (mixed-version: an old server drops the unknown frame)
+  falls back to the per-map dataplane for that peer.
 """
 
 from __future__ import annotations
@@ -36,6 +52,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from sparkrdma_tpu.config import TpuShuffleConf
 from sparkrdma_tpu.parallel.endpoints import (
     DeadExecutorError,
@@ -44,6 +62,7 @@ from sparkrdma_tpu.parallel.endpoints import (
 from sparkrdma_tpu.parallel.transport import (
     Backoff,
     ChecksumError,
+    FetchStatusError,
     TransportError,
 )
 from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
@@ -71,7 +90,14 @@ class FetchFailedError(Exception):
 
 @dataclass
 class FetchResult:
-    """One successful grouped fetch (or the failure/sentinel marker)."""
+    """One successful grouped fetch (or the failure/sentinel marker).
+
+    ``data`` is bytes, or — when a vectored response landed in a pool
+    lease — a uint8 numpy view into the shared
+    :class:`~sparkrdma_tpu.runtime.pool.RegisteredBuffer` (``lease``).
+    Lease-backed results must be :meth:`free`\\ d once consumed so the
+    pool buffer returns on last release; ``free`` is a no-op otherwise.
+    Use ``len(data)``, not truthiness (ndarray truthiness raises)."""
 
     map_id: int = -1
     start_partition: int = 0
@@ -80,6 +106,13 @@ class FetchResult:
     is_local: bool = False
     failure: Optional[FetchFailedError] = None
     is_sentinel: bool = False
+    lease: Optional[object] = None  # RegisteredBuffer holding `data`'s view
+
+    def free(self) -> None:
+        """Release this result's reference on the shared pool lease."""
+        if self.lease is not None:
+            lease, self.lease = self.lease, None
+            lease.release()
 
 
 @dataclass
@@ -99,6 +132,11 @@ class ReadMetrics:
     retries: int = 0
     checksum_failures: int = 0
     failed_fetches: int = 0
+    # request frames this reducer put on the wire: location RPCs (per-map
+    # or batched) + data reads (grouped or vectored), retries included —
+    # the RPC-count the coalesced dataplane exists to shrink. The
+    # coalescing tier-1 test asserts this drops vs the per-map path.
+    requests_per_reduce: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_remote(self, nbytes: int, latency_s: float) -> None:
@@ -106,6 +144,10 @@ class ReadMetrics:
             self.remote_bytes += nbytes
             self.remote_fetches += 1
             self.fetch_latencies_s.append(latency_s)
+
+    def record_request(self) -> None:
+        with self._lock:
+            self.requests_per_reduce += 1
 
     def record_local(self, nbytes: int) -> None:
         with self._lock:
@@ -135,6 +177,19 @@ class _PendingFetch:
     total_bytes: int
 
 
+@dataclass
+class _VectoredFetch:
+    """One coalesced data request: per-map groups merged across maps for
+    one peer. ``blocks`` is the request-order concatenation of every
+    segment's ranges; the response payload slices back into per-segment
+    results positionally, so per-map attribution survives the merge."""
+
+    exec_index: int
+    segments: List[_PendingFetch]
+    blocks: List  # [(buf, offset, length)] across all segments
+    total_bytes: int
+
+
 class ShuffleFetcher:
     """Iterator of FetchResults for one reducer's partition range."""
 
@@ -142,11 +197,17 @@ class ShuffleFetcher:
                  resolver: Optional[TpuShuffleBlockResolver],
                  conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
                  start_partition: int, end_partition: int,
-                 seed: Optional[int] = None, reader_stats=None, tracer=None):
+                 seed: Optional[int] = None, reader_stats=None, tracer=None,
+                 pool=None):
         from sparkrdma_tpu.utils import trace as trace_mod
         self.endpoint = endpoint
         self.resolver = resolver
         self.conf = conf
+        # staging pool (runtime/pool.py): when present, each vectored
+        # response lands in ONE refcounted multi-view RegisteredBuffer
+        # lease — many logical blocks, one pool buffer, returned on last
+        # consumer release (java/RdmaRegisteredBuffer.java:28-87)
+        self.pool = pool
         self.reader_stats = reader_stats  # ShuffleReaderStats | None
         self.tracer = tracer or trace_mod.NULL
         self.shuffle_id = shuffle_id
@@ -262,11 +323,17 @@ class ShuffleFetcher:
             # suspect so the retry envelope escalates instead of re-dialing
             self.endpoint.watch_peer(exec_idx, peer)
             try:
-                if depth <= 1:
-                    self._fetch_sequential(peer, exec_idx, maps, count_lock)
-                else:
-                    self._fetch_pipelined(peer, exec_idx, maps, count_lock,
-                                          depth)
+                served = False
+                if self.conf.coalesce_reads:
+                    served = self._fetch_coalesced(peer, exec_idx, maps,
+                                                   count_lock, depth)
+                if not served:
+                    if depth <= 1:
+                        self._fetch_sequential(peer, exec_idx, maps,
+                                               count_lock)
+                    else:
+                        self._fetch_pipelined(peer, exec_idx, maps,
+                                              count_lock, depth)
             finally:
                 self.endpoint.unwatch_peer(exec_idx)
         except _Aborted:
@@ -282,8 +349,15 @@ class ShuffleFetcher:
         finally:
             with count_lock:
                 self._peer_threads_left -= 1
-                if self._peer_threads_left == 0:
+                last = self._peer_threads_left == 0
+                if last:
                     self._results.put(FetchResult(is_sentinel=True))
+            # an aborted iteration stops consuming: once nothing more
+            # can be enqueued, pool leases parked in the queue must be
+            # returned (close() drains too, but a completion racing it
+            # can land after that drain — this one cannot be raced)
+            if last and self._aborted.is_set():
+                self._drain_unconsumed()
 
     def _group_locations(self, exec_idx: int, m: int,
                          locs) -> List[_PendingFetch]:
@@ -291,14 +365,16 @@ class ShuffleFetcher:
         (:240-263). Zero-length blocks ride along byte-free but still
         count toward a block-count bound so a wide, mostly-empty
         partition range can't build a request frame past the native
-        server's 1 MiB inbound cap (csrc/blockserver.cpp kMaxReqFrame;
-        8192 blocks ~= 128 KiB of frame)."""
+        server's inbound frame cap — the bound is DERIVED from that cap
+        (csrc/blockserver.cpp kMaxReqFrame via
+        ``resolved_max_fetch_blocks``), not a constant that can drift
+        from the C++ limit."""
         pending: List[_PendingFetch] = []
         group: List = []
         group_start = self.start_partition
         group_bytes = 0
         limit = self.conf.shuffle_read_block_size
-        max_blocks = 8192
+        max_blocks = self.conf.resolved_max_fetch_blocks()
         for i, loc in enumerate(locs):
             p = self.start_partition + i
             if group and (group_bytes + loc.length > limit
@@ -313,6 +389,342 @@ class ShuffleFetcher:
                 exec_idx, m, group_start,
                 self.start_partition + len(locs), group, group_bytes))
         return pending
+
+    # -- coalesced dataplane (per-peer batching at both levels) ----------
+
+    def _coalesce_plan(self, exec_idx: int,
+                       groups: List[_PendingFetch]) -> List[_VectoredFetch]:
+        """Merge per-map groups bound for one peer into vectored requests
+        of at most ``max_vectored_bytes`` (floored at the per-map read
+        block size — coalescing must never shrink a request the per-map
+        planner would have sent whole) and the frame-derived block-count
+        cap. A single oversized group still rides alone, preserving the
+        per-map path's single-oversized-fetch escape."""
+        # clamp to what the servers will actually serve: multi-block
+        # responses past max(256 MiB, read block size) are answered
+        # BAD_RANGE — authoritative, so an oversized plan would re-fail
+        # identically on every stage retry (endpoints._MAX_RESP_PAYLOAD,
+        # csrc kMaxRespPayload)
+        from sparkrdma_tpu.parallel.endpoints import ExecutorEndpoint
+        limit = max(min(self.conf.max_vectored_bytes,
+                        ExecutorEndpoint._MAX_RESP_PAYLOAD),
+                    self.conf.shuffle_read_block_size)
+        max_blocks = self.conf.resolved_max_fetch_blocks()
+        plan: List[_VectoredFetch] = []
+        cur: List[_PendingFetch] = []
+        cur_bytes = cur_blocks = 0
+
+        def seal():
+            plan.append(_VectoredFetch(
+                exec_idx, list(cur), [b for s in cur for b in s.blocks],
+                cur_bytes))
+
+        for g in groups:
+            if cur and (cur_bytes + g.total_bytes > limit
+                        or cur_blocks + len(g.blocks) > max_blocks):
+                seal()
+                cur, cur_bytes, cur_blocks = [], 0, 0
+            cur.append(g)
+            cur_bytes += g.total_bytes
+            cur_blocks += len(g.blocks)
+        if cur:
+            seal()
+        return plan
+
+    def _fetch_coalesced(self, peer, exec_idx: int, maps: List[int],
+                         count_lock: threading.Lock, depth: int) -> bool:
+        """The coalesced dataplane for one peer: ONE batched location RPC
+        (chunked only past the endpoint's response-size bound), then
+        vectored cross-map data reads through the read-ahead window.
+        Returns False — caller falls back to the per-map dataplane —
+        when the first batched call fails at the transport level TWICE
+        (one guarded retry absorbs a transient blip): a mixed-version
+        peer doesn't know the frame type and tears the connection down
+        on every attempt, which lands here as TransportErrors. Later
+        failures ride the normal retry envelope (the peer has already
+        proven it speaks the batched protocol)."""
+        locs_by_map: Dict[int, List] = {}
+        per = self.endpoint.outputs_batch_maps(self.start_partition,
+                                               self.end_partition)
+        try:
+            for i in range(0, len(maps), per):
+                chunk = maps[i:i + per]
+
+                def read_chunk(chunk=chunk):
+                    self.metrics.record_request()
+                    with self.tracer.span("fetch.locations", "fetch",
+                                          peer=exec_idx, maps=len(chunk),
+                                          batched=True):
+                        return self.endpoint.fetch_outputs(
+                            peer, self.shuffle_id, chunk,
+                            self.start_partition, self.end_partition)
+
+                if i == 0:
+                    self._suspect_check(exec_idx, chunk[0])
+                    try:
+                        locs_by_map.update(read_chunk())
+                    except FetchStatusError:
+                        raise
+                    except (TransportError, TimeoutError) as e:
+                        # one guarded retry separates a transient blip
+                        # from a genuine mixed-version peer: demoting a
+                        # new-version peer to the per-map dataplane over
+                        # one dropped connection would silently erase the
+                        # RPC reduction for the whole reduce. A zero
+                        # retry budget means fail-fast everywhere — honor
+                        # it here too (straight to the per-map fallback)
+                        if self.conf.fetch_retry_budget <= 0:
+                            raise
+                        self._suspect_check(exec_idx, chunk[0])
+                        self._note_transient(e, "locations", exec_idx,
+                                             chunk[0], True, 1)
+                        if self._aborted.wait(self._backoff.delay(0)):
+                            raise _Aborted()
+                        locs_by_map.update(read_chunk())
+                else:
+                    locs_by_map.update(self._with_retries(
+                        "locations", exec_idx, chunk[0], read_chunk))
+        except FetchStatusError as e:
+            # authoritative per-map answer (unknown map / bad range): the
+            # per-map path would re-fail identically — escalate now
+            # (_fail blames the exact map the peer named when the status
+            # carries one)
+            self._fail("locations", exec_idx, maps[0], 1, e)
+        except (TransportError, TimeoutError) as e:
+            # a suspect verdict is what FAILED the batched call (the
+            # monitor closed the connection under it): falling back would
+            # re-dial a fresh connection the monitor never closes and
+            # wait out the full request deadline — escalate now instead
+            self._suspect_check(exec_idx, maps[0])
+            log.debug("batched location fetch from peer %d failed (%s); "
+                      "falling back to the per-map dataplane", exec_idx, e)
+            self.tracer.instant("fetch.coalesce_fallback", "fetch",
+                                peer=exec_idx, error=type(e).__name__)
+            return False
+        groups: List[_PendingFetch] = []
+        for m in maps:
+            groups.extend(self._group_locations(exec_idx, m,
+                                                locs_by_map[m]))
+        plan = self._coalesce_plan(exec_idx, groups)
+        # randomized issue order (:74-79), at vectored-request granularity
+        self._rng.shuffle(plan)
+        with count_lock:
+            self._expected_results += sum(len(v.segments) for v in plan)
+        if depth <= 1:
+            self._fetch_vectored_sequential(peer, exec_idx, plan)
+        else:
+            self._fetch_vectored_windowed(peer, exec_idx, plan, depth)
+        return True
+
+    def _fetch_vectored_sequential(self, peer, exec_idx: int,
+                                   plan: List[_VectoredFetch]) -> None:
+        for vf in plan:
+            if self._aborted.is_set():
+                raise _Aborted()
+            # same pre-issue fail-fast as the windowed path: the first
+            # attempt dials outside the retry envelope, and a fresh
+            # post-verdict connection is one the monitor never closes
+            self._suspect_check(exec_idx, vf.segments[0].map_id)
+            self._acquire_in_flight(vf.total_bytes)
+            t0 = time.monotonic()
+            try:
+                with self.tracer.span("fetch.vectored", "fetch",
+                                      peer=exec_idx,
+                                      maps=len(vf.segments),
+                                      blocks=len(vf.blocks),
+                                      bytes=vf.total_bytes):
+                    data = self._vectored_data(peer, exec_idx, vf)
+            except BaseException:
+                self._release_in_flight(vf.total_bytes)
+                raise
+            dt = time.monotonic() - t0
+            self.metrics.record_remote(len(data), dt)
+            if self.reader_stats is not None:
+                self.reader_stats.update(exec_idx, dt, nbytes=len(data))
+            self._emit_vectored(vf, data)
+
+    def _fetch_vectored_windowed(self, peer, exec_idx: int,
+                                 plan: List[_VectoredFetch],
+                                 depth: int) -> None:
+        """The read-ahead window over vectored requests: locations are
+        already in hand (one batched RPC), so the window carries only
+        STEP-3 data reads — same budget interplay as the per-map
+        pipelined path (never block on the byte gate while holding
+        completions)."""
+        ready: deque = deque((vf, time.monotonic()) for vf in plan)
+        inflight: deque = deque()  # (vf, AsyncFetch, t_ready, t_issue)
+        try:
+            while ready or inflight:
+                if self._aborted.is_set():
+                    raise _Aborted()
+                while ready and len(inflight) < depth:
+                    vf, t_ready = ready[0]
+                    # never issue into a suspect peer: a request on a
+                    # fresh post-verdict connection would wait out its
+                    # whole deadline (the monitor only closes cached
+                    # connections once, at verdict time)
+                    self._suspect_check(exec_idx, vf.segments[0].map_id)
+                    if not self._try_acquire_in_flight(
+                            vf.total_bytes, nonblocking=bool(inflight)):
+                        break
+                    ready.popleft()
+                    t_issue = time.monotonic()
+                    self.metrics.record_request()
+                    handle = self.endpoint.fetch_blocks_async(
+                        peer, self.shuffle_id, vf.blocks)
+                    inflight.append((vf, handle, t_ready, t_issue))
+                    self.pipeline.record_issue(exec_idx, len(inflight),
+                                               t_issue - t_ready)
+                if inflight:
+                    self._complete_oldest_vectored(peer, exec_idx, inflight)
+        except BaseException:
+            # same unwind contract as _fetch_pipelined: window-held budget
+            # and send-budget slots must not outlive the window
+            for vf, handle, _tr, _ti in inflight:
+                handle.cancel()
+                self._release_in_flight(vf.total_bytes)
+            raise
+
+    def _complete_oldest_vectored(self, peer, exec_idx: int,
+                                  inflight: deque) -> None:
+        vf, handle, t_ready, t_issue = inflight[0]
+        wire_done_s = None
+        try:
+            data = handle.result()
+            wire_done_s = handle.wire_done_s
+        except (TransportError, TimeoutError, AssertionError) as e:
+            inflight.popleft()
+            t_issue = time.monotonic()  # latency covers the serving retry
+            try:
+                data = self._vectored_data(peer, exec_idx, vf,
+                                           first_error=e)
+            except BaseException:
+                self._release_in_flight(vf.total_bytes)
+                raise
+        else:
+            inflight.popleft()
+        now = time.monotonic()
+        dt = now - t_issue
+        self.metrics.record_remote(len(data), dt)
+        if self.reader_stats is not None:
+            self.reader_stats.update(exec_idx, dt, nbytes=len(data))
+        if self.tracer.enabled:
+            end_us = self.tracer.now_us()
+            issue_us = end_us - (now - t_issue) * 1e6
+            ready_us = end_us - (now - t_ready) * 1e6
+            wire_us = (end_us - (now - wire_done_s) * 1e6
+                       if wire_done_s is not None else end_us)
+            wire_us = min(max(wire_us, issue_us), end_us)
+            map0 = vf.segments[0].map_id
+            # the per-map pipelined path's issue→wire→complete contract
+            # is kept (one trace schema either way); fetch.vectored adds
+            # the coalescing shape on top
+            self.tracer.complete_span("fetch.issue", "fetch",
+                                      ready_us, issue_us,
+                                      map=map0, peer=exec_idx)
+            self.tracer.complete_span("fetch.blocks", "fetch",
+                                      issue_us, wire_us, map=map0,
+                                      peer=exec_idx, bytes=vf.total_bytes)
+            self.tracer.complete_span("fetch.complete", "fetch",
+                                      wire_us, end_us,
+                                      map=map0, peer=exec_idx)
+            self.tracer.complete_span("fetch.vectored", "fetch",
+                                      issue_us, end_us, peer=exec_idx,
+                                      maps=len(vf.segments),
+                                      blocks=len(vf.blocks),
+                                      bytes=vf.total_bytes)
+        self._emit_vectored(vf, data)
+
+    def _vectored_data(self, peer, exec_idx: int, vf: _VectoredFetch,
+                       first_error: Optional[BaseException] = None) -> bytes:
+        """The payload of one vectored request, healed: a CRC failure
+        that names its bad blocks refetches ONLY the affected segments
+        (per-map blame); anything else retries whole-request under the
+        envelope, blamed on the request's first map."""
+
+        def read_all():
+            self.metrics.record_request()
+            return self.endpoint.fetch_blocks(peer, self.shuffle_id,
+                                              vf.blocks)
+
+        err = first_error
+        if err is None:
+            try:
+                return read_all()
+            except (TransportError, TimeoutError, AssertionError) as e:
+                err = e
+        if (isinstance(err, ChecksumError) and err.bad_blocks is not None
+                and err.body is not None and len(vf.segments) > 1):
+            return self._heal_vectored(peer, exec_idx, vf, err)
+        return self._with_retries("blocks", exec_idx,
+                                  vf.segments[0].map_id, read_all,
+                                  first_error=err)
+
+    def _heal_vectored(self, peer, exec_idx: int, vf: _VectoredFetch,
+                       err: ChecksumError) -> bytes:
+        """Salvage a partially-corrupt vectored response: segments whose
+        sub-blocks all verified keep their bytes from ``err.body``; each
+        affected segment refetches alone under the retry envelope with
+        ITS map charged (retry counters, trace events, and — on
+        exhaustion — the FetchFailedError all blame the map that owns
+        the corrupt range, not the whole request)."""
+        bad = set(err.bad_blocks)
+        parts: List[Optional[bytes]] = []
+        dirty: List[int] = []
+        pos = block_index = 0
+        for si, seg in enumerate(vf.segments):
+            nblocks = len(seg.blocks)
+            if bad.isdisjoint(range(block_index, block_index + nblocks)):
+                parts.append(err.body[pos:pos + seg.total_bytes])
+            else:
+                parts.append(None)
+                dirty.append(si)
+            pos += seg.total_bytes
+            block_index += nblocks
+        for si in dirty:
+            seg = vf.segments[si]
+
+            def refetch(seg=seg):
+                self.metrics.record_request()
+                with self.tracer.span("fetch.refetch_range", "fault",
+                                      map=seg.map_id, peer=exec_idx,
+                                      bytes=seg.total_bytes,
+                                      blocks=len(seg.blocks)):
+                    return self.endpoint.fetch_blocks(
+                        peer, self.shuffle_id, seg.blocks)
+
+            # the vectored attempt was attempt one FOR EACH affected
+            # segment: charge it so the budget spans the same wall-clock
+            # either way and the retry counters attribute per map
+            parts[si] = self._with_retries("blocks", exec_idx, seg.map_id,
+                                           refetch, first_error=err)
+        return b"".join(parts)
+
+    def _emit_vectored(self, vf: _VectoredFetch, data: bytes) -> None:
+        """Slice one vectored payload back into per-(map, range) results.
+        With a pool, the whole response lands in ONE refcounted
+        multi-view lease (each result holds a reference; the buffer
+        returns to the pool on the last consumer's ``free``)."""
+        lease = None
+        if self.pool is not None and vf.total_bytes:
+            lease = self.pool.get_registered(vf.total_bytes)
+        pos = 0
+        for seg in vf.segments:
+            n = seg.total_bytes
+            if lease is not None:
+                view = lease.slice(n)
+                if n:
+                    view[:] = np.frombuffer(data, dtype=np.uint8,
+                                            count=n, offset=pos)
+                payload = view
+            else:
+                payload = data[pos:pos + n]
+            pos += n
+            self._results.put(FetchResult(
+                seg.map_id, seg.start_partition, seg.end_partition,
+                payload, lease=lease))
+        if lease is not None:
+            lease.release()  # creator's ref; results hold theirs
 
     # -- retry envelope (deadline + backoff, transient vs fatal) ---------
 
@@ -343,6 +755,11 @@ class ShuffleFetcher:
         self.metrics.record_failure()
         if self.reader_stats is not None:
             self.reader_stats.failures.incr("fetch_failures")
+        # an authoritative status that names its map (batched location
+        # responses do) beats the caller's request-level blame
+        named = getattr(err, "map_id", None)
+        if isinstance(named, int):
+            map_id = named
         raise FetchFailedError(
             self.shuffle_id, map_id, exec_idx,
             f"{what} failed after {consumed} attempt(s): {err}") from err
@@ -399,6 +816,7 @@ class ShuffleFetcher:
         for m in maps:
             # STEP 2: block locations (:293-315).
             def read_locs(m=m):
+                self.metrics.record_request()
                 with self.tracer.span("fetch.locations", "fetch",
                                       map=m, peer=exec_idx):
                     return self.endpoint.fetch_output_range(
@@ -417,6 +835,7 @@ class ShuffleFetcher:
             t0 = time.monotonic()
 
             def read_blocks(fetch=fetch):
+                self.metrics.record_request()
                 with self.tracer.span("fetch.blocks", "fetch",
                                       map=fetch.map_id, peer=exec_idx,
                                       bytes=fetch.total_bytes):
@@ -434,7 +853,7 @@ class ShuffleFetcher:
             dt = time.monotonic() - t0
             self.metrics.record_remote(len(data), dt)
             if self.reader_stats is not None:
-                self.reader_stats.update(exec_idx, dt)
+                self.reader_stats.update(exec_idx, dt, nbytes=len(data))
             self._results.put(FetchResult(
                 fetch.map_id, fetch.start_partition, fetch.end_partition,
                 data))
@@ -469,7 +888,13 @@ class ShuffleFetcher:
                 # everything else
                 while mi < len(maps) and len(loc_pending) < depth:
                     m = maps[mi]
+                    # same fail-fast as the sequential path's envelope: a
+                    # suspect verdict must stop NEW issues (a fresh dial
+                    # after the verdict is a connection the monitor will
+                    # never close for us)
+                    self._suspect_check(exec_idx, m)
                     mi += 1
+                    self.metrics.record_request()
                     loc_pending.append((
                         m,
                         self.endpoint.fetch_output_range_async(
@@ -494,6 +919,7 @@ class ShuffleFetcher:
                         break
                     ready.popleft()
                     t_issue = time.monotonic()
+                    self.metrics.record_request()
                     handle = self.endpoint.fetch_blocks_async(
                         peer, self.shuffle_id, fetch.blocks)
                     inflight.append((fetch, handle, t_ready, t_issue))
@@ -535,12 +961,14 @@ class ShuffleFetcher:
             # the windowed async issue was attempt one; run the remaining
             # retry budget synchronously (re-queueing into the window
             # would reorder the drain for no benefit)
-            locs = self._with_retries(
-                "locations", exec_idx, m,
-                lambda: self.endpoint.fetch_output_range(
+            def retry_locs(m=m):
+                self.metrics.record_request()
+                return self.endpoint.fetch_output_range(
                     peer, self.shuffle_id, m,
-                    self.start_partition, self.end_partition),
-                first_error=e)
+                    self.start_partition, self.end_partition)
+
+            locs = self._with_retries("locations", exec_idx, m, retry_locs,
+                                      first_error=e)
         if self.tracer.enabled:
             # same span the sequential path brackets around its blocking
             # location read — STEP-2 latency stays measurable in the
@@ -581,12 +1009,15 @@ class ShuffleFetcher:
             # pipeline analysis reads); the failed handle's wire stamp is
             # stale for the same reason
             t_issue = time.monotonic()
+
+            def retry_blocks(fetch=fetch):
+                self.metrics.record_request()
+                return self.endpoint.fetch_blocks(
+                    peer, self.shuffle_id, fetch.blocks)
+
             try:
-                data = self._with_retries(
-                    "blocks", exec_idx, fetch.map_id,
-                    lambda: self.endpoint.fetch_blocks(
-                        peer, self.shuffle_id, fetch.blocks),
-                    first_error=e)
+                data = self._with_retries("blocks", exec_idx, fetch.map_id,
+                                          retry_blocks, first_error=e)
             except BaseException:
                 # this entry's budget is released here; the rest of the
                 # window is released by _fetch_pipelined's unwind
@@ -598,7 +1029,7 @@ class ShuffleFetcher:
         dt = now - t_issue
         self.metrics.record_remote(len(data), dt)
         if self.reader_stats is not None:
-            self.reader_stats.update(exec_idx, dt)
+            self.reader_stats.update(exec_idx, dt, nbytes=len(data))
         if self.tracer.enabled:
             end_us = self.tracer.now_us()
             issue_us = end_us - (now - t_issue) * 1e6
@@ -665,14 +1096,27 @@ class ShuffleFetcher:
         with self._in_flight_cv:
             return self._in_flight
 
+    def _drain_unconsumed(self) -> None:
+        """Free pool leases of results the consumer will never take
+        (failure/early-exit teardown; a plain-bytes or sentinel result's
+        free() is a no-op)."""
+        while True:
+            try:
+                self._results.get_nowait().free()
+            except queue.Empty:
+                return
+
     def close(self) -> None:
         """Abort outstanding work: wakes budget waiters, stops peer
         threads at their next checkpoint (teardown semantics of
         RdmaChannel.java:872-956 — outstanding work must not outlive the
-        consumer)."""
+        consumer). Unconsumed lease-backed results return their pool
+        buffers (the last peer thread re-drains for completions that
+        race this)."""
         self._aborted.set()
         with self._in_flight_cv:
             self._in_flight_cv.notify_all()
+        self._drain_unconsumed()
 
     # -- iteration (:342-382) -------------------------------------------
 

@@ -179,7 +179,7 @@ class TpuShuffleManager:
                                 start_partition, end_partition,
                                 handle.row_payload_bytes,
                                 reader_stats=self.reader_stats,
-                                tracer=self.tracer)
+                                tracer=self.tracer, pool=self.pool)
 
     def recover_and_republish(self) -> dict:
         """Elastic rejoin: recover committed spills from disk and

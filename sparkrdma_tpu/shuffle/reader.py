@@ -29,11 +29,13 @@ class TpuShuffleReader:
                  resolver: Optional[TpuShuffleBlockResolver],
                  conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
                  start_partition: int, end_partition: int,
-                 row_payload_bytes: int, reader_stats=None, tracer=None):
+                 row_payload_bytes: int, reader_stats=None, tracer=None,
+                 pool=None):
         self.row_payload_bytes = row_payload_bytes
         self.fetcher = ShuffleFetcher(endpoint, resolver, conf, shuffle_id,
                                       num_maps, start_partition, end_partition,
-                                      reader_stats=reader_stats, tracer=tracer)
+                                      reader_stats=reader_stats, tracer=tracer,
+                                      pool=pool)
 
     @property
     def metrics(self) -> ReadMetrics:
@@ -44,8 +46,15 @@ class TpuShuffleReader:
         self.fetcher.start()
         try:
             for result in self.fetcher:
-                if result.data:
-                    yield decode_rows(result.data, self.row_payload_bytes)
+                # len(), not truthiness: lease-backed results are numpy
+                # views (multi-element truthiness raises); decode copies,
+                # so the pool lease releases as soon as it's decoded
+                try:
+                    if len(result.data):
+                        yield decode_rows(result.data,
+                                          self.row_payload_bytes)
+                finally:
+                    result.free()
         finally:
             # releases budget waiters + peer threads if the consumer stops
             # early (GeneratorExit) or a fetch failed
@@ -109,13 +118,17 @@ class TpuShuffleReader:
         import jax
 
         self.fetcher.start()
+        chunks = []
         try:
-            chunks = []
             total = 0
             for result in self.fetcher:
-                if result.data:
-                    chunks.append(result.data)
+                if len(result.data):
+                    # the result (and its pool lease, if any) is held
+                    # until the staging copy below, then freed
+                    chunks.append(result)
                     total += len(result.data)
+                else:
+                    result.free()
             row_bytes = 8 + self.row_payload_bytes
             if total == 0:
                 keys = jax.device_put(np.zeros((0, 2), dtype=np.uint32), device)
@@ -124,13 +137,20 @@ class TpuShuffleReader:
                 return keys, payload
             with pool.get(total) as buf:
                 pos = 0
-                for c in chunks:
-                    buf.view[pos:pos + len(c)] = np.frombuffer(c, dtype=np.uint8)
-                    pos += len(c)
+                for r in chunks:
+                    n = len(r.data)
+                    buf.view[pos:pos + n] = np.frombuffer(r.data,
+                                                          dtype=np.uint8)
+                    pos += n
+                    r.free()
                 rows = buf.view[:total].reshape(-1, row_bytes)
                 keys_host = rows[:, :8].copy().view(np.uint32).reshape(-1, 2)
                 payload_host = rows[:, 8:].copy()
             return (jax.device_put(keys_host, device),
                     jax.device_put(payload_host, device))
         finally:
+            # free() is idempotent: chunks already freed by the staging
+            # copy are no-ops; an exception mid-fetch frees the rest
+            for r in chunks:
+                r.free()
             self.fetcher.close()

@@ -49,36 +49,74 @@ class FetchHistogram:
         }
 
 
-class DepthHistogram:
-    """Power-of-two outstanding-depth buckets: bucket i counts samples
-    with depth in [2^i, 2^(i+1)). Depth 0 (idle issue) lands in bucket
-    0 with depth 1 — what matters is how full the read-ahead window ran,
-    and the window is never larger than a few thousand."""
+class _Pow2Histogram:
+    """Shared power-of-two bucketing: bucket i counts samples in
+    [2^i, 2^(i+1)); zero lands in bucket 0; past the top bucket clamps."""
 
-    NUM_BUCKETS = 16  # covers depth up to 2^15; deeper clamps
+    NUM_BUCKETS = 16
 
     def __init__(self):
         self.buckets = [0] * self.NUM_BUCKETS
         self.count = 0
-        self.max_depth = 0
         self._total = 0
 
-    def add(self, depth: int) -> None:
-        depth = max(0, int(depth))
-        idx = min(max(depth, 1).bit_length() - 1, self.NUM_BUCKETS - 1)
+    def add(self, value: int) -> None:
+        value = max(0, int(value))
+        idx = min(max(value, 1).bit_length() - 1, self.NUM_BUCKETS - 1)
         self.buckets[idx] += 1
         self.count += 1
-        self._total += depth
-        self.max_depth = max(self.max_depth, depth)
+        self._total += value
 
-    def summary(self) -> dict:
+    def _bucket_summary(self) -> dict:
         edges = [f"[{1 << i},{(1 << (i + 1)) - 1}]"
                  for i in range(self.NUM_BUCKETS)]
+        return {e: b for e, b in zip(edges, self.buckets) if b}
+
+
+class DepthHistogram(_Pow2Histogram):
+    """Power-of-two outstanding-depth buckets. Depth 0 (idle issue)
+    lands in bucket 0 with depth 1 — what matters is how full the
+    read-ahead window ran, and the window is never larger than a few
+    thousand."""
+
+    NUM_BUCKETS = 16  # covers depth up to 2^15; deeper clamps
+
+    def __init__(self):
+        super().__init__()
+        self.max_depth = 0
+
+    def add(self, depth: int) -> None:
+        super().add(depth)
+        self.max_depth = max(self.max_depth, max(0, int(depth)))
+
+    def summary(self) -> dict:
         return {
             "count": self.count,
             "max": self.max_depth,
             "mean": round(self._total / self.count, 2) if self.count else 0.0,
-            "buckets": {e: b for e, b in zip(edges, self.buckets) if b},
+            "buckets": self._bucket_summary(),
+        }
+
+
+class BytesHistogram(_Pow2Histogram):
+    """Power-of-two request-size buckets (bytes). Companion to
+    ``ReadMetrics.requests_per_reduce`` for the coalesced dataplane: the
+    RPC-count reduction must show up as FEWER, LARGER requests — mean
+    bytes/request rising — not just a smaller counter."""
+
+    NUM_BUCKETS = 32  # up to 2 GiB/request; larger clamps
+
+    @property
+    def total_bytes(self) -> int:
+        return self._total
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "total_bytes": self._total,
+            "mean_bytes": (round(self._total / self.count, 1)
+                           if self.count else 0.0),
+            "buckets": self._bucket_summary(),
         }
 
 
@@ -166,8 +204,13 @@ class ShuffleReaderStats:
         # failure-path counters ride along too: one snapshot answers both
         # "how fast" and "how rough"
         self.failures = FailureCounters()
+        # bytes-per-data-request distribution: the coalesced dataplane's
+        # whole point is fewer, larger requests — visible here as mass
+        # shifting into the high buckets
+        self.request_bytes = BytesHistogram()
 
-    def update(self, exec_index: int, latency_s: float) -> None:
+    def update(self, exec_index: int, latency_s: float,
+               nbytes: int = -1) -> None:
         with self._lock:
             hist = self._per_remote.get(exec_index)
             if hist is None:
@@ -175,6 +218,8 @@ class ShuffleReaderStats:
                 self._per_remote[exec_index] = hist
             hist.add(latency_s)
             self._global.add(latency_s)
+            if nbytes >= 0:
+                self.request_bytes.add(nbytes)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -183,6 +228,8 @@ class ShuffleReaderStats:
                 "per_remote": {str(k): v.summary()
                                for k, v in sorted(self._per_remote.items())},
             }
+            if self.request_bytes.count:
+                snap["request_bytes"] = self.request_bytes.summary()
         pipeline = self.pipeline.snapshot()
         if pipeline["per_peer"]:
             snap["pipeline"] = pipeline

@@ -113,6 +113,55 @@ def test_unknown_token_and_bad_range(server):
         cache.close_all()
 
 
+def test_checksum_trailer_matches_python_contract(server):
+    """With bs_set_checksum(1) the native server appends the same
+    per-block CRC32 trailer the Python path does (FLAG_CRC32, one u32
+    per requested block — zero-length blocks included), over a VECTORED
+    request spanning tokens; the client-side verifier accepts and strips
+    it. Without the toggle, flags stay 0."""
+    import struct
+    import zlib
+
+    srv, data = server
+    # a second registered file: the vectored request spans tokens the
+    # way a coalesced fetch spans maps' spill files
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(delete=False) as f:
+        data2 = bytes(range(256)) * 8
+        f.write(data2)
+        path2 = f.name
+    srv.register_file(8, path2)
+    cache = ConnectionCache(CONF)
+    try:
+        blocks = [(7, 11, 100), (8, 0, 64), (7, 0, 0), (8, 128, 32)]
+        expect = data[11:111] + data2[:64] + b"" + data2[128:160]
+        resp = _fetch(cache, srv.port, blocks)
+        assert resp.status == M.STATUS_OK and resp.flags == 0
+        assert resp.data == expect
+
+        srv.set_checksum(True)
+        resp = _fetch(cache, srv.port, blocks)
+        assert resp.status == M.STATUS_OK
+        assert resp.flags == M.FLAG_CRC32
+        n = len(blocks)
+        body, trailer = resp.data[:-4 * n], resp.data[-4 * n:]
+        assert body == expect
+        got_crcs = struct.unpack(f"<{n}I", trailer)
+        pos = 0
+        for (_t, _o, ln), crc in zip(blocks, got_crcs):
+            assert zlib.crc32(body[pos:pos + ln]) == crc
+            pos += ln
+
+        srv.set_checksum(False)
+        assert _fetch(cache, srv.port, [(7, 0, 16)]).flags == 0
+    finally:
+        cache.close_all()
+        import os as _os
+
+        _os.unlink(path2)
+
+
 def test_worker_survives_client_disconnect(server):
     """A client vanishing mid-pipeline must not take the worker down."""
     import socket

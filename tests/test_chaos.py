@@ -35,6 +35,9 @@ from sparkrdma_tpu.shuffle.recovery import run_map_stage, run_reduce_with_retry
 pytestmark = pytest.mark.chaos
 
 SEED = int(os.environ.get("CHAOS_SEED", "0"))
+# dataplane under chaos: 1 = coalesced vectored reads (the default), 0 =
+# the per-map fallback; scripts/run_chaos.sh sweeps both
+COALESCE = os.environ.get("CHAOS_COALESCE", "1") not in ("0", "false")
 
 
 def _conf(**kw):
@@ -42,6 +45,7 @@ def _conf(**kw):
                 retry_backoff_base_ms=10, retry_backoff_cap_ms=80,
                 fetch_retry_budget=3, use_cpp_runtime=False,
                 pre_warm_connections=False,
+                coalesce_reads=COALESCE,
                 collect_shuffle_reader_stats=True)
     base.update(kw)
     return TpuShuffleConf(**base)
@@ -266,6 +270,64 @@ def test_chaos_blackhole_partition_heartbeat_escalates(tmp_path):
         assert wall < 8.0, \
             f"seed={SEED}: {wall:.1f}s — waited out deadlines instead of " \
             f"heartbeat (2x interval = {2 * interval_ms / 1000:.1f}s)"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def test_chaos_vectored_corruption_refetches_only_affected_ranges(tmp_path):
+    """A corrupt sub-block inside a coalesced (cross-map) vectored
+    response is isolated by the per-block CRC trailer: ONLY the affected
+    map's ranges refetch (not the whole vectored request), and the
+    retry/trace attribution names that map."""
+    if not COALESCE:
+        pytest.skip("per-map dataplane sweep: vectored path disabled")
+    from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
+    from sparkrdma_tpu.utils.trace import Tracer
+
+    driver, execs = _cluster(tmp_path, n=2)
+    injector = FaultInjector(seed=SEED)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        # every map on ONE peer: the reducer coalesces all 6 maps into a
+        # single vectored request (6 segments, 24 blocks)
+        run_map_stage(execs, handle, _map_fn,
+                      placement={m: 1 for m in range(6)})
+        injector.install_endpoint(execs[0].executor)
+        injector.add(CORRUPT, msg_type=M.FetchBlocksResp, times=1)
+
+        tracer = Tracer()
+        reader = TpuShuffleReader(execs[0].executor, execs[0].resolver,
+                                  _conf(), handle.shuffle_id, 6, 0, 4, 0,
+                                  tracer=tracer)
+        keys, _ = reader.read_all()
+        np.testing.assert_array_equal(np.sort(keys), _expected(6),
+                                      err_msg=f"seed={SEED}")
+        m = reader.metrics
+        assert injector.fired_count(CORRUPT) == 1, f"seed={SEED}"
+        assert m.checksum_failures >= 1, f"seed={SEED}"
+        assert m.failed_fetches == 0, f"seed={SEED}"
+        # exactly one vectored request covered all 6 maps...
+        vec = [e for e in tracer._events if e["name"] == "fetch.vectored"]
+        assert len(vec) == 1 and vec[0]["args"]["maps"] == 6, f"seed={SEED}"
+        # ...and the heal refetched ONE map's ranges, not the request:
+        # a single bit flip lands in one block (or its trailer word), so
+        # one segment of 4 blocks goes back on the wire
+        refetches = [e for e in tracer._events
+                     if e["name"] == "fetch.refetch_range"]
+        assert len(refetches) == 1, f"seed={SEED}: {refetches}"
+        blamed = refetches[0]["args"]["map"]
+        assert 0 <= blamed < 6, f"seed={SEED}"
+        assert refetches[0]["args"]["blocks"] < vec[0]["args"]["blocks"], \
+            f"seed={SEED}: refetch was not narrower than the request"
+        # the retry instant attributes the SAME map the refetch named
+        retries = [e for e in tracer._events if e["name"] == "fetch.retry"]
+        assert retries and all(e["args"]["map"] == blamed
+                               for e in retries), f"seed={SEED}"
+        # wire accounting: 1 batched location RPC + 1 vectored read + 1
+        # range refetch — nothing else
+        assert m.requests_per_reduce == 3, f"seed={SEED}: {m}"
     finally:
         injector.uninstall()
         _shutdown(driver, execs)

@@ -1,0 +1,497 @@
+"""Coalesced shuffle reads: one location fetch per peer + cross-map
+vectored data reads.
+
+The dataplane the RPC-count reduction rides on: parity against the
+per-map paths (byte-identical across every dataplane/depth combination,
+zero-length blocks and degenerate shapes included), the >=5x request
+reduction on a many-small-maps shuffle (the acceptance gate), wire-
+traffic shape (coalescing OFF must reproduce today's per-map traffic
+exactly; ON must issue ONE batched location RPC per peer), CRC sub-block
+isolation, the frame-cap derivation that keeps the Python planner in
+lockstep with the C++ server limit, and the refcounted multi-view pool
+lease every vectored response lands in.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel.transport import ChecksumError
+from sparkrdma_tpu.shuffle.fetch_bench import run_coalesce_microbench
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
+
+CONF_KW = dict(connect_timeout_ms=5000, use_cpp_runtime=False,
+               pre_warm_connections=False)
+
+
+def _cluster(tmp_path, n=3, **kw):
+    conf = TpuShuffleConf(**dict(CONF_KW, **kw))
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(n)]
+    for ex in execs:
+        ex.executor.wait_for_members(n)
+    return driver, execs
+
+
+def _shutdown(driver, execs):
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def _drain(reader):
+    """All fetch results as a sorted multiset of attributable tuples."""
+    results = []
+    reader.fetcher.start()
+    try:
+        for r in reader.fetcher:
+            results.append((r.map_id, r.start_partition, r.end_partition,
+                            bytes(r.data)))
+            r.free()
+    finally:
+        reader.fetcher.close()
+    return sorted(results)
+
+
+def _reader(execs, idx, handle, conf, start=None, end=None, **kw):
+    return TpuShuffleReader(
+        execs[idx].executor, execs[idx].resolver, conf, handle.shuffle_id,
+        handle.num_maps, 0 if start is None else start,
+        handle.num_partitions if end is None else end,
+        handle.row_payload_bytes, **kw)
+
+
+# -- parity: every dataplane fetches identical bytes ---------------------
+
+
+@pytest.mark.parametrize("shape", ["mixed", "mostly_empty", "single_map"])
+def test_dataplane_parity_byte_identical(tmp_path, shape):
+    """Coalesced (sequential + windowed) vs per-map (sequential +
+    pipelined) drain the same shuffle byte-identically, with per-map
+    attribution (map_id, partition range) intact — including zero-length
+    blocks, a mostly-empty partition range, and the single-map
+    degenerate shuffle."""
+    driver, execs = _cluster(tmp_path, shuffle_read_block_size=2048)
+    try:
+        num_maps = 1 if shape == "single_map" else 6
+        num_partitions = 16
+        handle = driver.register_shuffle(
+            1, num_maps, num_partitions, PartitionerSpec("modulo"),
+            row_payload_bytes=8)
+        rng = np.random.default_rng(7)
+        for m in range(num_maps):
+            w = execs[m % 2].get_writer(handle, m)
+            if shape == "mostly_empty":
+                # everything lands in ONE partition: the other 15 are
+                # zero-length blocks riding the same requests
+                keys = np.full(64, 3, dtype=np.uint64)
+            else:
+                # skip odd partitions entirely -> zero-length blocks
+                # interleave with data blocks in every group
+                keys = (rng.integers(0, 8, size=200).astype(np.uint64) * 2)
+            w.write_batch(keys, rng.integers(
+                0, 255, (len(keys), 8), dtype=np.uint64).astype(np.uint8))
+            w.close()
+
+        combos = [
+            ("coalesced_seq", dict(coalesce_reads=True, read_ahead_depth=1)),
+            ("coalesced_win", dict(coalesce_reads=True, read_ahead_depth=8)),
+            ("per_map_seq", dict(coalesce_reads=False, read_ahead_depth=1)),
+            ("per_map_pipe", dict(coalesce_reads=False, read_ahead_depth=8)),
+        ]
+        drained = {}
+        for name, kw in combos:
+            conf = TpuShuffleConf(**dict(CONF_KW,
+                                         shuffle_read_block_size=2048, **kw))
+            drained[name] = _drain(_reader(execs, 2, handle, conf))
+        baseline = drained["per_map_seq"]
+        assert baseline, "shuffle drained nothing"
+        for name, got in drained.items():
+            assert got == baseline, f"{name} diverged from per_map_seq"
+        # a partial range drains identically too (grouping offsets differ)
+        conf_on = TpuShuffleConf(**dict(CONF_KW,
+                                        shuffle_read_block_size=2048,
+                                        coalesce_reads=True))
+        conf_off = TpuShuffleConf(**dict(CONF_KW,
+                                         shuffle_read_block_size=2048,
+                                         coalesce_reads=False))
+        lo, hi = 5, 11
+        assert (_drain(_reader(execs, 2, handle, conf_on, lo, hi))
+                == _drain(_reader(execs, 2, handle, conf_off, lo, hi)))
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_coalescing_disabled_reproduces_per_map_wire_traffic(tmp_path):
+    """The escape hatch: with ``coalesce_reads`` off the serving peer
+    sees exactly today's traffic — one FetchOutputReq per map, zero
+    batched requests; with it on, ONE FetchOutputsReq covers the peer
+    and no per-map location RPC is issued."""
+    driver, execs = _cluster(tmp_path, n=2)
+    try:
+        num_maps = 5
+        handle = driver.register_shuffle(1, num_maps, 4,
+                                         PartitionerSpec("modulo"),
+                                         row_payload_bytes=0)
+        for m in range(num_maps):
+            w = execs[0].get_writer(handle, m)
+            w.write_batch(np.arange(16, dtype=np.uint64))
+            w.close()
+        ep = execs[0].executor
+        served = {"per_map": 0, "batched": 0}
+        orig_one, orig_many = ep._on_fetch_output, ep._on_fetch_outputs
+
+        def count_one(msg):
+            served["per_map"] += 1
+            return orig_one(msg)
+
+        def count_many(msg):
+            served["batched"] += 1
+            return orig_many(msg)
+
+        ep._on_fetch_output, ep._on_fetch_outputs = count_one, count_many
+
+        off = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=False))
+        assert _drain(_reader(execs, 1, handle, off))
+        assert served == {"per_map": num_maps, "batched": 0}
+
+        served.update(per_map=0, batched=0)
+        on = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=True))
+        assert _drain(_reader(execs, 1, handle, on))
+        assert served == {"per_map": 0, "batched": 1}
+    finally:
+        _shutdown(driver, execs)
+
+
+# -- the acceptance gate: >=5x fewer request frames at equal bytes -------
+
+
+def test_rpc_reduction_many_small_maps(tmp_path):
+    """64-map/8-partition loopback microbench: the coalesced path issues
+    >=5x fewer request frames than per-map at equal total bytes,
+    byte-identical — and ``ReadMetrics.requests_per_reduce`` is the
+    counter that shows it (the CI guard for the RPC-count regression)."""
+    res = run_coalesce_microbench(str(tmp_path), num_maps=64,
+                                  num_partitions=8)
+    assert res["identical"], "dataplanes fetched different bytes"
+    assert res["bytes"] > 0
+    per_map, coalesced = res["requests"]["per_map"], \
+        res["requests"]["coalesced"]
+    # per-map: 64 location RPCs + >=64 data reads; coalesced: one
+    # batched location RPC + a handful of vectored reads
+    assert per_map >= 2 * 64
+    assert coalesced < per_map
+    assert res["rpc_reduction"] >= 5.0, res
+
+
+# -- CRC sub-block isolation ---------------------------------------------
+
+
+def test_verify_block_crcs_names_bad_blocks():
+    """The verifier checks EVERY block and reports the full bad set plus
+    the stripped body — what lets a vectored fetch salvage clean
+    sub-ranges and refetch only the corrupt ones."""
+    from sparkrdma_tpu.parallel.endpoints import ExecutorEndpoint
+    import struct
+    import zlib
+
+    ep = ExecutorEndpoint.__new__(ExecutorEndpoint)  # no sockets needed
+    ep.checksum_failures = 0
+    blocks = [(0, 0, 4), (0, 4, 6), (0, 10, 0), (0, 10, 5)]
+    req = M.FetchBlocksReq(1, 1, blocks)
+    parts = [b"aaaa", b"bbbbbb", b"", b"ccccc"]
+    body = b"".join(parts)
+    crcs = [zlib.crc32(p) for p in parts]
+    crcs[1] ^= 0x1  # corrupt one mid-list block's checksum
+    data = body + struct.pack("<4I", *crcs)
+    with pytest.raises(ChecksumError) as ei:
+        ep._verify_block_crcs(req, data)
+    assert ei.value.bad_blocks == [1]
+    assert ei.value.body == body
+    assert ep.checksum_failures == 1
+    # clean data passes and strips the trailer
+    ok = body + struct.pack("<4I", *(zlib.crc32(p) for p in parts))
+    assert ep._verify_block_crcs(req, ok) == body
+
+
+# -- frame-cap derivation (satellite: no magic 8192) ---------------------
+
+
+def test_max_fetch_blocks_derived_from_native_frame_cap():
+    """The block-count bound is derived from the C++ server's inbound
+    frame cap; the mirrored Python constant is greppped out of the .cpp
+    so a drift fails here instead of at 2am in production."""
+    cpp = open(os.path.join(os.path.dirname(__file__), "..", "csrc",
+                            "blockserver.cpp")).read()
+    m = re.search(r"kMaxReqFrame\s*=\s*(\d+)u?\s*<<\s*(\d+)", cpp)
+    assert m, "kMaxReqFrame not found in csrc/blockserver.cpp"
+    assert int(m.group(1)) << int(m.group(2)) == M.NATIVE_MAX_REQ_FRAME
+    # auto mode: an 8x margin under the frame cap, in wire-block units
+    expect = ((M.NATIVE_MAX_REQ_FRAME // 8 - M.BLOCKS_REQ_FIXED_BYTES)
+              // M.BLOCK_WIRE_BYTES)
+    assert TpuShuffleConf().resolved_max_fetch_blocks() == expect
+    # an explicit value passes through; 0 means auto
+    assert TpuShuffleConf(
+        max_fetch_blocks=123).resolved_max_fetch_blocks() == 123
+    # ...but never past what ONE native frame physically carries (the
+    # C++ server drops the connection as a protocol error past it, which
+    # no retry heals) — even when the config range allows more
+    hard = ((M.NATIVE_MAX_REQ_FRAME - M.BLOCKS_REQ_FIXED_BYTES)
+            // M.BLOCK_WIRE_BYTES)
+    assert TpuShuffleConf(
+        max_fetch_blocks=1 << 20).resolved_max_fetch_blocks() == hard
+    assert hard * M.BLOCK_WIRE_BYTES + M.BLOCKS_REQ_FIXED_BYTES \
+        <= M.NATIVE_MAX_REQ_FRAME
+    # the derived bound actually bounds the planner: a request can never
+    # exceed what one native frame carries
+    assert (expect * M.BLOCK_WIRE_BYTES + M.BLOCKS_REQ_FIXED_BYTES
+            <= M.NATIVE_MAX_REQ_FRAME)
+
+
+def test_group_locations_honors_configured_block_cap(tmp_path):
+    """A wide, mostly-empty partition range splits its groups at the
+    configured block cap (zero-length blocks still count — they cost
+    frame bytes, not payload bytes)."""
+    from sparkrdma_tpu.shuffle.fetcher import ShuffleFetcher
+    from sparkrdma_tpu.shuffle.map_output import BlockLocation
+
+    conf = TpuShuffleConf(max_fetch_blocks=10)
+    f = ShuffleFetcher.__new__(ShuffleFetcher)
+    f.conf = conf
+    f.start_partition = 0
+    locs = [BlockLocation(0, 0, 1)] * 25  # 25 zero-ish blocks, cap 10
+    groups = f._group_locations(0, 0, locs)
+    assert [len(g.blocks) for g in groups] == [10, 10, 5]
+
+
+# -- mixed-version fallback ----------------------------------------------
+
+
+def test_batched_failure_falls_back_to_per_map(tmp_path):
+    """A peer that fails the first batched location call (a
+    mixed-version server tears the connection on the unknown frame type)
+    is served by the per-map dataplane instead — same bytes, no error
+    surfaced."""
+    from sparkrdma_tpu.parallel.faults import DISCONNECT, FaultInjector
+
+    driver, execs = _cluster(tmp_path, n=2)
+    injector = FaultInjector(seed=0)
+    try:
+        handle = driver.register_shuffle(1, 4, 4, PartitionerSpec("modulo"),
+                                         row_payload_bytes=0)
+        for m in range(4):
+            w = execs[0].get_writer(handle, m)
+            w.write_batch(np.arange(32, dtype=np.uint64))
+            w.close()
+        ep = execs[0].executor
+        served = {"per_map": 0, "batched": 0}
+        orig_one, orig_many = ep._on_fetch_output, ep._on_fetch_outputs
+        ep._on_fetch_output = lambda msg: (
+            served.__setitem__("per_map", served["per_map"] + 1),
+            orig_one(msg))[1]
+        ep._on_fetch_outputs = lambda msg: (
+            served.__setitem__("batched", served["batched"] + 1),
+            orig_many(msg))[1]
+
+        injector.install_endpoint(execs[1].executor)
+        on = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=True,
+                                   retry_backoff_base_ms=5,
+                                   retry_backoff_cap_ms=20))
+        # ONE cut batched reply is a transient blip: the guarded retry
+        # keeps the peer on the coalesced dataplane (no demotion)
+        injector.add(DISCONNECT, msg_type=M.FetchOutputsResp, times=1)
+        got = _drain(_reader(execs, 1, handle, on))
+        assert got
+        assert injector.fired_count(DISCONNECT) == 1
+        assert served["batched"] == 2 and served["per_map"] == 0
+
+        # BOTH attempts torn down (what an old server that drops the
+        # unknown frame type does every time) -> per-map fallback
+        served.update(per_map=0, batched=0)
+        injector.clear()
+        injector.add(DISCONNECT, msg_type=M.FetchOutputsResp, times=2)
+        got2 = _drain(_reader(execs, 1, handle, on))
+        assert got2 == got
+        # fired_count accumulates across clear(): 1 (phase one) + 2
+        assert injector.fired_count(DISCONNECT) == 3
+        assert served["batched"] >= 2  # both attempts reached the peer
+        assert served["per_map"] == 4  # the fallback served every map
+        off = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=False))
+        assert got == _drain(_reader(execs, 1, handle, off))
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+# -- pool lease landing --------------------------------------------------
+
+
+def test_vectored_response_lands_in_shared_pool_lease(tmp_path):
+    """With a pool, one vectored response lands in ONE refcounted
+    multi-view RegisteredBuffer: every per-map result holds a view into
+    the same lease, bytes are exact, and the buffer returns to the pool
+    on the last ``free`` (java/RdmaRegisteredBuffer.java:28-87 made
+    real)."""
+    from sparkrdma_tpu.runtime.pool import BufferPool
+
+    driver, execs = _cluster(tmp_path, n=2)
+    try:
+        handle = driver.register_shuffle(1, 6, 4, PartitionerSpec("modulo"),
+                                         row_payload_bytes=8)
+        rng = np.random.default_rng(3)
+        for m in range(6):
+            w = execs[0].get_writer(handle, m)
+            keys = rng.integers(0, 4, size=100).astype(np.uint64)
+            w.write_batch(keys, rng.integers(
+                0, 255, (len(keys), 8), dtype=np.uint64).astype(np.uint8))
+            w.close()
+        pool = BufferPool(TpuShuffleConf(use_cpp_runtime=False))
+        conf = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=True))
+        reader = _reader(execs, 1, handle, conf, pool=pool)
+        baseline = _drain(_reader(execs, 1, handle, conf))  # bytes oracle
+
+        results = []
+        reader.fetcher.start()
+        try:
+            results.extend(reader.fetcher)
+        finally:
+            reader.fetcher.close()
+        leased = [r for r in results if r.lease is not None]
+        assert leased, "no vectored result landed in a pool lease"
+        # 6 tiny maps coalesce into one request -> one shared lease
+        assert len({id(r.lease) for r in leased}) < len(leased)
+        got = sorted((r.map_id, r.start_partition, r.end_partition,
+                      bytes(r.data)) for r in results)
+        assert got == baseline
+        assert pool.idle_bytes < pool.total_bytes  # leases still held
+        for r in results:
+            r.free()
+        assert pool.total_bytes > 0
+        assert pool.idle_bytes == pool.total_bytes  # all returned
+        pool.stop()
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_close_frees_unconsumed_leases(tmp_path):
+    """An abandoned iteration (failure/early-exit teardown) must return
+    the pool buffers of results the consumer never took — a stage-retry
+    loop would otherwise grow the executor pool without bound."""
+    import time
+
+    from sparkrdma_tpu.runtime.pool import BufferPool
+
+    driver, execs = _cluster(tmp_path, n=2)
+    try:
+        handle = driver.register_shuffle(1, 6, 4, PartitionerSpec("modulo"),
+                                         row_payload_bytes=8)
+        rng = np.random.default_rng(9)
+        for m in range(6):
+            w = execs[0].get_writer(handle, m)
+            keys = rng.integers(0, 4, size=100).astype(np.uint64)
+            w.write_batch(keys, rng.integers(
+                0, 255, (len(keys), 8), dtype=np.uint64).astype(np.uint8))
+            w.close()
+        pool = BufferPool(TpuShuffleConf(use_cpp_runtime=False))
+        conf = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=True))
+        reader = _reader(execs, 1, handle, conf, pool=pool)
+        it = iter(reader.fetcher.start())
+        first = next(it)
+        assert first.lease is not None  # the shared lease is live
+        first.free()
+        reader.fetcher.close()  # walk away with 5 siblings unconsumed
+        deadline = time.monotonic() + 5
+        while (pool.idle_bytes != pool.total_bytes
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert pool.total_bytes > 0
+        assert pool.idle_bytes == pool.total_bytes, "leaked pool lease"
+        pool.stop()
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_reader_frees_leases_end_to_end(tmp_path):
+    """The manager-built reader (pool wired through get_reader) decodes
+    lease-backed results and releases every lease: after read_all the
+    reducer's pool holds no outstanding fetch buffers."""
+    driver, execs = _cluster(tmp_path, n=2)
+    try:
+        handle = driver.register_shuffle(1, 8, 4, PartitionerSpec("modulo"),
+                                         row_payload_bytes=8)
+        rng = np.random.default_rng(5)
+        expect_keys = []
+        for m in range(8):
+            w = execs[0].get_writer(handle, m)
+            keys = rng.integers(0, 1000, size=200).astype(np.uint64)
+            w.write_batch(keys, rng.integers(
+                0, 255, (len(keys), 8), dtype=np.uint64).astype(np.uint8))
+            w.close()
+            expect_keys.append(keys)
+        reader = execs[1].get_reader(handle, 0, 4)
+        keys, _ = reader.read_all()
+        expect = np.concatenate(expect_keys)
+        expect = expect[expect % 4 < 4]  # all partitions in range
+        assert sorted(keys.tolist()) == sorted(expect.tolist())
+        pool = execs[1].pool
+        assert pool.idle_bytes == pool.total_bytes
+    finally:
+        _shutdown(driver, execs)
+
+
+# -- observability -------------------------------------------------------
+
+
+def test_vectored_trace_and_request_histograms(tmp_path):
+    """The coalesced dataplane proves its shape in telemetry:
+    ``fetch.vectored`` spans carry maps/blocks/bytes, the existing
+    issue->wire->complete contract is preserved, and the reader-stats
+    snapshot grows a bytes-per-request histogram whose mass sits in the
+    big buckets under coalescing."""
+    from sparkrdma_tpu.utils.stats import ShuffleReaderStats
+    from sparkrdma_tpu.utils.trace import Tracer
+
+    driver, execs = _cluster(tmp_path, n=2)
+    try:
+        handle = driver.register_shuffle(1, 6, 8, PartitionerSpec("modulo"),
+                                         row_payload_bytes=8)
+        rng = np.random.default_rng(11)
+        for m in range(6):
+            w = execs[0].get_writer(handle, m)
+            keys = rng.integers(0, 8, size=200).astype(np.uint64)
+            w.write_batch(keys, rng.integers(
+                0, 255, (len(keys), 8), dtype=np.uint64).astype(np.uint8))
+            w.close()
+        tracer = Tracer()
+        stats = ShuffleReaderStats(TpuShuffleConf())
+        conf = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=True,
+                                     read_ahead_depth=4))
+        reader = _reader(execs, 1, handle, conf, tracer=tracer,
+                         reader_stats=stats)
+        assert _drain(reader)
+        names = {e["name"] for e in tracer._events}
+        assert {"fetch.locations", "fetch.vectored", "fetch.issue",
+                "fetch.blocks", "fetch.complete"} <= names, names
+        vec = [e for e in tracer._events if e["name"] == "fetch.vectored"]
+        assert all(e["args"]["maps"] >= 1 and e["args"]["blocks"] >= 1
+                   and e["dur"] >= 0 for e in vec)
+        assert sum(e["args"]["maps"] for e in vec) == 6
+        # batched location span names the whole peer batch
+        locs = [e for e in tracer._events
+                if e["name"] == "fetch.locations"]
+        assert any(e["args"].get("batched") and e["args"]["maps"] == 6
+                   for e in locs)
+        snap = stats.snapshot()
+        assert snap["request_bytes"]["count"] == len(vec)
+        assert snap["request_bytes"]["total_bytes"] == \
+            reader.metrics.remote_bytes
+    finally:
+        _shutdown(driver, execs)

@@ -114,13 +114,29 @@ def test_grouping_respects_read_block_size(cluster):
     handle, keys, _ = _run_shuffle(driver, execs, 4, num_maps=2,
                                    num_partitions=8, rows_per_map=4000,
                                    payload_bytes=24)
-    reader = execs[2].get_reader(handle, 0, 8)
-    k, _ = reader.read_all()
+    from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
+
+    # per-map dataplane: grouping granularity IS request granularity
+    per_map = TpuShuffleReader(
+        execs[2].executor, execs[2].resolver,
+        TpuShuffleConf(connect_timeout_ms=5000,
+                       shuffle_read_block_size="4k", coalesce_reads=False),
+        handle.shuffle_id, 2, 0, 8, 24)
+    k, _ = per_map.read_all()
     assert len(k) == len(keys)
-    m = reader.metrics
+    m = per_map.metrics
     # 2 maps x 4000 rows x 32B = 256KB total; with 4KB grouping there must be
     # far more than one fetch per remote map
     assert m.remote_fetches > 8
+    # coalesced dataplane (cluster default): identical bytes, same 4KB
+    # grouping underneath, but the groups merge into far fewer request
+    # frames on the wire
+    coalesced = execs[2].get_reader(handle, 0, 8)
+    k2, _ = coalesced.read_all()
+    assert len(k2) == len(keys)
+    m2 = coalesced.metrics
+    assert m2.remote_bytes == m.remote_bytes
+    assert m2.requests_per_reduce < m.requests_per_reduce
 
 
 def test_writer_abort_discards(cluster):
