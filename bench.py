@@ -454,6 +454,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("als done")
     _bench_fetch_pipeline(detail)
     _progress("fetch pipeline done")
+    _bench_write_path(detail)
+    _progress("write path done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -530,6 +532,32 @@ def _bench_fetch_pipeline(detail: dict) -> None:
         detail["fetch_rpc_requests"] = cres["requests"]
     except Exception as e:  # noqa: BLE001
         detail["fetch_rpc_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_write_path(detail: dict) -> None:
+    """The streaming write dataplane's win, measured without hardware:
+    the same record batches through the pre-streaming monolithic writer
+    (close-time global sort + full rows copy) and the streaming writer
+    (O(n) scatter on arrival, background bounded-memory spill, sequential
+    merge commit) at a spill-forcing size — see shuffle/write_bench.py.
+    Pure host path, identical on TPU and CPU-fallback records."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.write_bench import run_write_microbench
+
+        with tempfile.TemporaryDirectory(prefix="writebench_") as td:
+            res = run_write_microbench(td, reps=2, map_compute_s=0.004)
+        if not res["identical"]:
+            detail["shuffle_write_error"] = \
+                "streaming and monolithic committed files differ"
+            return
+        detail["shuffle_write_throughput"] = res["throughput_mb_s"]["streaming"]
+        detail["shuffle_write_speedup"] = res["speedup"]
+        detail["shuffle_write_spills"] = res["spills"]
+        detail["shuffle_write_wall_s"] = res["wall_s"]
+    except Exception as e:  # noqa: BLE001
+        detail["shuffle_write_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def main() -> None:

@@ -60,6 +60,27 @@ _KEYS = [
     # --- exchange / data-plane sizing (reference: write/read block sizes, 107-111)
     _Key("shuffle_write_block_size", "8m", "bytes", 4096, 1 << 34,
          doc="Partition-aligned staging chunk size (ref shuffleWriteBlockSize=8m)."),
+    # --- streaming map-side write dataplane (TPU-only: the reference
+    # inherits Spark's sort/spill writer; we own it)
+    _Key("spill_threshold_bytes", "64m", "bytes", 0, 1 << 44,
+         doc="Map-side write budget: when a writer's accumulated "
+             "partition-scattered run bytes exceed this, they spill to a "
+             "per-map spill file on the background spill thread, "
+             "overlapping the map task's next batches; close() becomes a "
+             "sequential merge of partition-contiguous runs instead of a "
+             "monolithic sort-and-write. 0 = spill after every batch "
+             "(minimum memory, fully synchronous). Peak accumulation is "
+             "bounded by this plus one batch."),
+    _Key("write_spill_threads", 1, "int", 1, 64,
+         doc="Background spill threads per writer — also the cap on "
+             "spills in flight before write_batch backpressures, so "
+             "write-path memory is bounded by (1 + this) x "
+             "(spill_threshold_bytes + one batch)."),
+    _Key("native_write_scatter", True, "bool",
+         doc="Use the native O(n) counting-sort scatter kernel "
+             "(csrc/writer.cpp) for write_batch partitioning when the "
+             ".so provides it; off = the numpy fallback (identical run "
+             "layout, lockstep-tested)."),
     _Key("shuffle_read_block_size", "256k", "bytes", 1024, 1 << 34,
          doc="Max bytes fetched by one grouped read (ref shuffleReadBlockSize=256k)."),
     _Key("max_bytes_in_flight", "48m", "bytes", 1 << 16, 1 << 40,

@@ -62,6 +62,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.mem_gather.argtypes = [cp, ctypes.POINTER(u64), ctypes.POINTER(u64),
                                u64, cp, ctypes.c_int]
     lib.mem_gather.restype = i64
+    # optional symbol: a pre-scatter .so degrades to the numpy scatter
+    # fallback (identical run layout), not a disabled native runtime
+    if hasattr(lib, "writer_scatter"):
+        lib.writer_scatter.argtypes = [ctypes.POINTER(u64), cp, u64, u64,
+                                       ctypes.POINTER(i64), ctypes.c_uint32,
+                                       cp, ctypes.POINTER(u64), ctypes.c_int]
+        lib.writer_scatter.restype = i64
     u16 = ctypes.c_uint16
     lib.bs_create.argtypes = [cp, u16, ctypes.c_int,
                               ctypes.POINTER(ctypes.c_int), ctypes.c_int]
@@ -92,3 +99,9 @@ LIB = _load()
 
 def available() -> bool:
     return LIB is not None
+
+
+def has_writer_scatter() -> bool:
+    """True when the loaded .so exports the streaming write-path scatter
+    kernel (csrc/writer.cpp) — older checked-in builds predate it."""
+    return LIB is not None and hasattr(LIB, "writer_scatter")

@@ -205,6 +205,12 @@ class BufferPool:
         self._use_native = bool(conf.use_cpp_runtime and native.available())
         self._lock = threading.Lock()
         self._stopped = False
+        # leased-bytes gauge: what's checked out right now (bin sizes).
+        # The write dataplane's run buffers and the read side's vectored
+        # leases both show up here, so "who is holding the pool" is one
+        # property read instead of a guess.
+        self._leased_bytes = 0
+        self._peak_leased_bytes = 0
         if self._use_native:
             self._h = native.LIB.arena_create(
                 conf.max_buffer_allocation_size, self.min_block, int(zero_on_get))
@@ -235,6 +241,9 @@ class BufferPool:
                 token = self._py.get(size)
                 bin_size = self._py.size(token)
                 view = self._py.view(token)
+            self._leased_bytes += int(bin_size)
+            self._peak_leased_bytes = max(self._peak_leased_bytes,
+                                          self._leased_bytes)
         return PoolBuffer(int(token), int(bin_size), view, self)
 
     def get_registered(self, size: int) -> RegisteredBuffer:
@@ -250,6 +259,7 @@ class BufferPool:
                     raise RuntimeError(f"arena_put({buf.token}) failed: {rc}")
             else:
                 self._py.put(buf.token)
+            self._leased_bytes -= buf.size
 
     def preallocate(self, size: int, count: int) -> None:
         with self._lock:
@@ -281,6 +291,18 @@ class BufferPool:
             return self._py.total_bytes
 
     @property
+    def leased_bytes(self) -> int:
+        """Bytes currently checked out (bin-size accounting)."""
+        with self._lock:
+            return self._leased_bytes
+
+    @property
+    def peak_leased_bytes(self) -> int:
+        """High-water mark of :attr:`leased_bytes` over the pool's life."""
+        with self._lock:
+            return self._peak_leased_bytes
+
+    @property
     def idle_bytes(self) -> int:
         with self._lock:
             if self._stopped:
@@ -294,6 +316,13 @@ class BufferPool:
             return self._stats_locked()
 
     def _stats_locked(self) -> dict:
+        out = self._backend_stats_locked()
+        if out:
+            out["leased_bytes"] = self._leased_bytes
+            out["peak_leased_bytes"] = self._peak_leased_bytes
+        return out
+
+    def _backend_stats_locked(self) -> dict:
         if self._stopped:
             return {}
         if self._use_native:

@@ -166,7 +166,8 @@ class TpuShuffleManager:
             self.resolver, handle.shuffle_id, map_id, handle.num_partitions,
             handle.partitioner.build(handle.num_partitions),
             handle.row_payload_bytes,
-            combiner=combiner if combiner is not None else handle.combiner)
+            combiner=combiner if combiner is not None else handle.combiner,
+            conf=self.conf, pool=self.pool, tracer=self.tracer)
         return _PublishingWriter(inner, self.executor, tracer=self.tracer)
 
     def get_reader(self, handle: ShuffleHandle, start_partition: int,
@@ -267,5 +268,15 @@ class _PublishingWriter:
 
     @property
     def metrics(self):
-        return {"bytes_written": self._inner.bytes_written,
-                "records_written": self._inner.records_written}
+        out = {"bytes_written": self._inner.bytes_written,
+               "records_written": self._inner.records_written}
+        write_metrics = getattr(self._inner, "metrics", None)
+        if write_metrics is not None:
+            out["write"] = write_metrics.snapshot()
+        return out
+
+    @property
+    def write_metrics(self):
+        """The streaming writer's :class:`WriteMetrics` (scatter/spill/
+        merge timing, spill count/bytes, peak buffered bytes)."""
+        return self._inner.metrics

@@ -31,7 +31,9 @@ def _rows_to_u32(keys: np.ndarray, payload: np.ndarray) -> np.ndarray:
     n = len(keys)
     pw = (payload.shape[1] + 3) // 4
     rows = np.zeros((n, 2 + pw), dtype=np.uint32)
-    rows[:, :2] = keys.view(np.uint32).reshape(n, 2)
+    # ascontiguousarray: decode_rows hands out zero-copy strided key views
+    # (free when already contiguous, which concatenated batches are)
+    rows[:, :2] = np.ascontiguousarray(keys).view(np.uint32).reshape(n, 2)
     if payload.shape[1]:
         padded = np.zeros((n, pw * 4), dtype=np.uint8)
         padded[:, :payload.shape[1]] = payload

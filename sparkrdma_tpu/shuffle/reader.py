@@ -42,17 +42,28 @@ class TpuShuffleReader:
         return self.fetcher.metrics
 
     def read(self) -> Iterator[Batch]:
-        """Record batches in arrival order (one per grouped fetch)."""
+        """Record batches in arrival order (one per grouped fetch).
+
+        Batches may be READ-ONLY zero-copy views (blocks that arrived as
+        owned bytes decode without any copy); copy before mutating in
+        place. ``read_all``/``read_sorted`` return fresh writable arrays.
+        """
         self.fetcher.start()
         try:
             for result in self.fetcher:
                 # len(), not truthiness: lease-backed results are numpy
-                # views (multi-element truthiness raises); decode copies,
-                # so the pool lease releases as soon as it's decoded
+                # views (multi-element truthiness raises). Lease-backed
+                # bytes are materialized ONCE by the decode (the pool
+                # lease releases immediately after); results whose bytes
+                # the fetch already handed us outright decode zero-copy.
                 try:
                     if len(result.data):
+                        owned = (result.lease is None
+                                 and isinstance(result.data,
+                                                (bytes, bytearray)))
                         yield decode_rows(result.data,
-                                          self.row_payload_bytes)
+                                          self.row_payload_bytes,
+                                          copy=not owned)
                 finally:
                     result.free()
         finally:
@@ -144,10 +155,17 @@ class TpuShuffleReader:
                     pos += n
                     r.free()
                 rows = buf.view[:total].reshape(-1, row_bytes)
-                keys_host = rows[:, :8].copy().view(np.uint32).reshape(-1, 2)
-                payload_host = rows[:, 8:].copy()
-            return (jax.device_put(keys_host, device),
-                    jax.device_put(payload_host, device))
+                # device_put straight from the staging buffer's key/payload
+                # views — the staging gather IS the one materialization;
+                # the old host-side .copy() pair was a redundant hop
+                try:
+                    keys_host = rows[:, :8].view(np.uint32)
+                except ValueError:  # numpy < 1.23: strided view unsupported
+                    keys_host = rows[:, :8].copy().view(np.uint32)
+                keys_dev = jax.device_put(keys_host, device)
+                payload_dev = jax.device_put(rows[:, 8:], device)
+                jax.block_until_ready((keys_dev, payload_dev))
+            return keys_dev, payload_dev
         finally:
             # free() is idempotent: chunks already freed by the staging
             # copy are no-ops; an exception mid-fetch frees the rest

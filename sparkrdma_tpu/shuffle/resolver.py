@@ -63,7 +63,11 @@ class TpuShuffleBlockResolver:
 
     def data_tmp_path(self, shuffle_id: int, map_id: int) -> str:
         # attempt-unique: concurrent speculative attempts of one map task
-        # must not interleave writes in a shared tmp file
+        # must not interleave writes in a shared tmp file. The streaming
+        # writer derives its spill-file names from this path
+        # (``<tmp>.s<seq>.tmp``) — everything an uncommitted attempt puts
+        # on disk ends in ``.tmp``, so recover() and remove_shuffle() can
+        # reap orphans without knowing the writer's internals.
         attempt = next(self._attempts)
         return os.path.join(self.spill_dir,
                             f"shuffle_{shuffle_id}_{map_id}.{attempt}.tmp")
@@ -159,6 +163,20 @@ class TpuShuffleBlockResolver:
             spill.dispose()
             if os.path.exists(index):
                 os.unlink(index)
+        # reap this shuffle's uncommitted attempts (writer tmp + spill
+        # files from crashed/aborted tasks) — previously these lingered
+        # until a restart's recover() swept the whole dir
+        prefix = f"shuffle_{shuffle_id}_"
+        try:
+            names = os.listdir(self.spill_dir)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(prefix) and name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.spill_dir, name))
+                except OSError:
+                    pass
 
     def recover(self) -> Dict[int, list]:
         """Rebuild state from committed (data, index) pairs on disk.

@@ -188,6 +188,68 @@ class FailureCounters:
             return dict(sorted(self._counts.items()))
 
 
+class WriteMetrics:
+    """Write-side mirror of ``ReadMetrics``: per-writer telemetry for the
+    streaming map-side dataplane (shuffle/writer.py). Phase times
+    (scatter/spill/merge, ns), spill count/bytes, and the peak of the two
+    memory gauges the bounded-memory design promises: ``peak_buffered``
+    (accumulating runs awaiting a spill decision — bounded by
+    ``spill_threshold_bytes`` + one batch) and ``peak_outstanding``
+    (accumulation PLUS spills in flight on the background thread — bounded
+    by (1 + write_spill_threads) x that). Updated from the writer's task
+    thread and its spill threads — mutate via the record_* methods."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.scatter_ns = 0
+        self.spill_ns = 0
+        self.merge_ns = 0
+        self.spills = 0
+        self.spilled_bytes = 0
+        self.spill_wait_ns = 0  # write_batch blocked on spill backpressure
+        self.peak_buffered_bytes = 0
+        self.peak_outstanding_bytes = 0
+        self.native_scatter = False
+
+    def record_scatter(self, ns: int) -> None:
+        with self._lock:
+            self.scatter_ns += ns
+
+    def record_spill(self, ns: int, nbytes: int) -> None:
+        with self._lock:
+            self.spill_ns += ns
+            self.spills += 1
+            self.spilled_bytes += nbytes
+
+    def record_merge(self, ns: int) -> None:
+        with self._lock:
+            self.merge_ns += ns
+
+    def record_spill_wait(self, ns: int) -> None:
+        with self._lock:
+            self.spill_wait_ns += ns
+
+    def record_buffered(self, buffered: int, outstanding: int) -> None:
+        with self._lock:
+            self.peak_buffered_bytes = max(self.peak_buffered_bytes, buffered)
+            self.peak_outstanding_bytes = max(self.peak_outstanding_bytes,
+                                              outstanding)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "scatter_ns": self.scatter_ns,
+                "spill_ns": self.spill_ns,
+                "merge_ns": self.merge_ns,
+                "spill_wait_ns": self.spill_wait_ns,
+                "spills": self.spills,
+                "spilled_bytes": self.spilled_bytes,
+                "peak_buffered_bytes": self.peak_buffered_bytes,
+                "peak_outstanding_bytes": self.peak_outstanding_bytes,
+                "native_scatter": self.native_scatter,
+            }
+
+
 class ShuffleReaderStats:
     """Per-remote + global histograms (RdmaShuffleReaderStats.scala:32-81)."""
 
