@@ -3,31 +3,39 @@
 # (tests/test_chaos.py, `chaos` marker — including the `slow` wide
 # matrix) across a set of injector seeds, on BOTH fetch dataplanes
 # (coalesced vectored reads and the per-map fallback — the failure paths
-# differ, so the matrix covers each). Every scenario asserts
-# byte-identical reduce output under its faults and embeds the seed in
-# any failure message, so a red sweep replays exactly:
+# differ, so the matrix covers each), and with the STORAGE-fault matrix
+# (CHAOS_DISK=1, the default: seeded ENOSPC/EIO/torn-write/slow-disk/
+# corrupt-at-rest scenarios over the spill/merge/commit/serve path with
+# at-rest checksums on). Every scenario asserts byte-identical reduce
+# output under its faults — via refetch, spill retry, fallback dir, or
+# map re-execution — and embeds the seed in any failure message, so a
+# red sweep replays exactly:
 #
-#     CHAOS_SEED=<seed> CHAOS_COALESCE=<0|1> \
+#     CHAOS_SEED=<seed> CHAOS_COALESCE=<0|1> CHAOS_DISK=<0|1> \
 #         python -m pytest tests/test_chaos.py -m chaos
 #
 # Usage: scripts/run_chaos.sh [seed ...]
 #   CHAOS_SEEDS="0 1 2"   alternative way to pass the seed list
 #   CHAOS_COALESCE_MODES="0 1"  dataplanes to sweep (default both)
+#   CHAOS_DISK=0          drop the storage-fault matrix from the sweep
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 SEEDS=${*:-${CHAOS_SEEDS:-"0 1 2 3 4 5 6 7"}}
 MODES=${CHAOS_COALESCE_MODES:-"1 0"}
+DISK=${CHAOS_DISK:-1}
 failed=()
 for coalesce in $MODES; do
   for seed in $SEEDS; do
-    echo "=== chaos sweep: seed ${seed} coalesce=${coalesce} ==="
+    echo "=== chaos sweep: seed ${seed} coalesce=${coalesce} disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
+         CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
            -p no:cacheprovider -p no:randomly; then
       echo "!!! seed ${seed} coalesce=${coalesce} FAILED — replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
+           "CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
       failed+=("${seed}/c${coalesce}")
     fi
@@ -38,4 +46,4 @@ if [ "${#failed[@]}" -gt 0 ]; then
   echo "chaos sweep: FAILED (seed/dataplane): ${failed[*]}"
   exit 1
 fi
-echo "chaos sweep: all seeds green on both dataplanes"
+echo "chaos sweep: all seeds green on both dataplanes (disk=${DISK})"

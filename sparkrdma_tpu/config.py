@@ -228,6 +228,38 @@ _KEYS = [
              "refetch within fetch_retry_budget before escalating to "
              "FetchFailed. Native block-server responses are unchecksummed "
              "and verified only when the flag is present."),
+    _Key("spill_dirs", "", "str",
+         doc="Comma-separated FALLBACK spill directories for the write "
+             "path. A spill that fails with a transient disk error "
+             "(ENOSPC, EIO, torn write) retries with backoff into the "
+             "next healthy directory; a directory accumulating "
+             "spill_dir_max_failures consecutive failures is quarantined "
+             "for the executor's lifetime. Empty = primary spill dir "
+             "only (a transient failure still retries in place)."),
+    _Key("spill_dir_max_failures", 2, "int", 1, 1000,
+         doc="Consecutive spill failures before a spill directory is "
+             "quarantined (skipped by every later spill and recovery "
+             "sweep ordering; a success resets the count)."),
+    _Key("spill_retry_budget", 2, "int", 0, 100,
+         doc="Spill write retries beyond the first attempt for TRANSIENT "
+             "disk errors (ENOSPC/EIO/EAGAIN/torn write), with the same "
+             "exponential backoff as fetch retries. ENOSPC additionally "
+             "halves the writer's spill threshold so later spills are "
+             "smaller. Fatal errors (EACCES, EROFS, ...) and an "
+             "exhausted budget fail the attempt cleanly — every tmp and "
+             "spill file reaped — as a WriteFailedError the map stage "
+             "can re-place on another executor."),
+    _Key("at_rest_checksum", False, "bool",
+         doc="Write a CRC32 sidecar (<data>.crc: per-partition + whole-"
+             "file CRCs + the commit's fencing token) at commit, verify "
+             "it on mmap-open after a restart (recover() drops corrupt "
+             "or unattested files so the map recomputes), and spot-check "
+             "at serve time: first serve of each partition on the Python "
+             "data path, first location serve of each output when a "
+             "native block server carries the data bytes. A corrupt "
+             "output serves STATUS_CORRUPT (retryable) and routes into "
+             "blame -> re-execution. Off by default: commits pay one "
+             "streaming CRC pass when enabled."),
     _Key("request_deadline_ms", 0, "int", 0, 3600_000,
          doc="Per-request completion deadline on the control plane "
              "(request/AsyncFetch waits); 0 = fall back to "
@@ -325,6 +357,11 @@ class TpuShuffleConf:
         hard = ((M.NATIVE_MAX_REQ_FRAME - M.BLOCKS_REQ_FIXED_BYTES)
                 // M.BLOCK_WIRE_BYTES)
         return max(1, min(explicit if explicit > 0 else derived, hard))
+
+    def resolved_spill_dirs(self) -> list:
+        """The parsed ``spill_dirs`` fallback list (may be empty)."""
+        return [d.strip() for d in str(self.spill_dirs).split(",")
+                if d.strip()]
 
     def prealloc_spec(self) -> Dict[int, int]:
         """Parse 'size:count,size:count' into {bytes: count}.

@@ -158,6 +158,9 @@ class DriverEndpoint:
         # served to executors on GetBroadcastReq
         self._broadcasts: Dict[int, bytes] = {}
         self._broadcasts_lock = threading.Lock()
+        # commit-fencing audit: publishes rejected as stale (a zombie
+        # speculative attempt's late publish)
+        self.fenced_publishes = 0
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -331,7 +334,7 @@ class DriverEndpoint:
         # Publish is one-sided in the reference (RDMA WRITE into the table,
         # scala/RdmaShuffleManager.scala:410-412) — no remote reply; problems
         # are only observable driver-side, so log rather than ack.
-        from sparkrdma_tpu.shuffle.map_output import MAP_ENTRY_SIZE
+        from sparkrdma_tpu.shuffle.map_output import _MAP_ENTRY, MAP_ENTRY_SIZE
         with self._tables_lock:
             table = self._tables.get(msg.shuffle_id)
         if table is None:
@@ -341,11 +344,25 @@ class DriverEndpoint:
             log.warning("driver: publish with bad map_id %d for shuffle %d",
                         msg.map_id, msg.shuffle_id)
             return None
+        if len(msg.entry) != MAP_ENTRY_SIZE:
+            log.warning("driver: bad publish entry size %d for shuffle %d "
+                        "map %d", len(msg.entry), msg.shuffle_id, msg.map_id)
+            return None
+        token, exec_index = _MAP_ENTRY.unpack(msg.entry)
         try:
-            table.write_raw(msg.map_id * MAP_ENTRY_SIZE, msg.entry)
+            accepted = table.publish(msg.map_id, token, exec_index,
+                                     fence=msg.fence)
         except (ValueError, IndexError) as e:
             log.warning("driver: bad publish for shuffle %d map %d: %s",
                         msg.shuffle_id, msg.map_id, e)
+            return None
+        if not accepted:
+            # a zombie speculative attempt's late publish: the committed
+            # winner's location stays the one served
+            self.fenced_publishes += 1
+            log.warning("driver: FENCED stale publish for shuffle %d map "
+                        "%d (exec %d fence %d)", msg.shuffle_id, msg.map_id,
+                        exec_index, msg.fence)
             return None
         # push: answer any long-poller this publish satisfies (the write
         # above happens-before the waiter scan; _on_fetch_table re-checks
@@ -921,12 +938,37 @@ class ExecutorEndpoint:
         self._task_pool.submit(work)
         return None  # answered by the worker when the task finishes
 
+    def _corrupt_served(self, shuffle_id: int, map_id: int,
+                        detail: str) -> None:
+        """Audit a serve that found at-rest corruption (the resolver
+        already quarantined the output)."""
+        self.tracer.instant("serve.corrupt", "fault", shuffle=shuffle_id,
+                            map=map_id, detail=detail)
+        log.error("%s: serving shuffle %d map %d found corrupt committed "
+                  "output (%s); answering STATUS_CORRUPT so the reducer "
+                  "re-executes the map",
+                  self.manager_id.executor_id.executor, shuffle_id, map_id,
+                  detail)
+
     def _on_fetch_output(self, msg: M.FetchOutputReq) -> RpcMsg:
         """Serve 16B location entries
         (scala/RdmaShuffleFetcherIterator.scala:293-315 analogue)."""
         if self.data_source is None:
             return M.FetchOutputResp(msg.req_id, M.STATUS_ERROR, b"")
-        table = self.data_source.get_output_table(msg.shuffle_id, msg.map_id)
+        from sparkrdma_tpu.utils.integrity import CorruptOutputError
+        try:
+            table = self.data_source.get_output_table(msg.shuffle_id,
+                                                      msg.map_id)
+        except CorruptOutputError as e:
+            self._corrupt_served(msg.shuffle_id, msg.map_id, str(e))
+            return M.FetchOutputResp(msg.req_id, M.STATUS_CORRUPT, b"")
+        except OSError as e:
+            # transient disk error in the serve-time verify: answer the
+            # retryable class — an unanswered request would burn the
+            # requester's whole deadline instead of one backoff
+            log.warning("location serve failed for shuffle %d map %d: %s",
+                        msg.shuffle_id, msg.map_id, e)
+            return M.FetchOutputResp(msg.req_id, M.STATUS_ERROR, b"")
         if table is None:
             return M.FetchOutputResp(msg.req_id, M.STATUS_UNKNOWN_MAP, b"")
         if not (0 <= msg.start_partition <= msg.end_partition <= table.num_partitions):
@@ -950,9 +992,21 @@ class ExecutorEndpoint:
                 or span * ENTRY_SIZE * max(1, len(msg.map_ids))
                 > self._MAX_RESP_PAYLOAD):
             return M.FetchOutputsResp(msg.req_id, M.STATUS_BAD_RANGE, [])
+        from sparkrdma_tpu.utils.integrity import CorruptOutputError
         records = []
         for map_id in msg.map_ids:
-            table = self.data_source.get_output_table(msg.shuffle_id, map_id)
+            try:
+                table = self.data_source.get_output_table(msg.shuffle_id,
+                                                          map_id)
+            except CorruptOutputError as e:
+                self._corrupt_served(msg.shuffle_id, map_id, str(e))
+                records.append((map_id, M.STATUS_CORRUPT, b""))
+                continue
+            except OSError as e:
+                log.warning("location serve failed for shuffle %d map %d: "
+                            "%s", msg.shuffle_id, map_id, e)
+                records.append((map_id, M.STATUS_ERROR, b""))
+                continue
             if table is None:
                 records.append((map_id, M.STATUS_UNKNOWN_MAP, b""))
             elif not (msg.start_partition <= msg.end_partition
@@ -1095,9 +1149,24 @@ class ExecutorEndpoint:
                         self.conf.shuffle_read_block_size))
         if total > min(cap, self._MAX_SINGLE_BLOCK):
             return M.FetchBlocksResp(msg.req_id, M.STATUS_BAD_RANGE, b"")
+        from sparkrdma_tpu.utils.integrity import CorruptOutputError
         parts = []
         for token, offset, length in msg.blocks:
-            data = self.data_source.read_block(msg.shuffle_id, token, offset, length)
+            try:
+                data = self.data_source.read_block(msg.shuffle_id, token,
+                                                   offset, length)
+            except CorruptOutputError as e:
+                # the serve-time spot check caught at-rest rot: NEVER send
+                # the torn bytes — answer CORRUPT (retryable) so the
+                # reducer's envelope escalates into map re-execution
+                self._corrupt_served(msg.shuffle_id, -1, str(e))
+                return M.FetchBlocksResp(msg.req_id, M.STATUS_CORRUPT, b"")
+            except OSError as e:
+                # serve-time disk error (EIO on the mapped file): a
+                # transient answer — the refetch may land on healthy media
+                log.warning("serve-time read error for shuffle %d: %s",
+                            msg.shuffle_id, e)
+                return M.FetchBlocksResp(msg.req_id, M.STATUS_ERROR, b"")
             if data is None:
                 return M.FetchBlocksResp(msg.req_id, M.STATUS_UNKNOWN_SHUFFLE, b"")
             parts.append(data)
@@ -1134,13 +1203,16 @@ class ExecutorEndpoint:
     # -- client-side fetch calls (used by the fetcher iterator) ----------
 
     def publish_map_output(self, shuffle_id: int, map_id: int,
-                           table_token: int) -> None:
-        """(scala/RdmaShuffleManager.scala:384-418)."""
+                           table_token: int, fence: int = 0) -> None:
+        """(scala/RdmaShuffleManager.scala:384-418). ``fence`` is the
+        committing attempt's fencing token — the driver rejects a publish
+        naming the same executor with an older fence, so a zombie
+        speculative attempt can't clobber the winner's location."""
         entry = DriverTable.pack_entry(
             table_token,
             self.exec_index(timeout=self.conf.connect_timeout_ms / 1000))
         conn = self.driver_conn()
-        msg = M.PublishMsg(shuffle_id, map_id, entry)
+        msg = M.PublishMsg(shuffle_id, map_id, entry, fence=fence)
         conn.send(msg)
 
     def get_driver_table(self, shuffle_id: int, expect_published: int,
@@ -1232,9 +1304,16 @@ class ExecutorEndpoint:
             if resp.status != M.STATUS_OK:
                 # the owner answered authoritatively: it does not have the
                 # map/range the driver table promised — a refetch re-fails
-                # identically, only a recompute heals it
-                raise FetchStatusError("fetch_output", resp.status,
-                                       retryable=False)
+                # identically, only a recompute heals it. CORRUPT is the
+                # retryable demotion of at-rest rot (the bounded refetch
+                # re-fails fast, then escalates with a corrupt_output
+                # verdict into map re-execution); ERROR is the transient
+                # serving class (verify-time disk hiccup) — same
+                # semantics as the blocks path
+                raise FetchStatusError(
+                    "fetch_output", resp.status,
+                    retryable=resp.status in (M.STATUS_ERROR,
+                                              M.STATUS_CORRUPT))
             return MapTaskOutput.locations_from_range(resp.entries)
 
         return AsyncFetch(fut, self.conf.resolved_request_deadline_s(),
@@ -1283,8 +1362,10 @@ class ExecutorEndpoint:
             out = {}
             for map_id, mstatus, entries in resp.records:
                 if mstatus != M.STATUS_OK:
-                    err = FetchStatusError(f"fetch_outputs map {map_id}",
-                                           mstatus, retryable=False)
+                    err = FetchStatusError(
+                        f"fetch_outputs map {map_id}", mstatus,
+                        retryable=mstatus in (M.STATUS_ERROR,
+                                              M.STATUS_CORRUPT))
                     err.map_id = map_id
                     raise err
                 out[map_id] = MapTaskOutput.locations_from_range(entries)
@@ -1460,11 +1541,15 @@ class ExecutorEndpoint:
             if resp.status != M.STATUS_OK:
                 # STATUS_ERROR is the transient class (credit-window
                 # expiry under a stalled consumer, serving hiccup) — a
-                # refetch usually heals it; unknown-token/shuffle and
-                # bad-range answers are authoritative re-failures
+                # refetch usually heals it; STATUS_CORRUPT retries within
+                # the same budget then escalates with a corrupt_output
+                # verdict (at-rest rot heals only by re-execution);
+                # unknown-token/shuffle and bad-range answers are
+                # authoritative re-failures
                 raise FetchStatusError(
                     "fetch_blocks", resp.status,
-                    retryable=resp.status == M.STATUS_ERROR)
+                    retryable=resp.status in (M.STATUS_ERROR,
+                                              M.STATUS_CORRUPT))
             return self._decode_blocks_resp(final_req, resp)
 
         return AsyncFetch(fut, self.conf.resolved_request_deadline_s(),

@@ -1,4 +1,5 @@
-"""Deterministic fault injection for the control-plane transport.
+"""Deterministic fault injection for the control-plane transport AND the
+storage dataplane.
 
 The chaos harness the hardened failure path is tested with: a
 :class:`FaultInjector` wraps a live :class:`ConnectionCache` (and every
@@ -8,6 +9,19 @@ receive dispatch — so every failure mode the fetch path must survive
 (connect refusal, mid-stream disconnect, response delay, payload
 bit-flips, blackhole/partition) is reproducible in-process over plain
 sockets.
+
+Its sibling :class:`StorageFaultInjector` does the same for the disk
+half of the dataplane: the writer's spill/merge writes, the resolver's
+rename-commit and index/sidecar writes, mmap-opens, and serve-time
+reads all consult cheap module-level hook points
+(:func:`storage_check` / :func:`storage_write_cap` /
+:func:`storage_corrupt` — no-ops until an injector is installed) so
+``ENOSPC``, ``EIO``, torn/short writes, slow-disk stalls, and at-rest
+corruption are reproducible on the production code paths. The serving
+path has no server CPU to notice a bad block (the committed file is
+mmap'd and served one-sided, PAPER §0), so integrity and fencing live
+in the data and commit protocol — this injector is how that protocol
+is proven.
 
 Faults match on ``(kind, peer, message type, direction)`` with
 ``after``/``times`` windows and an optional per-match probability drawn
@@ -24,6 +38,7 @@ below "kill a JVM and watch Spark recompute" (SURVEY §7 hard part #4).
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
@@ -269,3 +284,202 @@ class FaultInjector:
                 self.fired[kind] = self.fired.get(kind, 0) + 1
                 return fault
         return None
+
+
+# -- storage faults -------------------------------------------------------
+
+# Storage fault kinds.
+ENOSPC = "enospc"              # the op raises OSError(ENOSPC)
+EIO = "eio"                    # the op raises OSError(EIO)
+TORN_WRITE = "torn_write"      # the write lands SHORT (torn_bytes of it)
+#                                then raises OSError(EIO) — the crash
+#                                window a rename-commit must mask
+SLOW_DISK = "slow_disk"        # hold the op delay_s on the calling thread
+CORRUPT_AT_REST = "corrupt_at_rest"  # flip bits in the target file AFTER
+#                                the op completes (bit-rot of committed
+#                                bytes; the CRC sidecar owns detection)
+
+STORAGE_KINDS = (ENOSPC, EIO, TORN_WRITE, SLOW_DISK, CORRUPT_AT_REST)
+
+# Hook-point op names (the layers real disk failures enter):
+#   spill_write   writer background spill file writes
+#   merge_write   writer close()-time merge into the data tmp
+#   commit        resolver rename-commit of the data file (also the
+#                 corrupt-at-rest hook: fires on the COMMITTED file)
+#   index_write   resolver index/sidecar durability writes
+#   mmap_open     SpillFile/block-server mapping of a committed file
+#   serve_read    resolver serve-time block reads
+
+
+@dataclass
+class StorageFault:
+    """One scripted storage fault. Matching is AND across set criteria
+    (op name, path substring); ``after``/``times``/``prob`` behave as on
+    :class:`Fault`."""
+
+    kind: str
+    op: Optional[str] = None          # None matches any op
+    path_substr: Optional[str] = None
+    after: int = 0
+    times: Optional[int] = None
+    prob: float = 1.0
+    delay_s: float = 0.0              # SLOW_DISK
+    torn_bytes: int = 64              # TORN_WRITE: bytes that land
+    flip_bits: int = 1                # CORRUPT_AT_REST
+    seen: int = 0
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.kind not in STORAGE_KINDS:
+            raise ValueError(f"unknown storage fault kind {self.kind!r}")
+
+
+class StorageFaultInjector:
+    """Seeded chaos shim over the storage dataplane.
+
+    Installed process-globally (``install()``/``uninstall()``): the
+    writer, resolver, and block server consult the module hook on every
+    guarded file op, which is a single ``is None`` check when no
+    injector is active. Same ``after``/``times``/``prob`` windows and
+    seeded RNG as the transport injector, so a failing
+    ``scripts/run_chaos.sh CHAOS_DISK=1`` sweep replays from its seed.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self._lock = threading.RLock()
+        self._faults: List[StorageFault] = []
+        self.fired: Dict[str, int] = {}
+
+    # -- scripting -------------------------------------------------------
+
+    def add(self, kind: str, **kw) -> StorageFault:
+        fault = StorageFault(kind, **kw)
+        with self._lock:
+            self._faults.append(fault)
+        return fault
+
+    def clear(self) -> None:
+        with self._lock:
+            self._faults.clear()
+
+    def fired_count(self, kind: Optional[str] = None) -> int:
+        with self._lock:
+            if kind is not None:
+                return self.fired.get(kind, 0)
+            return sum(self.fired.values())
+
+    # -- installation ----------------------------------------------------
+
+    def install(self) -> None:
+        global _STORAGE
+        _STORAGE = self
+
+    def uninstall(self) -> None:
+        global _STORAGE
+        if _STORAGE is self:
+            _STORAGE = None
+
+    # -- fault application (called from the module hooks) ----------------
+
+    def check(self, op: str, path: str) -> None:
+        """Raise/stall for error-kind faults matching ``(op, path)``."""
+        import errno
+
+        fault = self._match(SLOW_DISK, op, path)
+        if fault is not None:
+            time.sleep(fault.delay_s)
+        fault = self._match(ENOSPC, op, path)
+        if fault is not None:
+            raise OSError(errno.ENOSPC,
+                          f"fault injection: no space ({op})", path)
+        fault = self._match(EIO, op, path)
+        if fault is not None:
+            raise OSError(errno.EIO, f"fault injection: I/O error ({op})",
+                          path)
+
+    def write_cap(self, op: str, path: str, nbytes: int) -> Optional[int]:
+        """TORN_WRITE: how many of ``nbytes`` should actually land before
+        the write fails (None = no fault, write everything)."""
+        fault = self._match(TORN_WRITE, op, path)
+        if fault is None:
+            return None
+        return max(0, min(fault.torn_bytes, nbytes - 1))
+
+    def corrupt(self, op: str, path: str) -> bool:
+        """CORRUPT_AT_REST: flip seeded bits in ``path`` in place (the
+        sidecar was already written from the clean bytes — this is rot
+        AFTER commit). Returns True if a fault fired."""
+        fault = self._match(CORRUPT_AT_REST, op, path)
+        if fault is None:
+            return False
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return False
+        if size == 0:
+            return False
+        with open(path, "r+b") as f:
+            for _ in range(max(1, fault.flip_bits)):
+                with self._lock:
+                    pos = self.rng.randrange(size)
+                    bit = 1 << self.rng.randrange(8)
+                f.seek(pos)
+                b = f.read(1)
+                f.seek(pos)
+                f.write(bytes([b[0] ^ bit]))
+        log.debug("fault injection: flipped %d bit(s) at rest in %s",
+                  max(1, fault.flip_bits), path)
+        return True
+
+    def _match(self, kind: str, op: str, path: str) -> Optional[StorageFault]:
+        with self._lock:
+            for fault in self._faults:
+                if fault.kind != kind:
+                    continue
+                if fault.op is not None and fault.op != op:
+                    continue
+                if (fault.path_substr is not None
+                        and fault.path_substr not in path):
+                    continue
+                fault.seen += 1
+                if fault.seen <= fault.after:
+                    continue
+                if fault.times is not None and fault.fired >= fault.times:
+                    continue
+                if fault.prob < 1.0 and self.rng.random() >= fault.prob:
+                    continue
+                fault.fired += 1
+                self.fired[kind] = self.fired.get(kind, 0) + 1
+                return fault
+        return None
+
+
+# Process-global storage injector (None = no chaos, hooks are no-ops).
+_STORAGE: Optional[StorageFaultInjector] = None
+
+
+def storage_check(op: str, path: str) -> None:
+    """Production hook: raise/stall if a storage fault matches. A single
+    attribute load + ``is None`` test when no injector is installed."""
+    inj = _STORAGE
+    if inj is not None:
+        inj.check(op, path)
+
+
+def storage_write_cap(op: str, path: str, nbytes: int) -> Optional[int]:
+    """Production hook for torn/short writes: bytes to land before
+    failing, or None for a full write."""
+    inj = _STORAGE
+    if inj is not None:
+        return inj.write_cap(op, path, nbytes)
+    return None
+
+
+def storage_corrupt(op: str, path: str) -> None:
+    """Production hook: flip bits at rest in ``path`` if a
+    CORRUPT_AT_REST fault matches (no-op otherwise)."""
+    inj = _STORAGE
+    if inj is not None:
+        inj.corrupt(op, path)

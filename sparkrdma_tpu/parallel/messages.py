@@ -59,20 +59,37 @@ BLOCK_WIRE_BYTES = _BLOCK.size          # one (buf, offset, length) range
 
 @register(3)
 class PublishMsg(RpcMsg):
-    """Executor -> driver: positional driver-table entry write."""
+    """Executor -> driver: positional driver-table entry write.
 
-    def __init__(self, shuffle_id: int, map_id: int, entry: bytes):
+    ``fence`` is the committing attempt's fencing token: the driver
+    rejects a publish whose fence is older than the one already applied
+    for the same (map, executor), so a zombie speculative attempt that
+    commits late cannot clobber the winner's location entry. Appended
+    after the fixed 12-byte entry; a fence-less (pre-fencing) payload
+    decodes with fence 0, which never out-fences anything."""
+
+    ENTRY_BYTES = 12
+
+    def __init__(self, shuffle_id: int, map_id: int, entry: bytes,
+                 fence: int = 0):
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.entry = entry
+        self.fence = fence
 
     def payload(self) -> bytes:
-        return struct.pack("<ii", self.shuffle_id, self.map_id) + self.entry
+        return (struct.pack("<ii", self.shuffle_id, self.map_id)
+                + self.entry + struct.pack("<q", self.fence))
 
     @classmethod
     def from_payload(cls, payload: bytes) -> "PublishMsg":
         shuffle_id, map_id = struct.unpack_from("<ii", payload, 0)
-        return cls(shuffle_id, map_id, payload[8:])
+        entry = payload[8:8 + cls.ENTRY_BYTES]
+        fence = 0
+        if len(payload) >= 8 + cls.ENTRY_BYTES + 8:
+            (fence,) = struct.unpack_from("<q", payload,
+                                          8 + cls.ENTRY_BYTES)
+        return cls(shuffle_id, map_id, entry, fence)
 
 
 # Wire type 4 reserved (was an ack; publish is one-sided like the
@@ -438,6 +455,10 @@ STATUS_UNKNOWN_SHUFFLE = 1
 STATUS_UNKNOWN_MAP = 2
 STATUS_BAD_RANGE = 3
 STATUS_ERROR = 4
+# the committed output failed its at-rest CRC verification: retryable on
+# the wire (the retry envelope escalates it to FetchFailed with a
+# corrupt_output verdict, and recovery re-executes the producing map)
+STATUS_CORRUPT = 5
 
 # RunTaskResp statuses.
 TASK_OK = 0

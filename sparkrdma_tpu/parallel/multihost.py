@@ -24,9 +24,12 @@ collectives) is identical to a real multi-host TPU pod.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 
 def init_multihost(coordinator_address: str, num_processes: int,
@@ -147,8 +150,17 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
         owner = by_slot.get(entry[1])
         if owner is None:
             continue  # another process's map (checked globally below)
-        raw = owner.resolver.local_blocks(handle.shuffle_id, m, 0,
-                                          handle.num_partitions)
+        from sparkrdma_tpu.utils.integrity import CorruptOutputError
+        try:
+            raw = owner.resolver.local_blocks(handle.shuffle_id, m, 0,
+                                              handle.num_partitions)
+        except (CorruptOutputError, OSError) as e:
+            # corrupt/unreadable at staging time: same treatment as a
+            # disposed output — unstaged, so the consistent completeness
+            # check below owns the failure on every process
+            raw = None
+            log.warning("map %d unreadable at staging time (%s); leaving "
+                        "unstaged", m, e)
         if raw is None:
             # disposed mid-staging (dying executor): leave it unstaged —
             # the POST-allgather completeness check raises the retryable

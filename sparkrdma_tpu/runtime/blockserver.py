@@ -76,6 +76,11 @@ class BlockServer:
             return int(native.LIB.bs_port(self._h))
 
     def register_file(self, token: int, path: str) -> None:
+        # chaos hook: an mmap-open failure here surfaces as an OSError at
+        # commit/recover time (the write-failure path owns it) instead of
+        # a silently unservable token
+        from sparkrdma_tpu.parallel import faults as fault_mod
+        fault_mod.storage_check("mmap_open", path)
         with self._lock:
             if self._stopped:
                 return

@@ -59,6 +59,7 @@ from sparkrdma_tpu.parallel.endpoints import (
     DeadExecutorError,
     ExecutorEndpoint,
 )
+from sparkrdma_tpu.parallel.messages import STATUS_CORRUPT
 from sparkrdma_tpu.parallel.transport import (
     Backoff,
     ChecksumError,
@@ -78,14 +79,23 @@ class _Aborted(Exception):
 class FetchFailedError(Exception):
     """A remote block could not be fetched; the engine should recompute the
     producing stage (reference surfaces Spark's FetchFailedException,
-    scala/RdmaShuffleFetcherIterator.scala:376-381)."""
+    scala/RdmaShuffleFetcherIterator.scala:376-381).
 
-    def __init__(self, shuffle_id: int, map_id: int, exec_index: int, cause: str):
+    ``verdict`` tells the recovery loop WHY: ``"peer_lost"`` (default —
+    the slot may be dead; recompute everything it owned, maybe tombstone)
+    vs ``"corrupt_output"`` (the owner is alive but THIS map's committed
+    output failed its at-rest verification; re-execute just that map, on
+    any live executor including the owner, and never tombstone a live
+    peer over bit-rot)."""
+
+    def __init__(self, shuffle_id: int, map_id: int, exec_index: int,
+                 cause: str, verdict: str = "peer_lost"):
         super().__init__(f"shuffle {shuffle_id} map {map_id} "
                          f"(executor slot {exec_index}): {cause}")
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.exec_index = exec_index
+        self.verdict = verdict
 
 
 @dataclass
@@ -256,9 +266,42 @@ class ShuffleFetcher:
                 by_peer.setdefault(exec_idx, []).append(m)
 
         # Local short-circuit (:327-337): serve directly, count separately.
+        from sparkrdma_tpu.utils.integrity import CorruptOutputError
         for m in local_maps:
-            data = self.resolver.local_blocks(
-                self.shuffle_id, m, self.start_partition, self.end_partition)
+            attempts = 1 + max(0, self.conf.fetch_retry_budget)
+            for attempt in range(attempts):
+                try:
+                    data = self.resolver.local_blocks(
+                        self.shuffle_id, m, self.start_partition,
+                        self.end_partition)
+                    break
+                except CorruptOutputError as e:
+                    # our OWN committed output rotted: same demotion as
+                    # the remote case — re-execute the map (a reread
+                    # cannot heal persistent rot), don't fail the job
+                    raise FetchFailedError(
+                        self.shuffle_id, m, my_index,
+                        f"local map output corrupt at rest: {e}",
+                        verdict="corrupt_output") from e
+                except OSError as e:
+                    # transient local disk error: same bounded retry the
+                    # remote path gets (a remote serve answers the
+                    # retryable STATUS_ERROR for this) — escalating on
+                    # the first EIO would recompute every local map
+                    # elsewhere over a hiccup
+                    if attempt + 1 >= attempts:
+                        raise FetchFailedError(
+                            self.shuffle_id, m, my_index,
+                            f"local map output unreadable after "
+                            f"{attempts} attempt(s): {e}") from e
+                    self.metrics.record_retry()
+                    # abort-aware like every other retry wait in this
+                    # file: a concurrent teardown must not sit out the
+                    # full backoff schedule
+                    if self._aborted.wait(self._backoff.delay(attempt)):
+                        raise FetchFailedError(
+                            self.shuffle_id, m, my_index,
+                            "fetch aborted during local read retry") from e
             if data is None:
                 raise FetchFailedError(self.shuffle_id, m, my_index,
                                        "local map output missing")
@@ -656,9 +699,38 @@ class ShuffleFetcher:
         if (isinstance(err, ChecksumError) and err.bad_blocks is not None
                 and err.body is not None and len(vf.segments) > 1):
             return self._heal_vectored(peer, exec_idx, vf, err)
+        if (isinstance(err, FetchStatusError)
+                and err.status == STATUS_CORRUPT and len(vf.segments) > 1):
+            return self._isolate_corrupt_vectored(peer, exec_idx, vf)
         return self._with_retries("blocks", exec_idx,
                                   vf.segments[0].map_id, read_all,
                                   first_error=err)
+
+    def _isolate_corrupt_vectored(self, peer, exec_idx: int,
+                                  vf: _VectoredFetch) -> bytes:
+        """A server-side at-rest CORRUPT verdict covers a whole vectored
+        response (the serve aborts before sending any torn byte), so a
+        multi-map request can't tell WHICH map's committed output rotted.
+        Refetch each segment alone: healthy maps keep their bytes, and
+        the corrupt one fails under the envelope with ITS map charged —
+        the re-execution (corrupt_output verdict) then recomputes exactly
+        the rotten output, not the first map that happened to share the
+        frame."""
+        parts: List[bytes] = []
+        for seg in vf.segments:
+
+            def refetch(seg=seg):
+                self.metrics.record_request()
+                with self.tracer.span("fetch.refetch_range", "fault",
+                                      map=seg.map_id, peer=exec_idx,
+                                      bytes=seg.total_bytes,
+                                      blocks=len(seg.blocks)):
+                    return self.endpoint.fetch_blocks(
+                        peer, self.shuffle_id, seg.blocks)
+
+            parts.append(self._with_retries("blocks", exec_idx, seg.map_id,
+                                            refetch))
+        return b"".join(parts)
 
     def _heal_vectored(self, peer, exec_idx: int, vf: _VectoredFetch,
                        err: ChecksumError) -> bytes:
@@ -760,9 +832,13 @@ class ShuffleFetcher:
         named = getattr(err, "map_id", None)
         if isinstance(named, int):
             map_id = named
+        verdict = ("corrupt_output"
+                   if getattr(err, "status", None) == STATUS_CORRUPT
+                   else "peer_lost")
         raise FetchFailedError(
             self.shuffle_id, map_id, exec_idx,
-            f"{what} failed after {consumed} attempt(s): {err}") from err
+            f"{what} failed after {consumed} attempt(s): {err}",
+            verdict=verdict) from err
 
     def _with_retries(self, what: str, exec_idx: int, map_id: int, fn,
                       first_error: Optional[BaseException] = None):

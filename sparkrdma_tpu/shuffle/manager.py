@@ -126,7 +126,7 @@ class TpuShuffleManager:
             self.block_server = maybe_create(self.conf, host=host)
             spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpushuffle_")
             self.resolver = TpuShuffleBlockResolver(
-                spill_dir, block_server=self.block_server)
+                spill_dir, block_server=self.block_server, conf=self.conf)
             self.executor = ExecutorEndpoint(
                 host, executor_id, driver_addr, data_source=self.resolver,
                 conf=self.conf,
@@ -191,7 +191,9 @@ class TpuShuffleManager:
         recovered = self.resolver.recover()
         for shuffle_id, entries in recovered.items():
             for m, token in entries:
-                self.executor.publish_map_output(shuffle_id, m, token)
+                self.executor.publish_map_output(
+                    shuffle_id, m, token,
+                    fence=self.resolver.committed_fence(shuffle_id, m))
         return recovered
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
@@ -262,9 +264,22 @@ class _PublishingWriter:
         with self._tracer.span("writer.publish", "write",
                                shuffle=self._inner.shuffle_id,
                                map=self._inner.map_id):
+            # the publish carries the attempt's fencing token: a stale
+            # (zombie) attempt can't even get here — its commit already
+            # raised StaleAttemptError — and the driver's fence check
+            # rejects lateness the resolver couldn't see
             self._endpoint.publish_map_output(self._inner.shuffle_id,
-                                              self._inner.map_id, token)
+                                              self._inner.map_id, token,
+                                              fence=self._inner.fence)
         return token, partition_lengths
+
+    @property
+    def closed(self) -> bool:
+        return self._inner.closed
+
+    @property
+    def fence(self) -> int:
+        return self._inner.fence
 
     @property
     def metrics(self):

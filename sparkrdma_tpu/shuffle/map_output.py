@@ -128,20 +128,42 @@ class DriverTable:
         self.num_maps = num_maps
         self._buf = bytearray(num_maps * MAP_ENTRY_SIZE)
         self._published = 0  # O(1) count for the poll-heavy fetch path
+        # commit-fencing state, driver-local (never serialized): last
+        # applied (fence, exec_index) per map. Fences are allocated by
+        # each executor's resolver, so they totally order attempts OF ONE
+        # EXECUTOR; cross-executor overwrites always apply (recovery and
+        # elastic rejoin depend on last-writer-wins across executors, and
+        # a cross-executor late commit is a complete committed output of
+        # the same deterministic map — not a torn location).
+        self._fences: dict = {}
         for m in range(num_maps):
             _MAP_ENTRY.pack_into(self._buf, m * MAP_ENTRY_SIZE, 0, UNPUBLISHED)
 
-    def publish(self, map_id: int, table_token: int, exec_index: int) -> None:
+    def publish(self, map_id: int, table_token: int, exec_index: int,
+                fence: int = 0) -> bool:
+        """Apply one entry write unless it is FENCED: a publish naming the
+        same executor as the applied entry but an older fence is a zombie
+        speculative attempt's late publish — rejected, returns False.
+        Equal fences re-apply (publishes are idempotent overwrites)."""
         if not 0 <= map_id < self.num_maps:
             raise IndexError(f"map_id {map_id} out of range [0, {self.num_maps})")
+        prev = self._fences.get(map_id)
+        if (prev is not None and exec_index == prev[1]
+                and fence < prev[0]):
+            return False
         was = self.entry(map_id) is not None
         _MAP_ENTRY.pack_into(self._buf, map_id * MAP_ENTRY_SIZE, table_token, exec_index)
+        self._fences[map_id] = (fence, exec_index)
         if not was and self.entry(map_id) is not None:
             self._published += 1
+        return True
 
     def write_raw(self, byte_offset: int, payload: bytes) -> None:
         """The one-sided-WRITE analogue: blind positional write into the table
-        (scala/RdmaShuffleManager.scala:384-418). Must be entry-aligned."""
+        (scala/RdmaShuffleManager.scala:384-418). Must be entry-aligned.
+        Bypasses commit fencing by construction (a one-sided write has no
+        CPU to compare epochs) — the control-plane publish path goes
+        through :meth:`publish` instead."""
         if byte_offset % MAP_ENTRY_SIZE or len(payload) % MAP_ENTRY_SIZE:
             raise ValueError("unaligned driver-table write")
         if byte_offset < 0 or byte_offset + len(payload) > len(self._buf):

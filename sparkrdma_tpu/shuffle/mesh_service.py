@@ -153,8 +153,12 @@ def _iter_committed_batches(managers, handle, delivered: Optional[set] = None):
         for m in mgr.resolver.map_ids(handle.shuffle_id):
             if m in seen:
                 continue
-            raw = mgr.resolver.local_blocks(handle.shuffle_id, m, 0,
-                                            handle.num_partitions)
+            from sparkrdma_tpu.utils.integrity import CorruptOutputError
+            try:
+                raw = mgr.resolver.local_blocks(handle.shuffle_id, m, 0,
+                                                handle.num_partitions)
+            except (CorruptOutputError, OSError):
+                raw = None  # corrupt/unreadable: same as disposed below
             if raw is None:
                 continue  # disposed between map_ids() and the read;
                 # another manager may still hold a copy — completeness is

@@ -18,10 +18,11 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Callable, Dict, List, Sequence, TypeVar
+from typing import Callable, Dict, List, Optional, Sequence, TypeVar
 
 from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
+from sparkrdma_tpu.shuffle.writer import WriteFailedError
 
 log = logging.getLogger(__name__)
 
@@ -38,17 +39,44 @@ def run_map_stage(executors: Sequence[TpuShuffleManager],
                   map_ids: Sequence[int] = (),
                   placement: Dict[int, int] = None) -> Dict[int, int]:
     """Run map tasks round-robin (or per ``placement``); returns the
-    executor index that ran each map."""
+    executor index that ran each map.
+
+    A :class:`WriteFailedError` — the attempt failed its DISK writes
+    cleanly (spill retries and fallback dirs exhausted, merge/commit
+    error, dead spill worker; every tmp/spill file already reaped) — is
+    the write-side twin of a lost peer: the map re-places on the next
+    live executor instead of failing the stage, up to one attempt per
+    live executor."""
     live = [i for i, ex in enumerate(executors)
             if ex.executor is not None and not ex.executor.server.stopped]
     ran: Dict[int, int] = {}
     ids = list(map_ids) if map_ids else list(range(handle.num_maps))
     for k, m in enumerate(ids):
-        slot = (placement or {}).get(m, live[k % len(live)])
-        writer = executors[slot].get_writer(handle, m)
-        map_fn(writer, m)
-        writer.close()
-        ran[m] = slot
+        first = (placement or {}).get(m, live[k % len(live)])
+        # candidate order: the planned slot, then every other live slot
+        candidates = [first] + [s for s in live if s != first]
+        last_err: Optional[WriteFailedError] = None
+        for slot in candidates:
+            writer = executors[slot].get_writer(handle, m)
+            try:
+                map_fn(writer, m)
+                writer.close()
+                ran[m] = slot
+                last_err = None
+                break
+            except WriteFailedError as e:
+                last_err = e
+                log.warning("map %d write attempt failed on executor slot "
+                            "%d (%s); re-placing", m, slot, e)
+                if not getattr(writer, "closed", True):
+                    # the failure came from write_batch: abort the
+                    # attempt so nothing of it survives on disk
+                    try:
+                        writer.close(success=False)
+                    except Exception:  # noqa: BLE001 — abort best-effort
+                        pass
+        if last_err is not None:
+            raise last_err
     return ran
 
 
@@ -114,33 +142,53 @@ def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
             attempt += 1
             if attempt > max_stage_retries:
                 raise
-            # every map currently owned by the failed slot must be
-            # recomputed, not just the one that tripped the fetch
             dead_slot = e.exec_index
-            _tombstone_slot(driver, dead_slot)
+            corrupt = getattr(e, "verdict", "peer_lost") == "corrupt_output"
             table = executors[reducer_index].executor.get_driver_table(
                 handle.shuffle_id, 0, timeout=5)
-            lost_maps: List[int] = []
-            for m in range(handle.num_maps):
-                entry = table.entry(m)
-                if entry is None or entry[1] == dead_slot:
-                    lost_maps.append(m)
-            if not lost_maps and e.map_id >= 0:
-                lost_maps = [e.map_id]
-            log.warning("stage retry %d: recomputing maps %s lost with "
-                        "executor slot %d", attempt, lost_maps, dead_slot)
+            if corrupt and e.map_id >= 0:
+                # the owner is ALIVE — its committed output for THIS map
+                # failed at-rest verification (and is quarantined on the
+                # owner). Re-execute just that map; never tombstone a
+                # live peer over bit-rot, and don't recompute its healthy
+                # outputs
+                lost_maps: List[int] = [e.map_id]
+                log.warning("stage retry %d: re-executing map %d of "
+                            "shuffle %d (committed output corrupt on "
+                            "slot %d)", attempt, e.map_id,
+                            handle.shuffle_id, dead_slot)
+            else:
+                # every map currently owned by the failed slot must be
+                # recomputed, not just the one that tripped the fetch
+                _tombstone_slot(driver, dead_slot)
+                lost_maps = []
+                for m in range(handle.num_maps):
+                    entry = table.entry(m)
+                    if entry is None or entry[1] == dead_slot:
+                        lost_maps.append(m)
+                if not lost_maps and e.map_id >= 0:
+                    lost_maps = [e.map_id]
+                log.warning("stage retry %d: recomputing maps %s lost with "
+                            "executor slot %d", attempt, lost_maps,
+                            dead_slot)
+            # the entries being replaced, so the repair-visibility poll
+            # below can tell an overwrite from the stale original even
+            # when the new owner is the SAME slot (corrupt verdict)
+            old_entries = {m: table.entry(m) for m in lost_maps}
             # survivors = executors whose endpoint slot is not the dead
             # one AND whose server is still up: with TWO dead executors,
             # the first repair must not place recomputes on the second
             # (its resolver would happily write, its publishes would
             # advertise an unreachable owner, and the reduce would burn a
-            # whole extra stage retry discovering it)
+            # whole extra stage retry discovering it). For a corrupt
+            # verdict the blamed slot is alive and eligible — a
+            # re-execution there replaces the quarantined file in place.
             survivors = []
             for i, ex in enumerate(executors):
                 if ex.executor is None or ex.executor.server.stopped:
                     continue
                 try:
-                    if ex.executor.exec_index(timeout=1) != dead_slot:
+                    if corrupt or ex.executor.exec_index(timeout=1) != dead_slot:
                         survivors.append(i)
                 except KeyError:
                     continue
@@ -161,9 +209,16 @@ def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
             while time.monotonic() < deadline:
                 ep.invalidate_shuffle(handle.shuffle_id)
                 table = ep.get_driver_table(handle.shuffle_id, 0, timeout=5)
-                entries = [table.entry(m) for m in lost_maps]
-                if all(e is not None and e[1] != dead_slot
-                       for e in entries):
+                entries = {m: table.entry(m) for m in lost_maps}
+                if corrupt:
+                    # the re-execution may land on the SAME slot (new
+                    # token, new fence): visible = the entry CHANGED
+                    done = all(ent is not None and ent != old_entries[m]
+                               for m, ent in entries.items())
+                else:
+                    done = all(ent is not None and ent[1] != dead_slot
+                               for ent in entries.values())
+                if done:
                     break
                 time.sleep(0.005)
             else:

@@ -8,6 +8,32 @@ intercepts ``writeIndexFileAndCommit``, scala/RdmaShuffleBlockResolver.scala:
 the in-memory state from those files after an executor restart, enabling
 elastic rejoin without recomputing committed maps.
 
+Hardened storage semantics (the serving path is one-sided — no server CPU
+notices a bad block, PAPER §0 — so integrity and fencing live in the data
+and the commit protocol itself):
+
+* **Commit fencing**: every writer attempt holds a fencing token
+  (:meth:`begin_attempt`); commit is a compare-and-swap on it. A zombie
+  speculative attempt that commits after a newer attempt gets
+  :class:`StaleAttemptError` (its tmp reaped) instead of clobbering the
+  winner's committed file, and its publish is rejected at the driver
+  (``DriverTable.publish`` fence check).
+* **At-rest integrity** (``at_rest_checksum``): commit writes a CRC32
+  sidecar (``<data>.crc``, per-partition + whole-file CRCs + the fence;
+  ``utils/integrity.py``) BEFORE the index, so index-present implies
+  sidecar-present across every crash window. ``recover()`` verifies the
+  whole file on mmap-open; serve time spot-checks each partition on its
+  first Python-path read, or the whole file on first location serve when
+  a native block server carries the data bytes (the only Python
+  touchpoint on that dataplane). A corrupt output is QUARANTINED —
+  unregistered from the native server, every later serve raising
+  :class:`~sparkrdma_tpu.utils.integrity.CorruptOutputError`, demoted on
+  the wire to the retryable ``STATUS_CORRUPT`` — and heals only by map
+  re-execution (shuffle/recovery.py).
+* **Spill-dir health**: the writer's fallback-directory selection and
+  quarantine bookkeeping (``spill_dirs``/``spill_dir_max_failures``)
+  live here, shared by every writer of the executor.
+
 Re-design of ``scala/RdmaShuffleBlockResolver.scala`` + the data-ownership
 half of ``writer/wrapper/RdmaWrapperShuffleWriter.scala`` (its
 ``RdmaWrapperShuffleData`` owns ``mapId -> RdmaMappedFile``, :36):
@@ -16,10 +42,7 @@ half of ``writer/wrapper/RdmaWrapperShuffleWriter.scala`` (its
   for serving (rename-commit, RdmaWrapperShuffleWriter.scala:58-63;
   mapping + location-table fill, RdmaMappedFile.java:95-157),
 * remote peers read locations and bytes through the ``ShuffleDataSource``
-  protocol the control plane serves
-  (scala/RdmaShuffleBlockResolver.scala:73-78 serves local partitions;
-  remote reads bypass the resolver in the reference because the NIC serves
-  them — here the executor endpoint calls back into the resolver),
+  protocol the control plane serves,
 * ``remove_shuffle`` disposes mappings and deletes files
   (scala/RdmaShuffleBlockResolver.scala:45-53).
 
@@ -30,92 +53,393 @@ the role the registered MR's rkey plays in the reference.
 from __future__ import annotations
 
 import itertools
+import logging
 import os
 import threading
-from typing import Dict, Iterable, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import faults as fault_mod
 from sparkrdma_tpu.runtime.staging import SpillFile
 from sparkrdma_tpu.shuffle.map_output import MapTaskOutput
+from sparkrdma_tpu.utils import integrity
+
+log = logging.getLogger(__name__)
+
+CorruptOutputError = integrity.CorruptOutputError
+
+
+class StaleAttemptError(RuntimeError):
+    """A commit lost the fencing compare-and-swap: a NEWER attempt of the
+    same map already committed. The loser's tmp file is reaped before
+    this is raised; the caller (writer.close) reaps its spills and must
+    NOT publish."""
+
+    def __init__(self, shuffle_id: int, map_id: int, fence: int,
+                 committed_fence: int):
+        super().__init__(
+            f"shuffle {shuffle_id} map {map_id}: attempt fence {fence} is "
+            f"stale (fence {committed_fence} already committed)")
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.fence = fence
+        self.committed_fence = committed_fence
+
+
+class _SpillIntegrity:
+    """Serve-time verification state of one committed spill."""
+
+    __slots__ = ("part_crcs", "part_verified", "full_verified", "corrupt",
+                 "lock")
+
+    def __init__(self, part_crcs: Optional[List[int]], num_partitions: int,
+                 full_verified: bool):
+        self.part_crcs = part_crcs  # None = unattested (no sidecar data)
+        self.part_verified = bytearray(num_partitions)
+        self.full_verified = full_verified
+        self.corrupt = False
+        self.lock = threading.Lock()
 
 
 class TpuShuffleBlockResolver:
     """shuffle_id -> map_id -> committed SpillFile; implements
     ShuffleDataSource for the executor's control server."""
 
-    def __init__(self, spill_dir: str, block_server=None):
+    def __init__(self, spill_dir: str, block_server=None,
+                 conf: Optional[TpuShuffleConf] = None):
+        self.conf = conf or TpuShuffleConf()
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
         self._shuffles: Dict[int, Dict[int, SpillFile]] = {}
         self._by_token: Dict[int, SpillFile] = {}
         self._lock = threading.Lock()
         self._tokens = itertools.count(1)
-        self._attempts = itertools.count(1)
+        # attempt/fence allocator: a plain guarded int (not
+        # itertools.count) because recover() must be able to BUMP it past
+        # fences recovered from sidecars — a restarted executor whose
+        # counter restarted at 1 would otherwise lose the commit CAS to
+        # its own pre-crash commits (every re-execution of a recovered
+        # map would raise StaleAttemptError forever)
+        self._attempt_lock = threading.Lock()
+        self._next_attempt = 1
         self._commit_lock = threading.Lock()  # serializes the on-disk
-        # unlink-index/rename-data/write-index sequence: concurrent attempts
-        # of one map must not interleave into a mismatched durable pair
+        # unlink-index/rename-data/write-sidecar/write-index sequence AND
+        # the fence CAS: concurrent attempts of one map must not
+        # interleave into a mismatched durable set
+        self._map_fences: Dict[Tuple[int, int], int] = {}
+        self._integrity: Dict[int, _SpillIntegrity] = {}
+        self.at_rest_checksum = bool(self.conf.at_rest_checksum)
+        # spill-dir health, shared by every writer of this executor:
+        # consecutive-failure counts; a dir past spill_dir_max_failures
+        # is quarantined for the resolver's lifetime. Each configured
+        # fallback is NAMESPACED by a digest of the primary spill dir:
+        # co-hosted executors share one spill_dirs conf value, and an
+        # un-namespaced sweep (recover/remove_shuffle — spill names carry
+        # no executor identity) would delete a live sibling's in-flight
+        # spill files. A restarted executor adopting the same primary dir
+        # maps to the same namespace, so ITS orphans still get swept.
+        import hashlib
+        ns = "spill-" + hashlib.sha1(
+            os.path.abspath(spill_dir).encode()).hexdigest()[:12]
+        self.fallback_spill_dirs: List[str] = []
+        for d in self.conf.resolved_spill_dirs():
+            d = os.path.join(d, ns)
+            try:
+                os.makedirs(d, exist_ok=True)
+                self.fallback_spill_dirs.append(d)
+            except OSError as e:
+                log.warning("fallback spill dir %s unusable at startup: %s",
+                            d, e)
+        self._dir_lock = threading.Lock()
+        self._dir_failures: Dict[str, int] = {}
+        self._dir_quarantined: set = set()
+        # failure-path audit counters
+        self.fenced_commits = 0
+        self.corrupt_outputs = 0
         # native epoll server (runtime/blockserver.py): committed files are
         # registered there so peers fetch bytes without Python in the path
         self.block_server = block_server
 
     # -- write side ------------------------------------------------------
 
-    def data_tmp_path(self, shuffle_id: int, map_id: int) -> str:
+    def begin_attempt(self, shuffle_id: int, map_id: int) -> int:
+        """Allocate this attempt's fencing token. Monotone per resolver —
+        across restarts too (recover() bumps the allocator past every
+        fence it reads back from a sidecar) — so attempts of one map ON
+        THIS EXECUTOR are totally ordered; the commit CAS and the
+        driver's publish fence compare within that order (cross-executor
+        overwrites always apply — recovery depends on last-writer-wins
+        across executors)."""
+        with self._attempt_lock:
+            a = self._next_attempt
+            self._next_attempt += 1
+            return a
+
+    def _bump_attempts(self, floor: int) -> None:
+        """Never hand out an attempt/fence at or below ``floor``."""
+        with self._attempt_lock:
+            self._next_attempt = max(self._next_attempt, floor + 1)
+
+    def data_tmp_path(self, shuffle_id: int, map_id: int,
+                      fence: Optional[int] = None) -> str:
         # attempt-unique: concurrent speculative attempts of one map task
         # must not interleave writes in a shared tmp file. The streaming
         # writer derives its spill-file names from this path
         # (``<tmp>.s<seq>.tmp``) — everything an uncommitted attempt puts
         # on disk ends in ``.tmp``, so recover() and remove_shuffle() can
         # reap orphans without knowing the writer's internals.
-        attempt = next(self._attempts)
+        attempt = (fence if fence is not None
+                   else self.begin_attempt(shuffle_id, map_id))
         return os.path.join(self.spill_dir,
                             f"shuffle_{shuffle_id}_{map_id}.{attempt}.tmp")
 
+    # -- spill-dir health (consulted by writers) -------------------------
+
+    def spill_dir_candidates(self) -> List[str]:
+        """Healthy spill directories in preference order (primary first).
+        Empty only when EVERY directory is quarantined — the writer then
+        fails its attempt cleanly instead of spinning."""
+        with self._dir_lock:
+            return [d for d in [self.spill_dir] + self.fallback_spill_dirs
+                    if d not in self._dir_quarantined]
+
+    def record_spill_dir_failure(self, d: str) -> bool:
+        """Count one failure against ``d``; returns True when this crossed
+        ``spill_dir_max_failures`` and quarantined it."""
+        with self._dir_lock:
+            n = self._dir_failures.get(d, 0) + 1
+            self._dir_failures[d] = n
+            if (n >= self.conf.spill_dir_max_failures
+                    and d not in self._dir_quarantined):
+                self._dir_quarantined.add(d)
+                log.warning("spill dir %s quarantined after %d consecutive "
+                            "failures", d, n)
+                return True
+        return False
+
+    def record_spill_dir_success(self, d: str) -> None:
+        with self._dir_lock:
+            self._dir_failures.pop(d, None)
+
+    def spill_dir_health(self) -> dict:
+        with self._dir_lock:
+            return {"failures": dict(self._dir_failures),
+                    "quarantined": sorted(self._dir_quarantined)}
+
+    # -- commit ----------------------------------------------------------
+
+    def committed_fence(self, shuffle_id: int, map_id: int) -> int:
+        with self._commit_lock:
+            return self._map_fences.get((shuffle_id, map_id), 0)
+
     def commit(self, shuffle_id: int, map_id: int, tmp_path: str,
-               partition_lengths: Iterable[int]) -> Tuple[SpillFile, int]:
-        """Rename-commit + map for serving. Returns (spill, file_token)."""
+               partition_lengths: Iterable[int],
+               fence: Optional[int] = None,
+               partition_crcs: Optional[List[int]] = None
+               ) -> Tuple[SpillFile, int]:
+        """Rename-commit + map for serving. Returns (spill, file_token).
+
+        ``fence`` arms the commit CAS: a stale attempt (an OLDER fence
+        than the committed one for this map) raises
+        :class:`StaleAttemptError` with its tmp reaped — it can neither
+        clobber the winner's data file nor reach publication. ``None``
+        skips the CAS (fence-less callers, kept for compatibility).
+
+        Durable ordering, including RE-commits of the same map: drop the
+        old index (and sidecar), rename the data, write the sidecar, then
+        atomically publish the new index. Every crash window leaves data
+        WITHOUT an index, which recover() treats as lost (recompute) —
+        never a mismatched set.
+        """
         final = os.path.join(self.spill_dir,
                              f"shuffle_{shuffle_id}_{map_id}.data")
         lengths_arr = np.asarray(list(partition_lengths), dtype=np.uint64)
-        # Crash-safe ordering, including RE-commits of the same map: drop
-        # the old index, rename the data, then atomically publish the new
-        # index. Every crash window leaves data WITHOUT an index, which
-        # recover() treats as lost (recompute) — never a mismatched pair.
-        # The lock keeps concurrent attempts of one map from interleaving
-        # the three steps (which could durably pair A's index with B's data).
+        if self.at_rest_checksum and partition_crcs is None:
+            # callers that didn't stream CRCs during their writes (the
+            # monolithic baseline) pay one read of the tmp here
+            partition_crcs = integrity.partition_crcs_of_file(
+                tmp_path, lengths_arr.tolist())
         index = final + ".index"
+        sidecar = integrity.sidecar_path(final)
         with self._commit_lock:
+            if fence is not None:
+                committed = self._map_fences.get((shuffle_id, map_id), 0)
+                if fence <= committed:
+                    self.fenced_commits += 1
+                    self._reap_quietly(tmp_path)
+                    raise StaleAttemptError(shuffle_id, map_id, fence,
+                                            committed)
+            fault_mod.storage_check("commit", final)
             if os.path.exists(index):
                 os.unlink(index)
+            if os.path.exists(sidecar):
+                os.unlink(sidecar)
             os.replace(tmp_path, final)
-            lengths_arr.tofile(index + ".tmp")
-            os.replace(index + ".tmp", index)
+            try:
+                if self.at_rest_checksum:
+                    fault_mod.storage_check("index_write", sidecar)
+                    integrity.write_sidecar(final, fence or 0,
+                                            partition_crcs,
+                                            lengths_arr.tolist())
+                fault_mod.storage_check("index_write", index)
+                lengths_arr.tofile(index + ".tmp")
+                os.replace(index + ".tmp", index)
+            except BaseException:
+                # UN-commit: the rename already consumed the tmp, so a
+                # failed sidecar/index write would otherwise orphan a
+                # full-size index-less .data no sweep ever reaps (the
+                # writer's cleanup only knows .tmp names). Either the
+                # commit returns registered, or this attempt leaves
+                # nothing on disk.
+                for p in (final, sidecar, sidecar + ".tmp",
+                          index, index + ".tmp"):
+                    self._reap_quietly(p)
+                raise
+            if fence is not None:
+                self._map_fences[(shuffle_id, map_id)] = fence
         token = next(self._tokens)
-        spill = SpillFile(final, lengths_arr.tolist(), file_token=token)
-        if self.block_server is not None:
-            self.block_server.register_file(token, final)
+        try:
+            fault_mod.storage_check("mmap_open", final)
+            spill = SpillFile(final, lengths_arr.tolist(), file_token=token)
+            if self.block_server is not None:
+                self.block_server.register_file(token, final)
+        except BaseException:
+            # same invariant past the durable writes: a commit that can't
+            # be mapped/served is no commit — a durable triplet that never
+            # registers would leak (remove_shuffle only reaps registered
+            # spills), and the re-execution replaces it anyway
+            for p in (final, sidecar, index):
+                self._reap_quietly(p)
+            with self._commit_lock:
+                if (fence is not None and
+                        self._map_fences.get((shuffle_id, map_id)) == fence):
+                    del self._map_fences[(shuffle_id, map_id)]
+            raise
         with self._lock:
             # speculative/retried map task: replace and dispose the old
             # mapping (its file was already clobbered by the rename)
             old = self._shuffles.setdefault(shuffle_id, {}).get(map_id)
             self._shuffles[shuffle_id][map_id] = spill
             self._by_token[token] = spill
+            self._integrity[token] = _SpillIntegrity(
+                partition_crcs if self.at_rest_checksum else None,
+                len(lengths_arr),
+                # just written and attested by the commit itself; serve
+                # spot-checks re-verify only what could have rotted since
+                full_verified=not self.at_rest_checksum)
             if old is not None:
                 self._by_token.pop(old.file_token, None)
+                self._integrity.pop(old.file_token, None)
         if old is not None:
             if self.block_server is not None:
                 self.block_server.unregister_file(old.file_token)
             old._delete = False  # the path now belongs to the new spill
             old.dispose()
+        # at-rest corruption chaos hook: bit-rot of the COMMITTED bytes,
+        # after the (clean) sidecar landed — exactly what verification
+        # exists to catch
+        fault_mod.storage_corrupt("commit", final)
         return spill, token
+
+    def _reap_quietly(self, path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    # -- at-rest verification --------------------------------------------
+
+    def _integrity_of(self, spill: SpillFile) -> Optional[_SpillIntegrity]:
+        with self._lock:
+            return self._integrity.get(spill.file_token)
+
+    def _quarantine(self, spill: SpillFile, integ: _SpillIntegrity,
+                    detail: str) -> None:
+        """Demote a corrupt committed output: the native server stops
+        serving its raw bytes, every later serve answers CORRUPT fast,
+        and only a re-execution (re-commit) replaces it."""
+        integ.corrupt = True
+        self.corrupt_outputs += 1
+        log.error("at-rest corruption in %s: %s (quarantined; the map "
+                  "will be re-executed)", spill.path, detail)
+        if self.block_server is not None:
+            self.block_server.unregister_file(spill.file_token)
+
+    def _verify_file(self, spill: SpillFile, integ: _SpillIntegrity) -> None:
+        """Whole-file CRC check (one streamed read), once."""
+        with integ.lock:
+            if integ.corrupt:
+                raise CorruptOutputError(spill.path, "previously quarantined")
+            if integ.full_verified or integ.part_crcs is None:
+                return
+            expected = integrity.combine_parts(
+                integ.part_crcs, spill.partition_lengths.tolist())
+            actual = integrity.file_crc32(spill.path)
+            if actual != expected:
+                self._quarantine(spill, integ,
+                                 f"file CRC {actual:#x} != committed "
+                                 f"{expected:#x}")
+                raise CorruptOutputError(
+                    spill.path, "whole-file CRC mismatch at serve time")
+            integ.full_verified = True
+            for p in range(len(integ.part_verified)):
+                integ.part_verified[p] = 1
+
+    def _spot_check_range(self, spill: SpillFile, integ: _SpillIntegrity,
+                          offset: int, length: int) -> None:
+        """Verify (once) each partition a served byte range touches.
+        Serving reads the partition's bytes anyway; the first serve pays
+        one CRC pass over the partitions it covers."""
+        if integ.part_crcs is None:
+            return
+        with integ.lock:
+            if integ.corrupt:
+                raise CorruptOutputError(spill.path, "previously quarantined")
+            if integ.full_verified or length == 0:
+                return
+            offs = spill.partition_offsets
+            lens = spill.partition_lengths
+            first = int(np.searchsorted(offs, offset, side="right")) - 1
+            first = max(0, first)
+            end = offset + length
+            import zlib
+            for p in range(first, len(offs)):
+                if int(offs[p]) >= end:
+                    break
+                if integ.part_verified[p] or int(lens[p]) == 0:
+                    continue
+                buf = np.empty(int(lens[p]), dtype=np.uint8)
+                spill.gather([int(offs[p])], [int(lens[p])], buf)
+                if zlib.crc32(memoryview(buf)) != integ.part_crcs[p]:
+                    self._quarantine(
+                        spill, integ,
+                        f"partition {p} CRC mismatch on first serve")
+                    raise CorruptOutputError(
+                        spill.path, f"partition {p} failed its at-rest "
+                        f"CRC spot check")
+                integ.part_verified[p] = 1
 
     # -- ShuffleDataSource (served to remote peers) ----------------------
 
     def get_output_table(self, shuffle_id: int, map_id: int) -> Optional[MapTaskOutput]:
         with self._lock:
             spill = self._shuffles.get(shuffle_id, {}).get(map_id)
-        return spill.map_output if spill is not None else None
+        if spill is None:
+            return None
+        integ = self._integrity_of(spill)
+        if integ is not None:
+            if integ.corrupt:
+                raise CorruptOutputError(spill.path,
+                                         "previously quarantined")
+            if self.block_server is not None and not integ.full_verified:
+                # the native server serves the data bytes with no CPU in
+                # the loop: this location serve is the ONLY Python
+                # touchpoint on that dataplane, so the whole-file check
+                # happens here (first serve of each output)
+                self._verify_file(spill, integ)
+        return spill.map_output
 
     def read_block(self, shuffle_id: int, buf_token: int, offset: int,
                    length: int) -> Optional[bytes]:
@@ -123,6 +447,10 @@ class TpuShuffleBlockResolver:
             spill = self._by_token.get(buf_token)
         if spill is None or offset + length > spill.size or offset < 0:
             return None
+        fault_mod.storage_check("serve_read", spill.path)
+        integ = self._integrity_of(spill)
+        if integ is not None:
+            self._spot_check_range(spill, integ, offset, length)
         if length == 0:
             return b""
         out = np.empty(length, dtype=np.uint8)
@@ -139,8 +467,13 @@ class TpuShuffleBlockResolver:
             spill = self._shuffles.get(shuffle_id, {}).get(map_id)
         if spill is None:
             return None
+        fault_mod.storage_check("serve_read", spill.path)
         offs = spill.partition_offsets[start_partition:end_partition]
         lens = spill.partition_lengths[start_partition:end_partition]
+        integ = self._integrity_of(spill)
+        if integ is not None and len(offs):
+            self._spot_check_range(spill, integ, int(offs[0]),
+                                   int(lens.sum()))
         out = np.empty(int(lens.sum()), dtype=np.uint8)
         spill.gather(offs, lens, out)
         return out.tobytes()
@@ -151,32 +484,42 @@ class TpuShuffleBlockResolver:
 
     # -- lifecycle -------------------------------------------------------
 
+    def _sweep_tmps(self, shuffle_prefix: Optional[str] = None) -> None:
+        """Delete orphan ``.tmp`` attempt files (writer data tmps and
+        ``.s<seq>.tmp`` spill files) in the primary AND every fallback
+        spill dir, optionally scoped to one shuffle's prefix."""
+        for d in [self.spill_dir] + self.fallback_spill_dirs:
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if not name.endswith(".tmp"):
+                    continue
+                if shuffle_prefix is not None \
+                        and not name.startswith(shuffle_prefix):
+                    continue
+                self._reap_quietly(os.path.join(d, name))
+
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
             spills = self._shuffles.pop(shuffle_id, {})
             for spill in spills.values():
                 self._by_token.pop(spill.file_token, None)
+                self._integrity.pop(spill.file_token, None)
         for spill in spills.values():
             if self.block_server is not None:
                 self.block_server.unregister_file(spill.file_token)
             index = spill.path + ".index"
+            sidecar = integrity.sidecar_path(spill.path)
             spill.dispose()
             if os.path.exists(index):
                 os.unlink(index)
+            if os.path.exists(sidecar):
+                os.unlink(sidecar)
         # reap this shuffle's uncommitted attempts (writer tmp + spill
-        # files from crashed/aborted tasks) — previously these lingered
-        # until a restart's recover() swept the whole dir
-        prefix = f"shuffle_{shuffle_id}_"
-        try:
-            names = os.listdir(self.spill_dir)
-        except OSError:
-            return
-        for name in names:
-            if name.startswith(prefix) and name.endswith(".tmp"):
-                try:
-                    os.unlink(os.path.join(self.spill_dir, name))
-                except OSError:
-                    pass
+        # files from crashed/aborted tasks) — in every spill dir
+        self._sweep_tmps(f"shuffle_{shuffle_id}_")
 
     def recover(self) -> Dict[int, list]:
         """Rebuild state from committed (data, index) pairs on disk.
@@ -184,18 +527,17 @@ class TpuShuffleBlockResolver:
         Returns {shuffle_id: [(map_id, file_token), ...]} of recovered
         outputs so the caller can re-publish them (elastic rejoin: the
         restarted executor gets a fresh slot, re-publishes, and reducers
-        route to it). Orphaned ``.tmp`` spill attempts from the crashed
-        process are deleted.
-        """
+        route to it); the fence each output committed with is readable
+        via :meth:`committed_fence`. Orphaned ``.tmp`` spill attempts
+        from the crashed process are deleted — fallback spill dirs
+        included — and, with ``at_rest_checksum`` on, every recovered
+        file is verified against its CRC sidecar on mmap-open: corrupt
+        (or sidecar-less, hence unattested) files are treated as lost so
+        the map recomputes instead of serving rot."""
         import re as _re
         recovered: Dict[int, list] = {}
+        self._sweep_tmps()
         for name in sorted(os.listdir(self.spill_dir)):
-            if name.endswith(".tmp"):
-                try:
-                    os.unlink(os.path.join(self.spill_dir, name))
-                except OSError:
-                    pass
-                continue
             m = _re.fullmatch(r"shuffle_(\d+)_(\d+)\.data", name)
             if not m:
                 continue
@@ -203,22 +545,96 @@ class TpuShuffleBlockResolver:
             index_path = data_path + ".index"
             if not os.path.exists(index_path):
                 continue  # never fully committed
+            shuffle_id, map_id = int(m.group(1)), int(m.group(2))
             lengths = np.fromfile(index_path, dtype=np.uint64)
             if len(lengths) == 0:
                 continue
+            fence = 0
+            part_crcs: Optional[List[int]] = None
+            if self.at_rest_checksum:
+                sidecar = integrity.read_sidecar(data_path)
+                if sidecar is None:
+                    # committed without attestation (checksum was off, or
+                    # a pre-sidecar build): a restart cannot tell rot
+                    # from truth — recompute rather than serve blind, and
+                    # REAP the pair (it will never be registered, so no
+                    # later sweep would; leaving it leaks a full-size
+                    # file and re-logs this on every restart)
+                    log.warning("recover: %s has no CRC sidecar; treating "
+                                "as lost", name)
+                    for p in (data_path, index_path):
+                        self._reap_quietly(p)
+                    continue
+                fence, part_crcs, file_crc = sidecar
+                try:
+                    fault_mod.storage_check("mmap_open", data_path)
+                    actual = integrity.file_crc32(data_path)
+                except OSError as e:
+                    log.warning("recover: %s unreadable (%s); treating as "
+                                "lost", name, e)
+                    continue
+                if actual != file_crc:
+                    self.corrupt_outputs += 1
+                    log.error("recover: %s failed its at-rest CRC "
+                              "(%#x != committed %#x); dropping so the "
+                              "map recomputes", name, actual, file_crc)
+                    for p in (data_path, index_path,
+                              integrity.sidecar_path(data_path)):
+                        self._reap_quietly(p)
+                    self._bump_attempts(fence)
+                    continue
             try:
-                shuffle_id, map_id = int(m.group(1)), int(m.group(2))
                 token = next(self._tokens)
+                fault_mod.storage_check("mmap_open", data_path)
                 spill = SpillFile(data_path, lengths.tolist(),
                                   file_token=token)
-            except ValueError:
+            except (ValueError, OSError):
                 continue  # truncated data file: treat as lost
             if self.block_server is not None:
-                self.block_server.register_file(token, data_path)
+                try:
+                    self.block_server.register_file(token, data_path)
+                except OSError as e:
+                    # one unmappable file must cost ONE output (treated
+                    # as lost → recompute), not abort recovery of every
+                    # other committed output
+                    log.warning("recover: %s unservable by the native "
+                                "block server (%s); treating as lost",
+                                name, e)
+                    spill._delete = False
+                    spill.dispose()
+                    continue
             with self._lock:
                 self._shuffles.setdefault(shuffle_id, {})[map_id] = spill
                 self._by_token[token] = spill
+                # the mmap-open verify above attested the file for
+                # REGISTRATION, but must not exempt it from serve-time
+                # spot checks: rot landing between recover and first
+                # serve would otherwise be served silently (the fetch
+                # CRC trailer is computed over the rotted bytes) — so
+                # first serves re-verify, exactly like a fresh commit
+                self._integrity[token] = _SpillIntegrity(
+                    part_crcs, len(lengths),
+                    full_verified=not self.at_rest_checksum)
+            with self._commit_lock:
+                prev = self._map_fences.get((shuffle_id, map_id), 0)
+                self._map_fences[(shuffle_id, map_id)] = max(prev, fence)
+            # the allocator restarted at 1 with this process: new attempts
+            # of a recovered map must out-fence its pre-crash commit, or
+            # every re-execution (corrupt-output healing included) would
+            # lose the CAS to a dead process forever
+            self._bump_attempts(fence)
             recovered.setdefault(shuffle_id, []).append((map_id, token))
+        # orphan sidecars (data reaped or never committed) confuse nothing
+        # but waste space; sweep them (sidecars live only in the primary
+        # dir — they are written next to the committed data file)
+        try:
+            names = os.listdir(self.spill_dir)
+        except OSError:
+            names = []
+        for name in names:
+            if name.endswith(".data.crc") and not os.path.exists(
+                    os.path.join(self.spill_dir, name[:-len(".crc")])):
+                self._reap_quietly(os.path.join(self.spill_dir, name))
         return recovered
 
     def stop(self) -> None:

@@ -43,21 +43,54 @@ monolithic path's single global combine.
 from __future__ import annotations
 
 import ctypes
+import errno
+import logging
 import os
 import queue
 import threading
 import time
+import zlib
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
 from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import faults as fault_mod
+from sparkrdma_tpu.parallel.transport import Backoff
 from sparkrdma_tpu.runtime import native
-from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
+from sparkrdma_tpu.shuffle.resolver import (
+    StaleAttemptError,
+    TpuShuffleBlockResolver,
+)
+from sparkrdma_tpu.utils import integrity
 from sparkrdma_tpu.utils.stats import WriteMetrics
 from sparkrdma_tpu.utils import trace as trace_mod
 
+log = logging.getLogger(__name__)
+
 Partitioner = Callable[[np.ndarray], np.ndarray]  # keys -> dest partition ids
+
+
+class WriteFailedError(RuntimeError):
+    """This map attempt could not write its output (disk errors past the
+    spill retry budget, a failed merge/commit, a dead spill worker). The
+    attempt is CLEANLY failed — every tmp and spill file reaped — so the
+    map stage can re-place the task on another executor
+    (``shuffle/recovery.py run_map_stage``), mirroring how a lost peer's
+    maps recompute."""
+
+
+# Disk errors a spill retry (possibly into a fallback dir) can heal;
+# everything else (EACCES, EROFS, ENOENT on the dir, ...) re-fails
+# identically and fails the attempt immediately.
+_TRANSIENT_DISK_ERRNOS = frozenset(
+    e for e in (errno.EIO, errno.ENOSPC, errno.EAGAIN, errno.EINTR,
+                errno.ENOBUFS, getattr(errno, "EDQUOT", None))
+    if e is not None)
+
+
+def _transient_disk_error(e: BaseException) -> bool:
+    return isinstance(e, OSError) and e.errno in _TRANSIENT_DISK_ERRNOS
 
 
 def _rows_keys(rows: np.ndarray) -> np.ndarray:
@@ -97,13 +130,19 @@ class _Run:
 
 
 class _Spill:
-    """One completed spill file: partition-contiguous, lengths recorded."""
+    """One completed spill file: partition-contiguous, lengths recorded.
+    ``part_crcs`` (when at-rest checksums are on) carries each
+    partition segment's CRC32, computed while the bytes streamed to
+    disk, so the merge can CRC sendfile'd segments without reading them
+    back (``integrity.crc32_combine``)."""
 
-    __slots__ = ("path", "part_lengths", "part_offsets")
+    __slots__ = ("path", "part_lengths", "part_offsets", "part_crcs")
 
-    def __init__(self, path: str, part_lengths: np.ndarray):
+    def __init__(self, path: str, part_lengths: np.ndarray,
+                 part_crcs: Optional[List[int]] = None):
         self.path = path
         self.part_lengths = part_lengths  # bytes per partition, i64[P]
+        self.part_crcs = part_crcs
         offs = np.zeros(len(part_lengths), dtype=np.int64)
         if len(part_lengths) > 1:
             np.cumsum(part_lengths[:-1], out=offs[1:])
@@ -174,6 +213,15 @@ class TpuShuffleWriter:
                             and native.has_writer_scatter())
         self.metrics.native_scatter = self._use_native
         self._scatter_threads = max(1, min(4, os.cpu_count() or 1))
+        # fencing token: totally orders this executor's attempts of one
+        # map; commit is a CAS on it (resolver), publish carries it so a
+        # zombie speculative attempt can't clobber the winner's location
+        self.fence = self.resolver.begin_attempt(shuffle_id, map_id)
+        # at-rest integrity: CRCs stream with the writes (spill + merge)
+        # so the commit-time sidecar costs no extra read of the data
+        self._crc_enabled = bool(getattr(self.resolver, "at_rest_checksum",
+                                         self.conf.at_rest_checksum))
+        self._spill_backoff = Backoff.from_conf(self.conf)
 
         self._runs: List[_Run] = []  # unspilled, arrival order
         self._buffered = 0  # bytes accumulated in self._runs
@@ -186,6 +234,10 @@ class TpuShuffleWriter:
         self._spill_queue: Optional[queue.Queue] = None
         self._spill_workers: List[threading.Thread] = []
         self._aborted = False
+        # every spill path this attempt ever opened (retries may scatter
+        # them across fallback dirs): the abort/cleanup sweep reaps them
+        # all, so a failed attempt leaks nothing anywhere
+        self._spill_paths: set = set()
         # one tmp namespace per writer: the final data tmp plus numbered
         # spill files derive from it (attempt-unique via the resolver, so
         # speculative attempts of one map never share spill files); the
@@ -196,16 +248,39 @@ class TpuShuffleWriter:
     def row_bytes(self) -> int:
         return 8 + self.row_payload_bytes
 
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
     # -- streaming write side -------------------------------------------
 
     def _tmp_base(self) -> str:
         if self._tmp_path is None:
-            self._tmp_path = self.resolver.data_tmp_path(self.shuffle_id,
-                                                         self.map_id)
+            self._tmp_path = self.resolver.data_tmp_path(
+                self.shuffle_id, self.map_id, fence=self.fence)
         return self._tmp_path
 
-    def _spill_path(self, seq: int) -> str:
-        return f"{self._tmp_base()}.s{seq}.tmp"
+    def _spill_path(self, seq: int, spill_dir: Optional[str] = None) -> str:
+        name = f"{os.path.basename(self._tmp_base())}.s{seq}.tmp"
+        d = spill_dir if spill_dir is not None \
+            else os.path.dirname(self._tmp_base())
+        return os.path.join(d, name)
+
+    def _reap(self, path: str) -> None:
+        """Best-effort unlink for cleanup paths — but COUNTED: a cleanup
+        that itself fails (EACCES, EIO...) stays best-effort, yet chaos
+        runs can assert nothing leaked silently
+        (``WriteMetrics.cleanup_errors``)."""
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            self.metrics.record_cleanup_error()
+            self._tracer.instant("write.cleanup_error", "fault",
+                                 shuffle=self.shuffle_id, map=self.map_id,
+                                 error=type(e).__name__)
+            log.warning("cleanup of %s failed (leak candidate): %s", path, e)
 
     def write_batch(self, keys: np.ndarray,
                     payload: Optional[np.ndarray] = None) -> None:
@@ -252,6 +327,9 @@ class TpuShuffleWriter:
                     t0 = time.perf_counter_ns()
                     while self._inflight >= self._max_inflight \
                             and self._spill_error is None:
+                        self._check_spill_health_locked()
+                        if self._spill_error is not None:
+                            break
                         self._cv.wait(timeout=0.05)
                     self.metrics.record_spill_wait(
                         time.perf_counter_ns() - t0)
@@ -300,8 +378,21 @@ class TpuShuffleWriter:
 
     def _raise_spill_error_locked(self) -> None:
         if self._spill_error is not None:
-            raise RuntimeError("background spill failed") \
+            raise WriteFailedError("background spill failed") \
                 from self._spill_error
+
+    def _check_spill_health_locked(self) -> None:
+        """A spill worker that DIED (killed thread, not an exception its
+        handler saw) leaves ``_inflight`` stuck high forever; every wait
+        on the condition — backpressure, drain, abort — must notice and
+        raise instead of hanging the map task."""
+        if (self._spill_error is None and self._inflight > 0
+                and self._spill_workers
+                and not any(t.is_alive() for t in self._spill_workers)):
+            self._spill_error = WriteFailedError(
+                f"{self._inflight} spill(s) in flight but every spill "
+                f"worker is dead")
+            self._cv.notify_all()
 
     def _ensure_spill_workers(self) -> None:
         if self._spill_queue is None:
@@ -314,24 +405,24 @@ class TpuShuffleWriter:
 
     def _enqueue_spill_locked(self) -> None:
         """Hand the accumulated runs to the spill thread (caller holds
-        the cv). The spill path name is reserved here (task thread) so
-        file naming stays attempt-unique and deterministic."""
+        the cv). File naming stays attempt-unique and deterministic per
+        (attempt, seq); the DIRECTORY is chosen at write time from the
+        resolver's healthy-candidate list so retries can fall back."""
         runs, self._runs = self._runs, []
         nbytes, self._buffered = self._buffered, 0
         seq = self._spill_seq
         self._spill_seq += 1
-        path = self._spill_path(seq)
         self._inflight += 1
         self._inflight_bytes += nbytes
         self._ensure_spill_workers()
-        self._spill_queue.put((seq, runs, nbytes, path))
+        self._spill_queue.put((seq, runs, nbytes))
 
     def _spill_worker(self) -> None:
         while True:
             job = self._spill_queue.get()
             if job is None:
                 return
-            seq, runs, nbytes, path = job
+            seq, runs, nbytes = job
             t0 = time.perf_counter_ns()
             try:
                 if not self._aborted:
@@ -339,7 +430,7 @@ class TpuShuffleWriter:
                                            shuffle=self.shuffle_id,
                                            map=self.map_id, seq=seq,
                                            bytes=nbytes):
-                        spill = self._write_spill(runs, path)
+                        spill = self._spill_with_retries(seq, runs, nbytes)
                 else:
                     spill = None
             except BaseException as e:  # noqa: BLE001 — surfaced to the task
@@ -362,25 +453,122 @@ class TpuShuffleWriter:
                 self._inflight_bytes -= nbytes
                 self._cv.notify_all()
 
+    def _spill_dir_candidates(self) -> List[str]:
+        fn = getattr(self.resolver, "spill_dir_candidates", None)
+        if fn is not None:
+            return fn()
+        return [os.path.dirname(self._tmp_base())]
+
+    def _spill_with_retries(self, seq: int, runs: List[_Run],
+                            nbytes: int) -> Optional[_Spill]:
+        """One spill under the disk failure policy: TRANSIENT errors
+        (ENOSPC, EIO, torn write, ...) retry with backoff up to
+        ``spill_retry_budget``, rotating into the next healthy fallback
+        dir (``spill_dirs``; a dir with ``spill_dir_max_failures``
+        consecutive failures is quarantined executor-wide). ENOSPC also
+        halves the writer's spill threshold so later spills are smaller.
+        Fatal errors, an exhausted budget, or a fully-quarantined dir
+        list fail the attempt cleanly as :class:`WriteFailedError`."""
+        budget = max(0, int(self.conf.spill_retry_budget))
+        attempt = 0
+        failed_dirs: set = set()
+        while True:
+            if self._aborted:
+                return None
+            candidates = self._spill_dir_candidates()
+            if not candidates:
+                raise WriteFailedError(
+                    f"spill {seq}: every spill directory is quarantined "
+                    f"({self.resolver.spill_dir_health()})")
+            # rotate through EVERY not-yet-failed candidate before
+            # revisiting one (a healthy third dir must get its shot
+            # inside the budget); once all have failed, start over
+            if failed_dirs.issuperset(candidates):
+                failed_dirs.clear()
+            d = next((c for c in candidates if c not in failed_dirs),
+                     candidates[0])
+            path = self._spill_path(seq, d)
+            with self._cv:
+                self._spill_paths.add(path)
+            try:
+                return self._write_spill(runs, path)
+            except OSError as e:
+                self._reap(path)  # a partial spill must not survive
+                record = getattr(self.resolver,
+                                 "record_spill_dir_failure", None)
+                if record is not None:
+                    record(d)
+                self.metrics.record_spill_dir_failure()
+                failed_dirs.add(d)
+                if e.errno == errno.ENOSPC and self.spill_threshold > 0:
+                    # degrade: smaller spills both fit a nearly-full disk
+                    # better and bound how much one retry re-writes
+                    self.spill_threshold //= 2
+                    self.metrics.record_spill_shrink()
+                    self._tracer.instant(
+                        "write.spill_shrink", "fault",
+                        shuffle=self.shuffle_id, map=self.map_id,
+                        threshold=self.spill_threshold)
+                attempt += 1
+                if not _transient_disk_error(e) or attempt > budget:
+                    raise WriteFailedError(
+                        f"spill {seq} failed after {attempt} attempt(s) "
+                        f"(last dir {d}): {e}") from e
+                self.metrics.record_spill_retry()
+                self._tracer.instant("write.spill_retry", "fault",
+                                     shuffle=self.shuffle_id,
+                                     map=self.map_id, seq=seq,
+                                     attempt=attempt, dir=d,
+                                     error=type(e).__name__)
+                log.warning("spill %d of shuffle %d map %d failed in %s "
+                            "(attempt %d/%d): %s — retrying",
+                            seq, self.shuffle_id, self.map_id, d,
+                            attempt, budget + 1, e)
+                time.sleep(self._spill_backoff.delay(attempt - 1))
+
+    def _spill_write(self, f, view, path: str) -> None:
+        """One guarded spill write (torn-write injection point)."""
+        cap = fault_mod.storage_write_cap("spill_write", path, len(view))
+        if cap is not None:
+            f.write(memoryview(view)[:cap])
+            f.flush()
+            raise OSError(errno.EIO,
+                          f"fault injection: torn write ({cap}/{len(view)} "
+                          f"bytes landed)", path)
+        f.write(memoryview(view))
+
     def _write_spill(self, runs: List[_Run], path: str) -> _Spill:
         """One spill file: partition-contiguous over the runs it covers
-        (combiner applied per partition first, shrinking spilled bytes)."""
+        (combiner applied per partition first, shrinking spilled bytes).
+        Partition CRCs stream with the writes when at-rest checksums are
+        on; a success resets the directory's failure count."""
+        fault_mod.storage_check("spill_write", path)
         part_lengths = np.zeros(self.num_partitions, dtype=np.int64)
+        part_crcs = [0] * self.num_partitions if self._crc_enabled else None
         with open(path, "wb") as f:
             for p in range(self.num_partitions):
                 if self.combiner is None:
                     for run in runs:
                         seg = run.segment(p)
                         if len(seg):
-                            f.write(memoryview(seg))
+                            self._spill_write(f, seg, path)
                             part_lengths[p] += len(seg)
+                            if part_crcs is not None:
+                                part_crcs[p] = zlib.crc32(memoryview(seg),
+                                                          part_crcs[p])
                 else:
                     rows = self._partition_rows(p, [], runs)
                     if len(rows):
                         combined = self._combine_rows(rows)
-                        f.write(memoryview(combined.reshape(-1)))
+                        flat = combined.reshape(-1)
+                        self._spill_write(f, flat, path)
                         part_lengths[p] = combined.nbytes
-        return _Spill(path, part_lengths)
+                        if part_crcs is not None:
+                            part_crcs[p] = zlib.crc32(memoryview(flat))
+        success = getattr(self.resolver, "record_spill_dir_success", None)
+        if success is not None:
+            success(os.path.dirname(path))
+        return _Spill(path, part_lengths, part_crcs)
 
     # -- combine ---------------------------------------------------------
 
@@ -456,10 +644,31 @@ class TpuShuffleWriter:
             with self._tracer.span("write.merge", "write",
                                    shuffle=self.shuffle_id, map=self.map_id,
                                    spills=len(self._spills)):
-                tmp, partition_lengths = self._merge()
+                tmp, partition_lengths, partition_crcs = self._merge()
             self.metrics.record_merge(time.perf_counter_ns() - t0)
             _, token = self.resolver.commit(self.shuffle_id, self.map_id,
-                                            tmp, partition_lengths)
+                                            tmp, partition_lengths,
+                                            fence=self.fence,
+                                            partition_crcs=partition_crcs)
+        except StaleAttemptError:
+            # a newer attempt already committed: this attempt is a zombie
+            # — clean up everything, never publish
+            self._tracer.instant("commit.fenced", "fault",
+                                 shuffle=self.shuffle_id, map=self.map_id,
+                                 fence=self.fence)
+            self._abort_cleanup()
+            raise
+        except WriteFailedError:
+            self._abort_cleanup()
+            raise
+        except OSError as e:
+            # merge/commit-time disk failure: the attempt fails CLEANLY
+            # (all artifacts reaped) and classified so the map stage can
+            # re-place it on another executor
+            self._abort_cleanup()
+            raise WriteFailedError(
+                f"merge/commit of shuffle {self.shuffle_id} map "
+                f"{self.map_id} failed: {e}") from e
         except BaseException:
             self._abort_cleanup()
             raise
@@ -473,15 +682,21 @@ class TpuShuffleWriter:
             self.records_written = self.bytes_written // self.row_bytes
         return token, partition_lengths
 
-    def _merge(self) -> Tuple[str, np.ndarray]:
+    def _merge(self) -> Tuple[str, np.ndarray, Optional[List[int]]]:
         """Sequential merge of partition-contiguous runs into the data tmp:
         for each partition, spill segments stream kernel-side (sendfile)
         and in-memory runs write straight from (registered pool) run
-        memory — no global sort, no monolithic rows copy."""
+        memory — no global sort, no monolithic rows copy. With at-rest
+        checksums on, per-partition CRCs assemble as the bytes flow:
+        sendfile'd spill segments contribute the CRC computed when they
+        were SPILLED (``crc32_combine`` — the kernel-side copy stays
+        kernel-side), in-memory runs CRC directly."""
         tmp = self._tmp_base()
+        fault_mod.storage_check("merge_write", tmp)
         spills = [self._spills[s] for s in sorted(self._spills)]
         runs = self._runs
         part_lengths = np.zeros(self.num_partitions, dtype=np.int64)
+        part_crcs = [0] * self.num_partitions if self._crc_enabled else None
         out_fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
         spill_fds = []
         try:
@@ -494,28 +709,51 @@ class TpuShuffleWriter:
                         if ln:
                             _copy_from_file(out_fd, fd,
                                             int(s.part_offsets[p]), ln)
+                            if part_crcs is not None:
+                                part_crcs[p] = integrity.crc32_combine(
+                                    part_crcs[p], s.part_crcs[p], ln)
                             total += ln
                     for run in runs:
                         seg = run.segment(p)
                         if len(seg):
-                            _write_all(out_fd, seg)
+                            self._merge_write(out_fd, seg, tmp)
+                            if part_crcs is not None:
+                                part_crcs[p] = zlib.crc32(memoryview(seg),
+                                                          part_crcs[p])
                             total += len(seg)
                     part_lengths[p] = total
                 else:
                     rows = self._partition_rows(p, spills, runs, spill_fds)
                     if len(rows):
                         combined = self._combine_rows(rows)
-                        _write_all(out_fd, combined.reshape(-1))
+                        flat = combined.reshape(-1)
+                        self._merge_write(out_fd, flat, tmp)
+                        if part_crcs is not None:
+                            part_crcs[p] = zlib.crc32(memoryview(flat))
                         part_lengths[p] = combined.nbytes
         finally:
             for fd in spill_fds:
                 os.close(fd)
             os.close(out_fd)
-        return tmp, part_lengths
+        return tmp, part_lengths, part_crcs
+
+    def _merge_write(self, out_fd: int, view: np.ndarray, tmp: str) -> None:
+        """One guarded merge write (torn-write injection point; a torn
+        merge fails the attempt — the rename-commit never sees it)."""
+        cap = fault_mod.storage_write_cap("merge_write", tmp, len(view))
+        if cap is not None:
+            _write_all(out_fd, view[:cap])
+            raise OSError(errno.EIO,
+                          f"fault injection: torn merge write "
+                          f"({cap}/{len(view)} bytes landed)", tmp)
+        _write_all(out_fd, view)
 
     def _drain_spills(self) -> None:
         with self._cv:
             while self._inflight > 0 and self._spill_error is None:
+                self._check_spill_health_locked()
+                if self._spill_error is not None:
+                    break
                 self._cv.wait(timeout=0.05)
             self._raise_spill_error_locked()
 
@@ -530,10 +768,7 @@ class TpuShuffleWriter:
             spills = list(self._spills.values())
             self._spills = {}
         for spill in spills:
-            try:
-                os.unlink(spill.path)
-            except OSError:
-                pass
+            self._reap(spill.path)
 
     def _stop_spill_workers(self) -> None:
         if self._spill_queue is not None:
@@ -545,28 +780,31 @@ class TpuShuffleWriter:
 
     def _abort_cleanup(self) -> None:
         """Abort path: nothing of this attempt survives on disk — not the
-        data tmp, not a spill file. In-flight spill jobs are told to skip
-        their writes, then every artifact is unlinked."""
+        data tmp, not a spill file (fallback-dir spills included). In-
+        flight spill jobs are told to skip their writes, then every
+        artifact is unlinked (best-effort but COUNTED — see _reap)."""
         self._aborted = True
         with self._cv:
             deadline = time.monotonic() + 30
             while self._inflight > 0 and time.monotonic() < deadline:
+                self._check_spill_health_locked()
+                if self._spill_error is not None:
+                    break  # dead worker: its spills can't complete; sweep
                 self._cv.wait(timeout=0.05)
         self._stop_spill_workers()
         self._free_runs()
         self._cleanup_spill_files()
+        with self._cv:
+            attempted = set(self._spill_paths)
         if self._tmp_path is not None:
-            # the final tmp plus any spill file that slipped past the
-            # abort flag (its _Spill record may not have registered)
+            # every path this attempt ever opened, plus the primary-dir
+            # names of any spill that slipped past the abort flag (its
+            # _Spill record may not have registered)
             for seq in range(self._spill_seq):
-                try:
-                    os.unlink(self._spill_path(seq))
-                except OSError:
-                    pass
-            try:
-                os.unlink(self._tmp_path)
-            except OSError:
-                pass
+                attempted.add(self._spill_path(seq))
+            for path in sorted(attempted):
+                self._reap(path)
+            self._reap(self._tmp_path)
 
 
 class MonolithicShuffleWriter:
@@ -592,6 +830,8 @@ class MonolithicShuffleWriter:
         self._closed = False
         self.bytes_written = 0
         self.records_written = 0
+        self.cleanup_errors = 0  # swallowed-but-counted cleanup failures
+        self.fence = resolver.begin_attempt(shuffle_id, map_id)
 
     @property
     def row_bytes(self) -> int:
@@ -651,17 +891,23 @@ class MonolithicShuffleWriter:
         rows[:, :8] = keys[order, None].view(np.uint8).reshape(len(keys), 8)
         rows[:, 8:] = payload[order]
 
-        tmp = self.resolver.data_tmp_path(self.shuffle_id, self.map_id)
+        tmp = self.resolver.data_tmp_path(self.shuffle_id, self.map_id,
+                                          fence=self.fence)
         try:
             rows.tofile(tmp)
             partition_lengths = counts * self.row_bytes
             _, token = self.resolver.commit(self.shuffle_id, self.map_id, tmp,
-                                            partition_lengths)
+                                            partition_lengths,
+                                            fence=self.fence)
         except BaseException:
             try:
                 os.unlink(tmp)
-            except OSError:
+            except FileNotFoundError:
                 pass
+            except OSError as e:
+                self.cleanup_errors += 1
+                log.warning("cleanup of %s failed (leak candidate): %s",
+                            tmp, e)
             raise
         self.bytes_written = int(partition_lengths.sum())
         return token, partition_lengths

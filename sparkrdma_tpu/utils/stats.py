@@ -210,6 +210,14 @@ class WriteMetrics:
         self.peak_buffered_bytes = 0
         self.peak_outstanding_bytes = 0
         self.native_scatter = False
+        # failure path: transient spill retries absorbed, spill dirs that
+        # failed under this writer, ENOSPC-driven threshold shrinks, and
+        # best-effort cleanup unlinks that themselves failed (swallowed,
+        # but COUNTED — chaos runs assert nothing leaked silently)
+        self.spill_retries = 0
+        self.spill_dir_failures = 0
+        self.spill_shrinks = 0
+        self.cleanup_errors = 0
 
     def record_scatter(self, ns: int) -> None:
         with self._lock:
@@ -235,6 +243,22 @@ class WriteMetrics:
             self.peak_outstanding_bytes = max(self.peak_outstanding_bytes,
                                               outstanding)
 
+    def record_spill_retry(self) -> None:
+        with self._lock:
+            self.spill_retries += 1
+
+    def record_spill_dir_failure(self) -> None:
+        with self._lock:
+            self.spill_dir_failures += 1
+
+    def record_spill_shrink(self) -> None:
+        with self._lock:
+            self.spill_shrinks += 1
+
+    def record_cleanup_error(self) -> None:
+        with self._lock:
+            self.cleanup_errors += 1
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -247,6 +271,10 @@ class WriteMetrics:
                 "peak_buffered_bytes": self.peak_buffered_bytes,
                 "peak_outstanding_bytes": self.peak_outstanding_bytes,
                 "native_scatter": self.native_scatter,
+                "spill_retries": self.spill_retries,
+                "spill_dir_failures": self.spill_dir_failures,
+                "spill_shrinks": self.spill_shrinks,
+                "cleanup_errors": self.cleanup_errors,
             }
 
 

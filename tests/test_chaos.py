@@ -24,10 +24,16 @@ from sparkrdma_tpu.parallel import messages as M
 from sparkrdma_tpu.parallel.faults import (
     BLACKHOLE,
     CORRUPT,
+    CORRUPT_AT_REST,
     DELAY,
     DISCONNECT,
+    EIO,
+    ENOSPC,
     REFUSE_CONNECT,
+    SLOW_DISK,
+    TORN_WRITE,
     FaultInjector,
+    StorageFaultInjector,
 )
 from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
 from sparkrdma_tpu.shuffle.recovery import run_map_stage, run_reduce_with_retry
@@ -38,6 +44,8 @@ SEED = int(os.environ.get("CHAOS_SEED", "0"))
 # dataplane under chaos: 1 = coalesced vectored reads (the default), 0 =
 # the per-map fallback; scripts/run_chaos.sh sweeps both
 COALESCE = os.environ.get("CHAOS_COALESCE", "1") not in ("0", "false")
+# storage-fault sweep gate (CHAOS_DISK=0 runs the network-only matrix)
+DISK = os.environ.get("CHAOS_DISK", "1") not in ("0", "false")
 
 
 def _conf(**kw):
@@ -399,6 +407,104 @@ def test_chaos_matrix(tmp_path, scenario):
         np.testing.assert_array_equal(
             got, _expected_big(6),
             err_msg=f"scenario={scenario} seed={SEED}")
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+# -- the storage-fault matrix (CHAOS_DISK sweep) --------------------------
+#
+# Every injected ENOSPC/EIO/torn-write/slow-disk/corrupt-at-rest scenario
+# must end with byte-identical job output — via spill retry, fallback
+# dir, or map re-execution — or a clean, fully-reaped task failure:
+# never a hang, never a served torn/corrupt block.
+
+
+def _disk_faults(name, injector):
+    deterministic = True
+    if name == "enospc_spill":
+        # two failures, absorbed by retries (budget 2 = 3 attempts)
+        injector.add(ENOSPC, op="spill_write", times=2)
+    elif name == "eio_spill":
+        injector.add(EIO, op="spill_write", prob=0.2)
+        deterministic = False
+    elif name == "torn_spill":
+        injector.add(TORN_WRITE, op="spill_write", torn_bytes=32, times=2)
+    elif name == "slow_disk":
+        injector.add(SLOW_DISK, delay_s=0.01, prob=0.3)
+        deterministic = False
+    elif name == "corrupt_at_rest":
+        injector.add(CORRUPT_AT_REST, op="commit", times=1)
+    elif name == "mixed_disk":
+        injector.add(ENOSPC, op="spill_write", times=1)
+        injector.add(SLOW_DISK, op="spill_write", delay_s=0.005, prob=0.2)
+        injector.add(CORRUPT_AT_REST, op="commit", times=1)
+    else:  # pragma: no cover - scenario list and matrix stay in sync
+        raise AssertionError(name)
+    return deterministic
+
+
+@pytest.mark.skipif(not DISK, reason="CHAOS_DISK=0: network-only sweep")
+@pytest.mark.parametrize("scenario", ["enospc_spill", "eio_spill",
+                                      "torn_spill", "slow_disk",
+                                      "corrupt_at_rest", "mixed_disk"])
+def test_chaos_disk_matrix(tmp_path, scenario):
+    """Seeded storage faults under a real multi-executor job: small spill
+    threshold (every map spills), a fallback spill dir, at-rest
+    checksums on. Replay a failure with
+    ``CHAOS_SEED=<seed> CHAOS_COALESCE=<0|1> pytest tests/test_chaos.py
+    -m chaos -k disk``."""
+    driver, execs = _cluster(
+        tmp_path, spill_threshold_bytes="1k",
+        spill_dirs=str(tmp_path / "fallback"),
+        spill_retry_budget=2, at_rest_checksum=True)
+    injector = StorageFaultInjector(seed=SEED)
+    injector.install()
+    try:
+        deterministic = _disk_faults(scenario, injector)
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        # the map stage runs UNDER the faults: spill retries, fallback
+        # dirs, and WriteFailedError re-placement all exercise here
+        run_map_stage(execs, handle, _map_fn)
+        got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
+                                    reducer_index=0, max_stage_retries=3,
+                                    driver=driver)
+        np.testing.assert_array_equal(
+            got, _expected(6),
+            err_msg=f"scenario={scenario} seed={SEED}")
+        if deterministic:
+            assert injector.fired_count() > 0, \
+                f"scenario={scenario} seed={SEED}: no fault fired"
+        # no attempt artifacts may outlive the job in ANY spill dir
+        # (fallback dirs are namespaced per executor — walk recursively)
+        leftovers = [str(p) for p in tmp_path.rglob("*.tmp")]
+        assert leftovers == [], \
+            f"scenario={scenario} seed={SEED}: leaked {leftovers}"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+@pytest.mark.skipif(not DISK, reason="CHAOS_DISK=0: network-only sweep")
+def test_chaos_disk_total_failure_is_clean(tmp_path):
+    """When every spill dir fails persistently, the job FAILS CLEANLY:
+    WriteFailedError after re-placement on every live executor, no hang,
+    and not one ``.tmp`` left anywhere."""
+    from sparkrdma_tpu.shuffle.writer import WriteFailedError
+
+    driver, execs = _cluster(tmp_path, spill_threshold_bytes="1k",
+                             spill_retry_budget=1)
+    injector = StorageFaultInjector(seed=SEED)
+    injector.install()
+    try:
+        injector.add(EIO, op="spill_write")  # every attempt, every dir
+        handle = driver.register_shuffle(1, num_maps=2, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        with pytest.raises(WriteFailedError):
+            run_map_stage(execs, handle, _map_fn)
+        leftovers = [str(p) for p in tmp_path.rglob("*.tmp")]
+        assert leftovers == [], f"seed={SEED}: leaked {leftovers}"
     finally:
         injector.uninstall()
         _shutdown(driver, execs)

@@ -1,0 +1,768 @@
+"""Storage-fault harness and the hardened write/serve path.
+
+Covers the disk half of the chaos story (the network half lives in
+``test_faults.py``/``test_chaos.py``): seeded storage fault injection
+(ENOSPC / EIO / torn writes / slow disk / at-rest corruption), spill
+retries into fallback dirs with quarantine, clean attempt failure with
+full reaping, spill-worker-death detection, counted cleanup swallows,
+crash-restart recovery windows, commit fencing (resolver CAS + driver
+publish rejection), and at-rest CRC verification end to end on both the
+Python and native dataplanes.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import faults as fault_mod
+from sparkrdma_tpu.parallel.faults import (
+    CORRUPT_AT_REST,
+    EIO,
+    ENOSPC,
+    SLOW_DISK,
+    TORN_WRITE,
+    StorageFaultInjector,
+)
+from sparkrdma_tpu.runtime import native
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+from sparkrdma_tpu.shuffle.map_output import DriverTable
+from sparkrdma_tpu.shuffle.recovery import run_map_stage, run_reduce_with_retry
+from sparkrdma_tpu.shuffle.resolver import (
+    StaleAttemptError,
+    TpuShuffleBlockResolver,
+)
+from sparkrdma_tpu.shuffle.writer import (
+    TpuShuffleWriter,
+    WriteFailedError,
+    decode_rows,
+)
+from sparkrdma_tpu.utils import integrity
+
+
+def _mod_part(n):
+    return lambda keys: (np.asarray(keys) % n).astype(np.int64)
+
+
+def _write_map(writer, seed=0, batches=3, rows=400):
+    rng = np.random.default_rng(seed)
+    for _ in range(batches):
+        writer.write_batch(rng.integers(0, 4096, rows).astype(np.uint64))
+
+
+def _tmp_leftovers(*dirs):
+    out = []
+    for d in dirs:
+        for root, _dirs, names in os.walk(d):
+            out += [n for n in names if n.endswith(".tmp")]
+    return out
+
+
+@pytest.fixture
+def injector():
+    inj = StorageFaultInjector(seed=0)
+    inj.install()
+    yield inj
+    inj.uninstall()
+
+
+# -- injector unit behavior ----------------------------------------------
+
+
+def test_storage_injector_matching_windows(tmp_path, injector):
+    injector.add(ENOSPC, op="spill_write", path_substr="alpha", after=1,
+                 times=2)
+    # wrong op / wrong path: no fire
+    fault_mod.storage_check("merge_write", "/x/alpha/f")
+    fault_mod.storage_check("spill_write", "/x/beta/f")
+    # first match is skipped (after=1), next two fire, then exhausted
+    fault_mod.storage_check("spill_write", "/x/alpha/f")
+    with pytest.raises(OSError):
+        fault_mod.storage_check("spill_write", "/x/alpha/f")
+    with pytest.raises(OSError):
+        fault_mod.storage_check("spill_write", "/x/alpha/f")
+    fault_mod.storage_check("spill_write", "/x/alpha/f")
+    assert injector.fired_count(ENOSPC) == 2
+
+
+def test_storage_injector_uninstalled_is_noop(tmp_path):
+    inj = StorageFaultInjector()
+    inj.add(EIO)
+    # never installed: hooks must stay no-ops
+    fault_mod.storage_check("spill_write", "/anything")
+    assert fault_mod.storage_write_cap("spill_write", "/anything", 10) is None
+
+
+def test_torn_write_cap_and_slow_disk(tmp_path, injector):
+    injector.add(TORN_WRITE, op="spill_write", torn_bytes=7, times=1)
+    assert fault_mod.storage_write_cap("spill_write", "/f", 100) == 7
+    assert fault_mod.storage_write_cap("spill_write", "/f", 100) is None
+    injector.add(SLOW_DISK, op="serve_read", delay_s=0.05, times=1)
+    t0 = time.monotonic()
+    fault_mod.storage_check("serve_read", "/f")
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_corrupt_at_rest_flips_bits(tmp_path, injector):
+    p = str(tmp_path / "f")
+    with open(p, "wb") as f:
+        f.write(b"\x00" * 128)
+    injector.add(CORRUPT_AT_REST, op="commit", flip_bits=3, times=1)
+    fault_mod.storage_corrupt("commit", p)
+    data = open(p, "rb").read()
+    assert data != b"\x00" * 128 and len(data) == 128
+
+
+# -- integrity primitives -------------------------------------------------
+
+
+def test_sidecar_roundtrip(tmp_path):
+    import zlib
+    data_path = str(tmp_path / "shuffle_1_0.data")
+    parts = [b"abc" * 100, b"", b"zzz" * 57]
+    with open(data_path, "wb") as f:
+        for p in parts:
+            f.write(p)
+    crcs = [zlib.crc32(p) for p in parts]
+    lens = [len(p) for p in parts]
+    integrity.write_sidecar(data_path, fence=42, partition_crcs=crcs,
+                            partition_lengths=lens)
+    fence, got_crcs, file_crc = integrity.read_sidecar(data_path)
+    assert fence == 42 and got_crcs == crcs
+    assert file_crc == integrity.file_crc32(data_path)
+    assert integrity.combine_parts(crcs, lens) == file_crc
+    assert integrity.partition_crcs_of_file(data_path, lens) == crcs
+    assert integrity.read_sidecar(str(tmp_path / "nope.data")) is None
+
+
+# -- hardened spill path --------------------------------------------------
+
+
+def _writer(resolver, conf, sid=1, mid=0, parts=4):
+    return TpuShuffleWriter(resolver, sid, mid, parts, _mod_part(parts), 0,
+                            conf=conf)
+
+
+def test_spill_enospc_retries_into_fallback_dir(tmp_path, injector):
+    primary, fb = str(tmp_path / "s"), str(tmp_path / "fb")
+    conf = TpuShuffleConf(spill_threshold_bytes=0, spill_dirs=fb,
+                          spill_retry_budget=2, retry_backoff_base_ms=1,
+                          retry_backoff_cap_ms=5)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    injector.add(ENOSPC, op="spill_write", path_substr=primary + "/",
+                 times=1)
+    w = _writer(resolver, conf)
+    _write_map(w, seed=1)
+    token, lengths = w.close()
+    assert injector.fired_count(ENOSPC) == 1
+    assert w.metrics.spill_retries >= 1
+    assert w.metrics.spill_dir_failures >= 1
+    # byte-identical to a fault-free run of the same input
+    r2 = TpuShuffleBlockResolver(str(tmp_path / "clean"), conf=conf)
+    w2 = _writer(r2, conf)
+    _write_map(w2, seed=1)
+    w2.close()
+    got = open(resolver._shuffles[1][0].path, "rb").read()
+    want = open(r2._shuffles[1][0].path, "rb").read()
+    assert got == want and len(got) > 0
+    # nothing left behind in either dir
+    assert _tmp_leftovers(primary, fb) == []
+    resolver.stop()
+    r2.stop()
+
+
+def test_spill_dir_quarantined_after_max_failures(tmp_path, injector):
+    primary, fb = str(tmp_path / "s"), str(tmp_path / "fb")
+    conf = TpuShuffleConf(spill_threshold_bytes=0, spill_dirs=fb,
+                          spill_dir_max_failures=1, spill_retry_budget=3,
+                          retry_backoff_base_ms=1, retry_backoff_cap_ms=5)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    injector.add(ENOSPC, op="spill_write", path_substr=primary + "/")
+    w = _writer(resolver, conf)
+    _write_map(w, seed=2)
+    w.close()
+    assert resolver.spill_dir_health()["quarantined"] == [primary]
+    # a NEW writer never even tries the quarantined dir
+    before = injector.fired_count(ENOSPC)
+    w2 = _writer(resolver, conf, mid=1)
+    _write_map(w2, seed=3)
+    w2.close()
+    assert injector.fired_count(ENOSPC) == before
+    assert w2.metrics.spill_retries == 0
+    resolver.stop()
+
+
+def test_torn_spill_write_retried_clean(tmp_path, injector):
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes=0, spill_retry_budget=2,
+                          retry_backoff_base_ms=1, retry_backoff_cap_ms=5)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    injector.add(TORN_WRITE, op="spill_write", torn_bytes=16, times=1)
+    w = _writer(resolver, conf)
+    _write_map(w, seed=4)
+    w.close()
+    assert injector.fired_count(TORN_WRITE) == 1
+    assert w.metrics.spill_retries >= 1
+    r2 = TpuShuffleBlockResolver(str(tmp_path / "clean"), conf=conf)
+    w2 = _writer(r2, conf)
+    _write_map(w2, seed=4)
+    w2.close()
+    assert (open(resolver._shuffles[1][0].path, "rb").read()
+            == open(r2._shuffles[1][0].path, "rb").read())
+    assert _tmp_leftovers(primary) == []
+    resolver.stop()
+    r2.stop()
+
+
+def test_enospc_shrinks_spill_threshold(tmp_path, injector):
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes="8k", spill_retry_budget=2,
+                          retry_backoff_base_ms=1, retry_backoff_cap_ms=5)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    injector.add(ENOSPC, op="spill_write", times=1)
+    w = _writer(resolver, conf)
+    assert w.spill_threshold == 8 << 10
+    _write_map(w, seed=5, batches=8, rows=500)
+    w.close()
+    assert w.metrics.spill_shrinks == 1
+    assert w.spill_threshold <= 4 << 10
+    resolver.stop()
+
+
+def test_spill_failure_exhausted_fails_attempt_cleanly(tmp_path, injector):
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes=0, spill_retry_budget=1,
+                          retry_backoff_base_ms=1, retry_backoff_cap_ms=5)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    injector.add(EIO, op="spill_write")  # every attempt, every dir
+    w = _writer(resolver, conf)
+    with pytest.raises(WriteFailedError):
+        _write_map(w, seed=6, batches=10)
+        w.close()
+    if not w.closed:
+        w.close(success=False)
+    assert os.listdir(primary) == []  # clean failure: everything reaped
+    resolver.stop()
+
+
+def test_fatal_disk_error_fails_without_retry(tmp_path, injector):
+    """A non-transient errno (EACCES here) must not burn the retry
+    budget — the attempt fails immediately and cleanly."""
+    import errno as _errno
+
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes=0, spill_retry_budget=5,
+                          retry_backoff_base_ms=1, retry_backoff_cap_ms=5)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    w = _writer(resolver, conf)
+
+    real_open = open
+
+    def denied(path, *a, **kw):
+        if str(path).endswith(".s0.tmp"):
+            raise OSError(_errno.EACCES, "injected permission denial", path)
+        return real_open(path, *a, **kw)
+
+    import builtins
+    orig = builtins.open
+    builtins.open = denied
+    try:
+        with pytest.raises(WriteFailedError):
+            _write_map(w, seed=7, batches=10)
+            w.close()
+    finally:
+        builtins.open = orig
+    if not w.closed:
+        w.close(success=False)
+    assert w.metrics.spill_retries == 0
+    assert os.listdir(primary) == []
+    resolver.stop()
+
+
+def test_spill_rotation_reaches_every_healthy_dir(tmp_path, injector):
+    """With primary and the first fallback persistently failing, the
+    SECOND fallback must get its attempt inside the retry budget."""
+    primary = str(tmp_path / "s")
+    fb1, fb2 = str(tmp_path / "fb1"), str(tmp_path / "fb2")
+    conf = TpuShuffleConf(spill_threshold_bytes=0,
+                          spill_dirs=f"{fb1},{fb2}",
+                          spill_retry_budget=2, spill_dir_max_failures=10,
+                          retry_backoff_base_ms=1, retry_backoff_cap_ms=5)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    injector.add(EIO, op="spill_write", path_substr=primary + "/")
+    injector.add(EIO, op="spill_write", path_substr=fb1 + "/")
+    w = _writer(resolver, conf)
+    _write_map(w, seed=11)
+    w.close()  # budget 2 = 3 attempts: primary, fb1, fb2 — fb2 heals it
+    assert w.metrics.spill_retries >= 2
+    assert _tmp_leftovers(primary, fb1, fb2) == []
+    resolver.stop()
+
+
+def test_commit_failure_after_rename_leaves_no_orphan_data(tmp_path,
+                                                           injector):
+    """A failed index/sidecar write AFTER the data rename must UN-commit:
+    an index-less .data file would otherwise survive every sweep (the
+    writer's cleanup only knows .tmp names) and leak a full-size file on
+    an already-failing disk."""
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(at_rest_checksum=True)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    injector.add(ENOSPC, op="index_write")
+    w = _writer(resolver, conf)
+    _write_map(w, seed=12)
+    with pytest.raises(WriteFailedError):
+        w.close()
+    assert os.listdir(primary) == [], \
+        "a failed commit must leave nothing on disk"
+    # the next attempt (no fault left) commits normally
+    injector.clear()
+    w2 = _writer(resolver, conf)
+    _write_map(w2, seed=12)
+    w2.close()
+    assert resolver.get_output_table(1, 0) is not None
+    resolver.stop()
+
+
+# -- satellite: spill-worker death must wake blocked writers --------------
+
+
+def test_spill_worker_death_wakes_writer(tmp_path):
+    """Regression: a KILLED spill worker (thread gone, accounting stuck)
+    must wake a ``write_batch`` blocked on the backpressure wait and
+    raise, not hang the map task forever."""
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes=0, write_spill_threads=1)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    w = _writer(resolver, conf)
+    # kill switch: the worker thread exits the moment it starts, leaving
+    # the queued spill permanently in flight
+    w._spill_worker = lambda: None
+    w.write_batch(np.arange(100, dtype=np.uint64))  # enqueues the spill
+    t0 = time.monotonic()
+    with pytest.raises(WriteFailedError, match="spill"):
+        for _ in range(50):
+            w.write_batch(np.arange(100, dtype=np.uint64))
+    assert time.monotonic() - t0 < 10, "detection must not wait out a hang"
+    w.close(success=False)
+    assert _tmp_leftovers(primary) == []
+    resolver.stop()
+
+
+def test_spill_worker_death_wakes_close(tmp_path):
+    """Same detection on the close()/drain path."""
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes=0, write_spill_threads=2)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    w = _writer(resolver, conf)
+    w._spill_worker = lambda: None
+    w.write_batch(np.arange(100, dtype=np.uint64))
+    time.sleep(0.05)  # let the doomed worker exit
+    with pytest.raises(WriteFailedError, match="spill"):
+        w.close()
+    assert _tmp_leftovers(primary) == []
+    resolver.stop()
+
+
+# -- satellite: cleanup swallows are counted ------------------------------
+
+
+def test_cleanup_swallows_are_counted(tmp_path, monkeypatch):
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes=0)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    w = _writer(resolver, conf)
+    _write_map(w, seed=8, batches=2)
+
+    real_unlink = os.unlink
+    blocked = []
+
+    def flaky_unlink(path, *a, **kw):
+        if str(path).endswith(".s0.tmp"):
+            blocked.append(str(path))
+            raise PermissionError(13, "injected unlink denial", path)
+        return real_unlink(path, *a, **kw)
+
+    monkeypatch.setattr(os, "unlink", flaky_unlink)
+    w.close()  # commit succeeds; spill cleanup swallow is counted
+    monkeypatch.undo()
+    assert w.metrics.cleanup_errors >= 1
+    assert blocked, "the injected unlink failure never triggered"
+    for path in blocked:
+        if os.path.exists(path):
+            real_unlink(path)
+    resolver.stop()
+
+
+# -- satellite: crash-restart recovery windows ----------------------------
+
+
+def test_recover_crash_windows_and_orphan_sweep(tmp_path):
+    """Death between data-rename and index-write, and death mid-spill:
+    recover() serves ONLY fully-committed attempts and sweeps every
+    orphan ``.tmp``/``.s<seq>.tmp`` — fallback spill dirs included."""
+    primary, fb = str(tmp_path / "s"), str(tmp_path / "fb")
+    conf = TpuShuffleConf(spill_threshold_bytes=0, spill_dirs=fb,
+                          at_rest_checksum=True)
+    r1 = TpuShuffleBlockResolver(primary, conf=conf)
+    w = _writer(r1, conf, mid=0)
+    _write_map(w, seed=9)
+    w.close()
+    committed_bytes = open(r1._shuffles[1][0].path, "rb").read()
+
+    # crash window (a): data renamed, index never written (map 1)
+    with open(os.path.join(primary, "shuffle_1_1.data"), "wb") as f:
+        f.write(b"\x07" * 64)
+    # crash window (b): mid-spill death (map 2) — tmp + spills, one of
+    # them in the crashed resolver's (namespaced) fallback dir
+    for name, d in [("shuffle_1_2.99.tmp", primary),
+                    ("shuffle_1_2.99.tmp.s0.tmp", primary),
+                    ("shuffle_1_2.99.tmp.s1.tmp",
+                     r1.fallback_spill_dirs[0])]:
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(b"junk")
+
+    r2 = TpuShuffleBlockResolver(primary, conf=conf)
+    recovered = r2.recover()
+    assert [m for m, _ in recovered[1]] == [0] and list(recovered) == [1]
+    assert r2.committed_fence(1, 0) == w.fence
+    assert _tmp_leftovers(primary, fb) == []
+    # the committed map still serves, byte-identical
+    assert r2.local_blocks(1, 0, 0, 4) == committed_bytes
+    # the half-committed data file is NOT served (recompute owns it)
+    assert r2.get_output_table(1, 1) is None
+    r2.stop()
+
+
+def test_recover_drops_corrupt_and_unattested_files(tmp_path):
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(at_rest_checksum=True)
+    r1 = TpuShuffleBlockResolver(primary, conf=conf)
+    w = _writer(r1, conf, mid=0)
+    _write_map(w, seed=10)
+    w.close()
+    data_path = r1._shuffles[1][0].path
+
+    # map 1: committed pair WITHOUT a sidecar (checksum-off commit):
+    # unattested under at_rest_checksum — treated as lost
+    p1 = os.path.join(primary, "shuffle_1_1.data")
+    with open(p1, "wb") as f:
+        f.write(b"\x01" * 32)
+    np.array([32], dtype=np.uint64).tofile(p1 + ".index")
+
+    # rot map 0's committed bytes
+    with open(data_path, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    r2 = TpuShuffleBlockResolver(primary, conf=conf)
+    recovered = r2.recover()
+    assert recovered == {}
+    assert r2.corrupt_outputs == 1
+    # both the corrupt set and the unattested pair were deleted so the
+    # recompute starts clean and nothing full-size leaks across restarts
+    assert not os.path.exists(data_path)
+    assert not os.path.exists(data_path + ".index")
+    assert not os.path.exists(integrity.sidecar_path(data_path))
+    assert not os.path.exists(p1) and not os.path.exists(p1 + ".index")
+    r2.stop()
+
+
+def test_recovered_fence_does_not_fence_new_attempts(tmp_path):
+    """Regression: after a restart, the attempt allocator restarts at 1
+    while recover() restores higher committed fences from sidecars — a
+    re-execution of a recovered map on the SAME executor must still
+    out-fence its pre-crash commit, not lose the CAS forever."""
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(at_rest_checksum=True)
+    r1 = TpuShuffleBlockResolver(primary, conf=conf)
+    # burn a few attempts so the committed fence is well above 1
+    for _ in range(3):
+        r1.begin_attempt(1, 0)
+    w = _writer(r1, conf)
+    _write_map(w, seed=13)
+    w.close()
+    assert w.fence >= 4
+
+    r2 = TpuShuffleBlockResolver(primary, conf=conf)
+    recovered = r2.recover()
+    assert [m for m, _ in recovered[1]] == [0]
+    assert r2.committed_fence(1, 0) == w.fence
+    # the re-execution (e.g. corrupt-output healing) commits fine
+    w2 = _writer(r2, conf)
+    assert w2.fence > w.fence
+    _write_map(w2, seed=14)
+    w2.close()
+    r2.stop()
+
+
+# -- satellite: commit fencing --------------------------------------------
+
+
+def test_commit_fencing_loser_rejected_and_reaped(tmp_path):
+    """Two concurrent speculative attempts of one map; the loser (older
+    fence) commits AFTER the winner: the winner's bytes stay served, the
+    loser raises StaleAttemptError, and the loser's files are reaped."""
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes=0)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    loser = _writer(resolver, conf)     # fence f
+    winner = _writer(resolver, conf)    # fence f+1
+    assert winner.fence > loser.fence
+    loser.write_batch(np.full(64, 3, dtype=np.uint64))
+    winner.write_batch(np.full(64, 7, dtype=np.uint64))
+    winner.close()
+    with pytest.raises(StaleAttemptError):
+        loser.close()
+    assert resolver.fenced_commits == 1
+    keys, _ = decode_rows(resolver.local_blocks(1, 0, 0, 4), 0)
+    assert set(keys.tolist()) == {7}, "winner's bytes must be served"
+    assert _tmp_leftovers(primary) == []
+    resolver.stop()
+
+
+def test_driver_table_publish_fencing_unit():
+    t = DriverTable(4)
+    assert t.publish(0, 10, exec_index=1, fence=5)
+    assert not t.publish(0, 11, 1, fence=4)  # stale same-exec: rejected
+    assert t.entry(0) == (10, 1)
+    assert t.publish(0, 12, 1, fence=5)      # idempotent re-publish
+    assert t.publish(0, 13, 2, fence=1)      # cross-exec always applies
+    assert t.entry(0) == (13, 2)
+    assert not t.publish(0, 14, 2, fence=0)  # now fenced on exec 2
+    assert t.entry(0) == (13, 2)
+
+
+def _cluster(tmp_path, n=2, **kw):
+    base = dict(connect_timeout_ms=3000, max_connection_attempts=2,
+                retry_backoff_base_ms=10, retry_backoff_cap_ms=80,
+                fetch_retry_budget=1, use_cpp_runtime=False,
+                pre_warm_connections=False)
+    base.update(kw)
+    conf = TpuShuffleConf(**base)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(n)]
+    for ex in execs:
+        ex.executor.wait_for_members(n)
+    return driver, execs
+
+
+def _shutdown(driver, execs):
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def test_publish_fencing_rejects_stale_e2e(tmp_path):
+    driver, execs = _cluster(tmp_path)
+    try:
+        handle = driver.register_shuffle(1, num_maps=1, num_partitions=2,
+                                         partitioner=PartitionerSpec("modulo"))
+        w = execs[0].get_writer(handle, 0)
+        w.write_batch(np.arange(32, dtype=np.uint64))
+        token, _ = w.close()
+        time.sleep(0.1)
+        entry = driver.driver.map_entry(1, 0)
+        assert entry == (token, execs[0].executor.exec_index())
+        # a zombie's late publish: same executor, older fence
+        execs[0].executor.publish_map_output(1, 0, 4242, fence=0)
+        time.sleep(0.2)
+        assert driver.driver.map_entry(1, 0) == entry, \
+            "stale publish must not clobber the committed winner"
+        assert driver.driver.fenced_publishes == 1
+    finally:
+        _shutdown(driver, execs)
+
+
+@pytest.mark.parametrize("native_dataplane", [
+    False,
+    pytest.param(True, marks=pytest.mark.skipif(
+        not native.available(), reason="native runtime not built")),
+])
+def test_speculative_loser_fenced_winner_served(tmp_path, native_dataplane):
+    """Acceptance: the stale attempt's late commit/publish is rejected
+    and the committed winner's bytes are the ones a reducer receives —
+    on the Python AND native dataplanes."""
+    driver, execs = _cluster(tmp_path, use_cpp_runtime=native_dataplane)
+    try:
+        handle = driver.register_shuffle(1, num_maps=1, num_partitions=2,
+                                         partitioner=PartitionerSpec("modulo"))
+        loser = execs[0].get_writer(handle, 0)
+        winner = execs[0].get_writer(handle, 0)
+        loser.write_batch(np.full(64, 4, dtype=np.uint64))
+        winner.write_batch(np.full(64, 8, dtype=np.uint64))
+        winner.close()
+        with pytest.raises(StaleAttemptError):
+            loser.close()
+        keys, _ = execs[1].get_reader(handle, 0, 2).read_all()
+        assert set(keys.tolist()) == {8}, "winner's bytes must be served"
+        assert execs[0].resolver.fenced_commits == 1
+        assert _tmp_leftovers(str(tmp_path / "e0")) == []
+    finally:
+        _shutdown(driver, execs)
+
+
+# -- at-rest corruption: detection and re-execution -----------------------
+
+
+def _flip_mid_byte(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _map_fn(writer, map_id):
+    rng = np.random.default_rng(1000 + map_id)
+    writer.write_batch(rng.integers(0, 5000, 500).astype(np.uint64))
+
+
+def _reduce_fn(mgr, handle):
+    keys, _ = mgr.get_reader(handle, 0, handle.num_partitions).read_all()
+    return np.sort(keys)
+
+
+def _expected(num_maps):
+    return np.sort(np.concatenate(
+        [np.random.default_rng(1000 + m).integers(0, 5000, 500)
+         for m in range(num_maps)]).astype(np.uint64))
+
+
+@pytest.mark.parametrize("native_dataplane", [
+    False,
+    pytest.param(True, marks=pytest.mark.skipif(
+        not native.available(), reason="native runtime not built")),
+])
+def test_at_rest_corruption_reexecutes_only_that_map(tmp_path,
+                                                     native_dataplane):
+    """Bit-rot in ONE committed output after commit: the serve-time CRC
+    check demotes it to STATUS_CORRUPT, the reducer escalates with a
+    corrupt_output verdict, and recovery re-executes exactly that map —
+    no tombstone, no recompute of the owner's healthy outputs — ending
+    byte-identical. On the native dataplane the detection rides the
+    location serve (the only Python touchpoint there)."""
+    driver, execs = _cluster(tmp_path, at_rest_checksum=True,
+                             use_cpp_runtime=native_dataplane)
+    map_runs = []
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn,
+                      placement={m: 1 for m in range(6)})
+        victim_map = 3
+        _flip_mid_byte(execs[1].resolver._shuffles[1][victim_map].path)
+
+        def counting_map_fn(writer, map_id):
+            map_runs.append(map_id)
+            _map_fn(writer, map_id)
+
+        got = run_reduce_with_retry(execs, handle, counting_map_fn,
+                                    _reduce_fn, reducer_index=0,
+                                    driver=driver)
+        np.testing.assert_array_equal(got, _expected(6))
+        assert map_runs == [victim_map], \
+            f"exactly the corrupt map must re-execute, got {map_runs}"
+        assert execs[1].resolver.corrupt_outputs >= 1
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        assert all(m != TOMBSTONE for m in driver.driver.members()), \
+            "bit-rot must never tombstone a live executor"
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_local_at_rest_corruption_reexecutes(tmp_path):
+    """The reducer's OWN committed output rotted: the local short-circuit
+    detects it the same way and the map re-executes."""
+    driver, execs = _cluster(tmp_path, at_rest_checksum=True)
+    try:
+        handle = driver.register_shuffle(1, num_maps=2, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        # map 0 ran on exec 0 == the reducer: rot it
+        _flip_mid_byte(execs[0].resolver._shuffles[1][0].path)
+        got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
+                                    reducer_index=0, driver=driver)
+        np.testing.assert_array_equal(got, _expected(2))
+        assert execs[0].resolver.corrupt_outputs >= 1
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_rot_after_recover_detected_and_healed(tmp_path):
+    """Regression: recover()'s mmap-open verification must not exempt a
+    recovered output from serve-time spot checks — rot landing BETWEEN
+    restart-recovery and first serve was previously served silently (the
+    fetch CRC trailer is computed over the rotted bytes, so it
+    matches). The rejoined owner's re-execution must also out-fence its
+    own pre-crash commit (the allocator-bump fix)."""
+    driver, execs = _cluster(tmp_path, at_rest_checksum=True)
+    rejoined = None
+    try:
+        handle = driver.register_shuffle(1, num_maps=4, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn,
+                      placement={m: 1 for m in range(4)})
+        lost = execs[1].executor.manager_id
+        execs[1].executor.stop()
+        if execs[1].block_server is not None:
+            execs[1].block_server.stop()
+        driver.driver.remove_member(lost)
+        time.sleep(0.3)
+        rejoined = TpuShuffleManager(
+            execs[0].conf, driver_addr=driver.driver_addr,
+            executor_id="1b", spill_dir=str(tmp_path / "e1"))
+        rejoined.executor.wait_for_members(2)
+        rec = rejoined.recover_and_republish()
+        assert sorted(m for m, _ in rec[1]) == [0, 1, 2, 3]
+        time.sleep(0.2)
+        # rot AFTER recovery verified the files
+        _flip_mid_byte(rejoined.resolver._shuffles[1][2].path)
+        execs[0].executor.invalidate_shuffle(1)
+        got = run_reduce_with_retry([execs[0], rejoined], handle, _map_fn,
+                                    _reduce_fn, reducer_index=0,
+                                    driver=driver)
+        np.testing.assert_array_equal(got, _expected(4))
+        assert rejoined.resolver.corrupt_outputs >= 1
+    finally:
+        if rejoined is not None:
+            rejoined.stop()
+        _shutdown(driver, execs)
+
+
+def test_at_rest_writer_streams_crcs_no_extra_read(tmp_path):
+    """The streaming writer's sidecar CRCs (spill-time + merge-time
+    streaming, crc32_combine for sendfile'd segments) must equal a
+    from-scratch read of the committed file — spills, fallback dirs and
+    combiners included."""
+    from sparkrdma_tpu.shuffle.writer import make_sum_combiner
+
+    primary = str(tmp_path / "s")
+    conf = TpuShuffleConf(spill_threshold_bytes="2k", at_rest_checksum=True)
+    resolver = TpuShuffleBlockResolver(primary, conf=conf)
+    for mid, combiner in ((0, None), (1, make_sum_combiner("<u4"))):
+        w = TpuShuffleWriter(resolver, 1, mid, 4, _mod_part(4), 4,
+                             combiner=combiner, conf=conf)
+        rng = np.random.default_rng(20 + mid)
+        for _ in range(6):
+            keys = rng.integers(0, 64, 300).astype(np.uint64)
+            payload = rng.integers(0, 255, (300, 4)).astype(np.uint8)
+            w.write_batch(keys, payload)
+        w.close()
+        assert w.metrics.spills >= 2
+        spill = resolver._shuffles[1][mid].path
+        fence, crcs, file_crc = integrity.read_sidecar(spill)
+        lengths = np.fromfile(spill + ".index", dtype=np.uint64).tolist()
+        assert crcs == integrity.partition_crcs_of_file(spill, lengths)
+        assert file_crc == integrity.file_crc32(spill)
+        assert fence == w.fence
+    resolver.stop()
