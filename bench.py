@@ -456,6 +456,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("fetch pipeline done")
     _bench_write_path(detail)
     _progress("write path done")
+    _bench_iterative(detail)
+    _progress("iterative warm done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -532,6 +534,78 @@ def _bench_fetch_pipeline(detail: dict) -> None:
         detail["fetch_rpc_requests"] = cres["requests"]
     except Exception as e:  # noqa: BLE001
         detail["fetch_rpc_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_iterative(detail: dict) -> None:
+    """The warm metadata plane's win, measured without hardware: a
+    PageRank-style 10-superstep loop re-reading one unchanged shuffle
+    over loopback with a fixed metadata service delay standing in for
+    control-plane RTT — cold (every superstep re-syncs the driver table
+    + per-peer locations) vs warm (epoch-validated local cache, ZERO
+    metadata RPCs on supersteps >= 1); see shuffle/iter_bench.py. Pure
+    host path — identical on TPU and CPU-fallback records."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.iter_bench import run_iterative_microbench
+
+        with tempfile.TemporaryDirectory(prefix="iterbench_") as td:
+            res = run_iterative_microbench(td, supersteps=10)
+        if not res["identical"]:
+            detail["iterative_warm_error"] = \
+                "cold and warm supersteps fetched different bytes"
+            return
+        if res["metadata_rpcs_per_superstep"]["warm"] != 0:
+            detail["iterative_warm_error"] = (
+                "warm supersteps issued metadata RPCs: "
+                f"{res['metadata_rpcs_per_superstep']}")
+            return
+        detail["iterative_warm_speedup"] = res["speedup"]
+        detail["iterative_metadata_rpcs"] = res["metadata_rpcs_per_superstep"]
+        detail["iterative_wall_s"] = res["wall_s_per_superstep"]
+    except Exception as e:  # noqa: BLE001
+        detail["iterative_warm_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_dense_guard(detail: dict, mesh, impl: str, small_cfg,
+                       small_rows) -> None:
+    """Dense-exchange regression guard: time the SAME small terasort
+    step under the dense and gather transports IN THIS ROUND and record
+    the ratio. The ratio cancels host noise — a dense-specific code
+    regression inflates it, uniform host contention doesn't.
+    (BENCH_r04->r05's 0.594->0.795 s 'regression' was uniform: every
+    secondary — including pure-jitted PageRank/join/TPC-DS untouched by
+    that PR — slowed ~30% while the CACHED cpu_baseline stayed frozen
+    at 0.6268 s, and r05 uniquely ran under an active recovery watcher.
+    Host contention, not a dense-exchange change; this guard plus
+    host_load_avg make the next such swing attributable per round.)"""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkrdma_tpu.models.terasort import make_terasort_step
+
+    try:
+        guard = {}
+        rows_d = jax.device_put(small_rows,
+                                NamedSharding(mesh, P("shuffle")))
+        for gimpl in ("dense", "gather"):
+            gstep = make_terasort_step(mesh, "shuffle", small_cfg,
+                                       impl=gimpl)
+            for _ in range(2):
+                np.asarray(gstep(rows_d)[1])
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(gstep(rows_d)[1])
+                times.append(time.perf_counter() - t0)
+            guard[gimpl + "_step_s"] = round(min(times), 4)
+        guard["dense_vs_gather"] = round(
+            guard["dense_step_s"] / max(guard["gather_step_s"], 1e-9), 3)
+        assert guard["dense_step_s"] > 0 and guard["gather_step_s"] > 0
+        detail["dense_exchange_guard"] = guard
+    except Exception as e:  # noqa: BLE001 — the guard enriches detail,
+        # never breaks the headline
+        detail["dense_exchange_guard_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _bench_write_path(detail: dict) -> None:
@@ -744,8 +818,18 @@ def main() -> None:
                     else "host numpy + device_put",
         # what actually ran, not the request: "auto" resolves per mesh
         "exchange_impl": _resolved_impl(mesh, impl),
+        # host contention provenance: a uniform slowdown across every
+        # workload with high load here is noise, not a regression (the
+        # BENCH_r05 lesson — its fresh numbers ran under an active
+        # recovery watcher while the cached baseline stayed frozen)
+        "host_load_avg": [round(x, 2) for x in os.getloadavg()],
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if _resolved_impl(mesh, impl) == "dense":
+        # dense-exchange step time tracked per round, noise-cancelled
+        # against gather on the same host in the same process
+        _bench_dense_guard(detail, mesh, impl, small_cfg, small_rows)
+        _progress("dense exchange guard done")
 
     if not light and os.environ.get("BENCH_SKIP_SECONDARY") != "1":
         # Secondary workloads (BASELINE.md configs #3/#4): best-effort —

@@ -17,33 +17,43 @@
 # Usage: scripts/run_chaos.sh [seed ...]
 #   CHAOS_SEEDS="0 1 2"   alternative way to pass the seed list
 #   CHAOS_COALESCE_MODES="0 1"  dataplanes to sweep (default both)
+#   CHAOS_WARM_MODES="1 0"      metadata planes to sweep (default both:
+#                               epoch-validated warm caches and the cold
+#                               pre-plane path — stale-cache scenarios
+#                               only run warm)
 #   CHAOS_DISK=0          drop the storage-fault matrix from the sweep
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 SEEDS=${*:-${CHAOS_SEEDS:-"0 1 2 3 4 5 6 7"}}
 MODES=${CHAOS_COALESCE_MODES:-"1 0"}
+WARM_MODES=${CHAOS_WARM_MODES:-"1 0"}
 DISK=${CHAOS_DISK:-1}
 failed=()
+for warm in $WARM_MODES; do
 for coalesce in $MODES; do
   for seed in $SEEDS; do
-    echo "=== chaos sweep: seed ${seed} coalesce=${coalesce} disk=${DISK} ==="
+    echo "=== chaos sweep: seed ${seed} coalesce=${coalesce}" \
+         "warm=${warm} disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
-         CHAOS_DISK="${DISK}" \
+         CHAOS_WARM="${warm}" CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
            -p no:cacheprovider -p no:randomly; then
-      echo "!!! seed ${seed} coalesce=${coalesce} FAILED — replay with:"
+      echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm} FAILED —" \
+           "replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
-           "CHAOS_DISK=${DISK}" \
+           "CHAOS_WARM=${warm} CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}")
+      failed+=("${seed}/c${coalesce}w${warm}")
     fi
   done
+done
 done
 
 if [ "${#failed[@]}" -gt 0 ]; then
   echo "chaos sweep: FAILED (seed/dataplane): ${failed[*]}"
   exit 1
 fi
-echo "chaos sweep: all seeds green on both dataplanes (disk=${DISK})"
+echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
+     "planes (disk=${DISK})"

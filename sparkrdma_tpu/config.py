@@ -260,6 +260,43 @@ _KEYS = [
              "output serves STATUS_CORRUPT (retryable) and routes into "
              "blame -> re-execution. Off by default: commits pay one "
              "streaming CRC pass when enabled."),
+    # --- metadata plane (TPU-only: epoch-versioned location tables,
+    # sharded driver state, warm iterative reuse — shuffle/location_plane.py,
+    # docs/CONFIG.md "Metadata plane")
+    _Key("location_epoch_cache", True, "bool",
+         doc="Epoch-validated local cache of location metadata (driver "
+             "table + per-map block-location entries). Warm-path reads — "
+             "superstep N over an unchanged shuffle — resolve every "
+             "location locally and put ZERO metadata RPCs on the wire; "
+             "invalidation arrives as a pushed epoch bump (executor "
+             "loss, re-execution, unregister). Off = no location "
+             "caching at all — every read re-pays the full metadata "
+             "round trips (the regression escape hatch, and what the "
+             "iterative bench's cold mode measures)."),
+    _Key("metadata_shards", 0, "int", 0, 4096,
+         doc="Shard the driver's per-shuffle location table by map-range "
+             "across up to this many executors: the driver keeps shard "
+             "assignment + commit fencing and forwards applied publishes "
+             "to shard hosts; reducers' cold-path table syncs long-poll "
+             "the shard hosts instead of serializing on the driver "
+             "endpoint. 0 = off (driver-hosted only). Any shard-host "
+             "failure falls back to the driver, which stays "
+             "authoritative."),
+    _Key("warm_read_cache", False, "bool",
+         doc="Cross-stage shuffle-output reuse (shuffle/dist_cache.py): "
+             "a reducer's materialized partition range is kept, keyed by "
+             "location epoch, and iteration N+1 over the unchanged "
+             "shuffle serves it locally instead of re-fetching — zero "
+             "RPCs, zero bytes moved. Epoch bumps (re-execution, "
+             "executor loss) invalidate; bounded by dist_cache_budget. "
+             "Off by default: it trades executor memory for superstep "
+             "latency, a profile only iterative jobs want."),
+    _Key("dist_cache_budget", "256m", "bytes", 0, 1 << 44,
+         doc="Byte budget for the worker-process shuffle cache "
+             "(dist_cache: mesh-reduce results + warm read cache). Past "
+             "it, whole-shuffle entries evict LRU (dist_cache.evicted "
+             "counts them) so cross-stage reuse can't OOM a long "
+             "iterative job. 0 disables caching entirely."),
     _Key("request_deadline_ms", 0, "int", 0, 3600_000,
          doc="Per-request completion deadline on the control plane "
              "(request/AsyncFetch waits); 0 = fall back to "

@@ -373,6 +373,22 @@ class DAGEngine:
                 log.warning("cleanup of shuffle %d failed on an executor",
                             handle.shuffle_id, exc_info=True)
 
+    def warm_stats(self) -> dict:
+        """Metadata-plane observability for iterative jobs: per-executor
+        location-plane snapshots (cache hits = metadata RPCs NOT issued
+        on warm supersteps) plus the worker cache's byte/eviction
+        counters. Pinned stages (``pin``) are the warm-path unit: their
+        shuffles survive job teardown, so superstep N+1's readers
+        resolve them from epoch-validated caches — zero location RPCs —
+        until an epoch bump (loss, re-execution) invalidates."""
+        from sparkrdma_tpu.shuffle import dist_cache
+
+        planes = {}
+        for i, ex in enumerate(self.executors):
+            if not self._is_remote(ex) and ex.native.executor is not None:
+                planes[i] = ex.native.executor.location_plane.snapshot()
+        return {"location_planes": planes, "dist_cache": dist_cache.stats()}
+
     def accumulator(self, name: str, zero=0) -> "shared_vars.Accumulator":
         """Create a driver-owned counter tasks can ``add`` to (Spark's
         longAccumulator). Deltas merge on the driver exactly once per

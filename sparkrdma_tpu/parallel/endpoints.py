@@ -41,7 +41,11 @@ from sparkrdma_tpu.parallel.transport import (
     TransportError,
     await_response,
 )
-from sparkrdma_tpu.shuffle.map_output import DriverTable, MapTaskOutput
+from sparkrdma_tpu.shuffle.map_output import (
+    MAP_ENTRY_SIZE,
+    DriverTable,
+    MapTaskOutput,
+)
 from sparkrdma_tpu.utils import trace as trace_mod
 from sparkrdma_tpu.utils.ids import ShuffleManagerId
 
@@ -132,6 +136,15 @@ class DriverEndpoint:
         self._members_lock = threading.Lock()
         self._tables: Dict[int, DriverTable] = {}
         self._tables_lock = threading.Lock()
+        # metadata plane (shuffle/location_plane.py): per-shuffle location
+        # EPOCH — the version reducers' caches validate against. Starts
+        # at 1 on register; moves ONLY when location state is repaired
+        # (an applied publish overwrites an existing entry, an executor
+        # is tombstoned) or the shuffle dies (EPOCH_DEAD). Guarded by
+        # _tables_lock (epoch and table always move together).
+        self._epochs: Dict[int, int] = {}
+        self._shard_maps: Dict[int, object] = {}  # shuffle -> ShardMap
+        self.epoch_bumps = 0  # audit: pushed invalidations
         self._clients = ConnectionCache(self.conf)
         # One broadcaster thread + a coalescing slot instead of a thread per
         # membership event: N executors joining produce O(N) sends of the
@@ -139,6 +152,13 @@ class DriverEndpoint:
         # caches for the same reason, java/RdmaNode.java:283-353).
         self._announce_cond = threading.Condition()
         self._announce_pending: Optional[Tuple[List[ShuffleManagerId], int]] = None
+        # metadata-plane pushes (epoch bumps, shard maps, shard-entry
+        # forwards) ride the SAME broadcaster thread as announces:
+        # invalidation is pushed on the existing channel, never polled,
+        # and a dead peer's connect budget can never stall a publish
+        # handler or the engine's register call. Items are
+        # (target | None, msg); None broadcasts to every live member.
+        self._push_pending: List[Tuple[Optional[ShuffleManagerId], RpcMsg]] = []
         self._announce_stop = False
         self._broadcaster = threading.Thread(
             target=self._broadcast_loop, daemon=True, name="driver-announce")
@@ -170,19 +190,67 @@ class DriverEndpoint:
 
     def register_shuffle(self, shuffle_id: int, num_maps: int) -> None:
         """Allocate the per-shuffle map-output table
-        (scala/RdmaShuffleManager.scala:168-172)."""
+        (scala/RdmaShuffleManager.scala:168-172) at epoch 1, and — with
+        ``metadata_shards`` on — assign map-range shards over the live
+        members and push the assignment so reducers aim cold-path table
+        syncs at shard hosts instead of the driver."""
+        from sparkrdma_tpu.shuffle.location_plane import ShardMap
+        shard_map = None
         with self._tables_lock:
-            if shuffle_id not in self._tables:
-                self._tables[shuffle_id] = DriverTable(num_maps)
+            if shuffle_id in self._tables:
+                return
+            self._tables[shuffle_id] = DriverTable(num_maps)
+            self._epochs[shuffle_id] = 1
+            if self.conf.metadata_shards > 0:
+                with self._members_lock:
+                    live = [i for i, m in enumerate(self._members)
+                            if m != TOMBSTONE]
+                shard_map = ShardMap.assign(num_maps, live,
+                                            self.conf.metadata_shards)
+                if shard_map is not None:
+                    self._shard_maps[shuffle_id] = shard_map
+        if shard_map is not None:
+            self._queue_push(None, M.ShardMapMsg(
+                shuffle_id, 1, num_maps, shard_map.shard_slots))
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._tables_lock:
-            self._tables.pop(shuffle_id, None)
+            known = self._tables.pop(shuffle_id, None) is not None
+            self._epochs.pop(shuffle_id, None)
+            self._shard_maps.pop(shuffle_id, None)
         # unblock long-pollers: the shuffle is gone, answer "unknown"
         with self._waiters_lock:
             waiters = self._waiters.pop(shuffle_id, [])
         for conn, req_id, _, _ in waiters:
-            self._answer_waiter(conn, M.FetchTableResp(req_id, -1, b""))
+            self._answer_waiter(conn, M.FetchTableResp(req_id, -1, b"",
+                                                       M.EPOCH_DEAD))
+        if known:
+            # terminal push: caches (location views, warm partitions,
+            # shard replicas) drop the shuffle instead of re-validating
+            # against a version that will never exist again
+            self._queue_push(None, M.EpochBumpMsg(shuffle_id,
+                                                  M.EPOCH_DEAD))
+
+    def epoch_of(self, shuffle_id: int) -> Optional[int]:
+        """The shuffle's current location-state version (None =
+        unregistered)."""
+        with self._tables_lock:
+            return self._epochs.get(shuffle_id)
+
+    def bump_epoch(self, shuffle_id: int, reason: str = "") -> Optional[int]:
+        """Advance one shuffle's epoch and push the invalidation. The
+        driver calls this itself on repair publishes and tombstones;
+        public for engines that learn of staleness out of band."""
+        with self._tables_lock:
+            if shuffle_id not in self._epochs:
+                return None
+            self._epochs[shuffle_id] += 1
+            epoch = self._epochs[shuffle_id]
+        self.epoch_bumps += 1
+        log.info("driver: epoch bump shuffle %d -> %d%s", shuffle_id,
+                 epoch, f" ({reason})" if reason else "")
+        self._queue_push(None, M.EpochBumpMsg(shuffle_id, epoch))
+        return epoch
 
     def map_entry(self, shuffle_id: int, map_id: int):
         """Current (token, exec_index) for one map, or None (unpublished
@@ -224,11 +292,25 @@ class DriverEndpoint:
         with self._members_lock:
             if manager_id not in self._members:
                 return  # unknown or already tombstoned: nothing to do
+            dead_slot = self._members.index(manager_id)
             self._members = [TOMBSTONE if m == manager_id else m
                              for m in self._members]
             self._members_epoch += 1
             snapshot, epoch = list(self._members), self._members_epoch
         self._queue_announce(snapshot, epoch)
+        # bump shuffles whose table actually NAMES the dead slot — their
+        # cached locations could route a fetch at a dead executor (the
+        # chaos matrix asserts none serves after this). Shuffles with no
+        # entry on the slot keep their epoch: invalidating them too
+        # would cold-restart every reducer's cache fleet-wide and queue
+        # O(shuffles x members) pushes for nothing.
+        with self._tables_lock:
+            sids = [sid for sid, table in self._tables.items()
+                    if any((e := table.entry(m)) is not None
+                           and e[1] == dead_slot
+                           for m in range(table.num_maps))]
+        for sid in sids:
+            self.bump_epoch(sid, reason="executor lost")
 
     # -- message handling ------------------------------------------------
 
@@ -273,22 +355,64 @@ class DriverEndpoint:
                 self._announce_pending = (snapshot, epoch)
             self._announce_cond.notify()
 
+    def _queue_push(self, target: Optional[ShuffleManagerId],
+                    msg: RpcMsg) -> None:
+        """Queue a metadata-plane push for the broadcaster thread:
+        ``target=None`` broadcasts to every live member, else one
+        directed send (shard-entry forwards). Best-effort by design —
+        a lost push is backstopped by the fetch-failure invalidation
+        path, so no retry ladder hangs off the publish handler."""
+        with self._announce_cond:
+            if self._announce_stop:
+                return
+            self._push_pending.append((target, msg))
+            self._announce_cond.notify()
+
     def _broadcast_loop(self) -> None:
         while True:
             with self._announce_cond:
-                while self._announce_pending is None and not self._announce_stop:
+                while (self._announce_pending is None
+                       and not self._push_pending
+                       and not self._announce_stop):
                     self._announce_cond.wait()
                 if self._announce_stop:
                     return
-                snapshot, epoch = self._announce_pending
+                snapshot_epoch = self._announce_pending
                 self._announce_pending = None
+                pushes, self._push_pending = self._push_pending, []
             try:
-                self._broadcast(snapshot, epoch)
+                if snapshot_epoch is not None:
+                    self._broadcast(*snapshot_epoch)
             except Exception:  # noqa: BLE001 — a bad snapshot must cost one
                 # broadcast, not the whole announce plane (the single
                 # long-lived thread would otherwise die silently)
                 log.exception("driver: announce broadcast (epoch %d) failed",
-                              epoch)
+                              snapshot_epoch[1])
+            for target, msg in pushes:
+                try:
+                    self._send_push(target, msg)
+                except Exception:  # noqa: BLE001 — same survival contract
+                    log.exception("driver: metadata push failed")
+
+    def _send_push(self, target: Optional[ShuffleManagerId],
+                   msg: RpcMsg) -> None:
+        with self._members_lock:
+            members = list(self._members)
+        targets = ([target] if target is not None
+                   else [m for m in members if m != TOMBSTONE])
+        for m in targets:
+            if self._announce_stop:
+                return
+            if m == TOMBSTONE:
+                continue
+            try:
+                self._clients.get(m.rpc_host, m.rpc_port).send(msg)
+            except TransportError as e:
+                # one attempt only: the peer may be mid-death (the very
+                # event some pushes announce); its reducers heal via the
+                # fetch-failure invalidation backstop
+                log.debug("driver: push %s to %s:%s failed: %s",
+                          type(msg).__name__, m.rpc_host, m.rpc_port, e)
 
     def _broadcast(self, members: List[ShuffleManagerId], epoch: int) -> None:
         announce = AnnounceMsg(members, epoch)
@@ -349,6 +473,7 @@ class DriverEndpoint:
                         "map %d", len(msg.entry), msg.shuffle_id, msg.map_id)
             return None
         token, exec_index = _MAP_ENTRY.unpack(msg.entry)
+        old = table.entry(msg.map_id)
         try:
             accepted = table.publish(msg.map_id, token, exec_index,
                                      fence=msg.fence)
@@ -364,6 +489,29 @@ class DriverEndpoint:
                         "%d (exec %d fence %d)", msg.shuffle_id, msg.map_id,
                         exec_index, msg.fence)
             return None
+        # epoch semantics: a publish that OVERWROTE a live entry is a
+        # REPAIR (re-execution after loss or corrupt output, elastic
+        # rejoin under new tokens) — bump + push so epoch-validated
+        # caches refresh. First-time publishes and identical republishes
+        # move no state reducers could have cached against.
+        epoch = self.epoch_of(msg.shuffle_id) or 1
+        if old is not None and old != (token, exec_index):
+            epoch = self.bump_epoch(msg.shuffle_id,
+                                    reason="repair publish") or epoch
+        # sharded driver state: the fence CAS above is the driver's
+        # authority — only surviving publishes are forwarded into the
+        # owning shard host's replica (one directed positional write,
+        # the reference's table WRITE re-aimed at a shard host)
+        with self._tables_lock:
+            shard_map = self._shard_maps.get(msg.shuffle_id)
+        if shard_map is not None:
+            with self._members_lock:
+                members = list(self._members)
+            slot = shard_map.slot_of_map(msg.map_id)
+            if slot < len(members) and members[slot] != TOMBSTONE:
+                self._queue_push(members[slot], M.ShardEntryMsg(
+                    msg.shuffle_id, epoch, msg.map_id, table.num_maps,
+                    msg.entry))
         # push: answer any long-poller this publish satisfies (the write
         # above happens-before the waiter scan; _on_fetch_table re-checks
         # the count inside the same lock, so no wakeup can be lost)
@@ -382,22 +530,43 @@ class DriverEndpoint:
             count, table_bytes = table.num_published, table.to_bytes()
             for conn, req_id, _, _ in ready:
                 self._answer_waiter(conn, M.FetchTableResp(
-                    req_id, count, table_bytes))
+                    req_id, count, table_bytes, epoch))
         return None
 
     def _on_fetch_table(self, conn: Connection,
                         msg: M.FetchTableReq) -> Optional[RpcMsg]:
         with self._tables_lock:
             table = self._tables.get(msg.shuffle_id)
+            epoch = self._epochs.get(msg.shuffle_id, 0)
         if table is None:
-            return M.FetchTableResp(msg.req_id, -1, b"")
+            return M.FetchTableResp(msg.req_id, -1, b"", M.EPOCH_DEAD)
         with self._waiters_lock:
             n = table.num_published
             if n >= msg.min_published or msg.timeout_ms <= 0:
-                return M.FetchTableResp(msg.req_id, n, table.to_bytes())
+                return M.FetchTableResp(msg.req_id, n, table.to_bytes(),
+                                        epoch)
             deadline = time.monotonic() + msg.timeout_ms / 1000
-            self._waiters.setdefault(msg.shuffle_id, []).append(
-                (conn, msg.req_id, msg.min_published, deadline))
+            waiter = (conn, msg.req_id, msg.min_published, deadline)
+            self._waiters.setdefault(msg.shuffle_id, []).append(waiter)
+        # unregister-race re-check: unregister_shuffle pops the table
+        # (tables lock) and THEN wakes waiters (waiters lock) — a poll
+        # that read the table before the pop but registered after the
+        # wake would sit out its whole deadline for a shuffle that is
+        # already gone. Re-reading the registry after registration
+        # closes the window: whoever pops the waiter (us here, or the
+        # unregister that raced in between) answers it, exactly once.
+        with self._tables_lock:
+            gone = msg.shuffle_id not in self._tables
+        if gone:
+            with self._waiters_lock:
+                pending = self._waiters.get(msg.shuffle_id, [])
+                mine = waiter in pending
+                if mine:
+                    pending.remove(waiter)
+                    if not pending:
+                        self._waiters.pop(msg.shuffle_id, None)
+            if mine:
+                return M.FetchTableResp(msg.req_id, -1, b"", M.EPOCH_DEAD)
         return None  # answered later by a publish or the sweeper
 
     def _answer_waiter(self, conn: Connection, resp: RpcMsg) -> None:
@@ -419,19 +588,20 @@ class DriverEndpoint:
                     if dead:
                         with self._tables_lock:
                             table = self._tables.get(sid)
-                        expired.append((table, dead))
+                            epoch = self._epochs.get(sid, M.EPOCH_DEAD)
+                        expired.append((table, epoch, dead))
                         if live:
                             self._waiters[sid] = live
                         else:
                             self._waiters.pop(sid, None)
-            for table, dead in expired:
+            for table, epoch, dead in expired:
                 if table is None:
                     count, table_bytes = -1, b""
                 else:
                     count, table_bytes = table.num_published, table.to_bytes()
                 for conn, req_id, _, _ in dead:
                     self._answer_waiter(conn, M.FetchTableResp(
-                        req_id, count, table_bytes))
+                        req_id, count, table_bytes, epoch))
 
     def stop(self) -> None:
         with self._announce_cond:
@@ -538,7 +708,18 @@ class ExecutorEndpoint:
         self._members_event = threading.Event()
         self._members_lock = threading.Lock()
         self._clients = ConnectionCache(self.conf, on_message=self._handle)
-        self._table_cache: Dict[int, DriverTable] = {}
+        # metadata plane (shuffle/location_plane.py): the epoch-validated
+        # local cache of driver tables + block-location entries (the
+        # warm-path zero-RPC store), and this executor's driver-table
+        # shard replicas (fed by the driver's ShardEntryMsg forwards,
+        # served to peers' FetchShardReq long-polls)
+        from sparkrdma_tpu.shuffle.location_plane import (
+            LocationPlane, ShardStore)
+        self.location_plane = LocationPlane(
+            enabled=bool(self.conf.location_epoch_cache))
+        self.shard_store = ShardStore()
+        self._shard_waiters: Dict[int, list] = {}
+        self._shard_waiters_lock = threading.Lock()
         # invalidation generation: a long-poll answered with a
         # PRE-invalidation table must not re-memoize after the
         # invalidation (stage recovery repaired the driver table; a stale
@@ -868,6 +1049,20 @@ class ExecutorEndpoint:
             if self.conf.pre_warm_connections:
                 self._prewarm_peers()
             return None
+        if isinstance(msg, M.EpochBumpMsg):
+            self._on_epoch_bump(msg)
+            return None
+        if isinstance(msg, M.ShardMapMsg):
+            from sparkrdma_tpu.shuffle.location_plane import ShardMap
+            self.location_plane.put_shard_map(
+                msg.shuffle_id, ShardMap(msg.num_maps, msg.shard_slots),
+                msg.epoch)
+            return None
+        if isinstance(msg, M.ShardEntryMsg):
+            self._on_shard_entry(msg)
+            return None
+        if isinstance(msg, M.FetchShardReq):
+            return self._on_fetch_shard(conn, msg)
         if isinstance(msg, M.FetchOutputReq):
             return self._on_fetch_output(msg)
         if isinstance(msg, M.FetchOutputsReq):
@@ -890,7 +1085,7 @@ class ExecutorEndpoint:
         if isinstance(msg, M.PongMsg):
             return None  # pong landed after its ping's deadline: stale
         if isinstance(msg, (M.FetchOutputResp, M.FetchOutputsResp,
-                            M.FetchTableResp)):
+                            M.FetchTableResp, M.FetchShardResp)):
             # orphan of a cancelled/timed-out request (the fetcher
             # cancels whole read-ahead windows on failure); unlike block
             # responses these carry no credits, so dropping is complete
@@ -937,6 +1132,110 @@ class ExecutorEndpoint:
 
         self._task_pool.submit(work)
         return None  # answered by the worker when the task finishes
+
+    # -- metadata plane (epoch pushes + shard replicas) ------------------
+
+    def _on_epoch_bump(self, msg: M.EpochBumpMsg) -> None:
+        """A pushed invalidation: the shuffle's location state moved (or
+        died). Epoch-validated caches — location views here, warm
+        partition ranges in dist_cache — refresh on their next read
+        instead of serving a dead executor's locations."""
+        invalidated = self.location_plane.note_epoch(msg.shuffle_id,
+                                                     msg.epoch)
+        if msg.epoch == M.EPOCH_DEAD:
+            self.shard_store.drop(msg.shuffle_id)
+            self._expire_shard_waiters(msg.shuffle_id)
+        from sparkrdma_tpu.shuffle import dist_cache
+        dist_cache.on_epoch(msg.shuffle_id, msg.epoch)
+        if invalidated:
+            self.tracer.instant("meta.epoch_bump", "meta",
+                                shuffle=msg.shuffle_id, epoch=msg.epoch)
+
+    def _on_shard_entry(self, msg: M.ShardEntryMsg) -> None:
+        self.shard_store.apply(msg.shuffle_id, msg.epoch, msg.map_id,
+                               msg.num_maps, msg.entry)
+        # wake any shard long-poller this entry satisfies (push, not
+        # client polling — the driver's waiter contract, at shard scale)
+        ready = []
+        with self._shard_waiters_lock:
+            pending = self._shard_waiters.get(msg.shuffle_id)
+            if pending:
+                still = []
+                for w in pending:
+                    conn, req_id, lo, hi, min_pub, _deadline = w
+                    n = self.shard_store.count_in(msg.shuffle_id, lo, hi)
+                    if n is not None and n >= min_pub:
+                        ready.append(w)
+                    else:
+                        still.append(w)
+                if still:
+                    self._shard_waiters[msg.shuffle_id] = still
+                else:
+                    self._shard_waiters.pop(msg.shuffle_id, None)
+        for conn, req_id, lo, hi, _min_pub, _deadline in ready:
+            self._answer_shard_waiter(msg.shuffle_id, conn, req_id, lo, hi)
+
+    def _answer_shard_waiter(self, shuffle_id: int, conn: Connection,
+                             req_id: int, lo: int, hi: int) -> None:
+        res = self.shard_store.read_range(shuffle_id, lo, hi)
+        if res is None:
+            resp = M.FetchShardResp(req_id, -1, 0, b"")
+        else:
+            n, epoch, data = res
+            resp = M.FetchShardResp(req_id, n, epoch, data)
+        try:
+            conn.send(resp)
+        except TransportError as e:
+            log.debug("shard long-poll answer failed: %s", e)
+
+    def _on_fetch_shard(self, conn: Connection,
+                        msg: M.FetchShardReq) -> Optional[RpcMsg]:
+        """Serve one driver-table map-range out of this executor's shard
+        replica — the fan-in distribution half of the sharded metadata
+        plane. Long-poll semantics mirror the driver's table fetch:
+        unsatisfiable requests park as waiters answered by the entry
+        forward that satisfies them (or swept at deadline with the
+        partial range)."""
+        res = self.shard_store.read_range(msg.shuffle_id, msg.map_lo,
+                                          msg.map_hi)
+        if res is None:
+            # no replica here (never assigned, or dropped): the client
+            # falls back to the authoritative driver table
+            return M.FetchShardResp(msg.req_id, -1, 0, b"")
+        n, epoch, data = res
+        if n >= msg.min_published or msg.timeout_ms <= 0:
+            return M.FetchShardResp(msg.req_id, n, epoch, data)
+        deadline = time.monotonic() + msg.timeout_ms / 1000
+        with self._shard_waiters_lock:
+            self._shard_waiters.setdefault(msg.shuffle_id, []).append(
+                (conn, msg.req_id, msg.map_lo, msg.map_hi,
+                 msg.min_published, deadline))
+        self._ensure_park_sweeper()  # the shared sweeper expires these
+        return None
+
+    def _expire_shard_waiters(self, shuffle_id: Optional[int] = None,
+                              now: Optional[float] = None) -> None:
+        """Answer shard waiters that expired (``now``) or whose shuffle
+        died (``shuffle_id``) with the partial range — the terminal
+        status contract of the driver's sweeper, at shard scale."""
+        expired = []
+        with self._shard_waiters_lock:
+            for sid, pending in list(self._shard_waiters.items()):
+                if shuffle_id is not None and sid != shuffle_id:
+                    continue
+                if shuffle_id is not None:
+                    dead, live = pending, []
+                else:
+                    dead = [w for w in pending if w[5] <= now]
+                    live = [w for w in pending if w[5] > now]
+                if dead:
+                    expired.extend((sid, w) for w in dead)
+                    if live:
+                        self._shard_waiters[sid] = live
+                    else:
+                        self._shard_waiters.pop(sid, None)
+        for sid, (conn, req_id, lo, hi, _min_pub, _dl) in expired:
+            self._answer_shard_waiter(sid, conn, req_id, lo, hi)
 
     def _corrupt_served(self, shuffle_id: int, map_id: int,
                         detail: str) -> None:
@@ -1136,6 +1435,10 @@ class ExecutorEndpoint:
                         expire()
                     except Exception:  # noqa: BLE001 — sweeper must live
                         log.exception("park expiry callback failed")
+            try:
+                self._expire_shard_waiters(now=now)
+            except Exception:  # noqa: BLE001 — sweeper must live
+                log.exception("shard waiter expiry failed")
 
     def _on_fetch_blocks(self, msg: M.FetchBlocksReq) -> RpcMsg:
         """Serve a scatter data read (DCN fallback of the one-sided READ,
@@ -1216,25 +1519,62 @@ class ExecutorEndpoint:
         conn.send(msg)
 
     def get_driver_table(self, shuffle_id: int, expect_published: int,
-                         timeout: Optional[float] = None) -> DriverTable:
-        """One long-poll: the driver holds the response until the expected
-        publishes have landed (push on publish, not client polling — the
-        event-driven analogue of the reference's READ-once-after-known-
-        complete, scala/RdmaShuffleManager.scala:341-376; wait budget
-        partitionLocationFetchTimeout, scala/RdmaShuffleConf.scala:112-115).
-        Memoized per shuffle only once ALL maps have published, so a later
-        call with a higher expectation never sees a stale partial table."""
-        with self._table_lock:
-            cached = self._table_cache.get(shuffle_id)
-            gen = self._table_gen
-        if cached is not None and cached.num_published >= expect_published:
+                         timeout: Optional[float] = None,
+                         metrics=None) -> DriverTable:
+        """The table of :meth:`get_driver_table_v` (compat shape)."""
+        return self.get_driver_table_v(shuffle_id, expect_published,
+                                       timeout, metrics)[0]
+
+    def get_driver_table_v(self, shuffle_id: int, expect_published: int,
+                           timeout: Optional[float] = None,
+                           metrics=None) -> Tuple[DriverTable, int]:
+        """``(table, epoch)`` for one shuffle — warm path first.
+
+        Warm: the location plane holds a complete epoch-current table —
+        zero RPCs. Cold: with a shard map, one long-poll per SHARD HOST
+        (fan-in spreads off the driver; any shard failure falls back);
+        else the driver long-poll — the driver holds the response until
+        the expected publishes have landed (push on publish, not client
+        polling — the event-driven analogue of the reference's
+        READ-once-after-known-complete,
+        scala/RdmaShuffleManager.scala:341-376; wait budget
+        partitionLocationFetchTimeout). Complete tables memoize into the
+        plane under the response's epoch, unless an invalidation raced
+        the poll. ``metrics`` (a fetcher's ReadMetrics) counts the
+        metadata RPCs actually issued — a cache hit counts zero."""
+        cached = self.location_plane.table(shuffle_id)
+        if cached is not None and cached[0].num_published >= expect_published:
             return cached
+        with self._table_lock:
+            gen = self._table_gen
         tmo = (timeout if timeout is not None
                else self.conf.partition_location_fetch_timeout_ms / 1000)
         deadline = time.monotonic() + tmo
+        shard_map = self.location_plane.shard_map(shuffle_id)
+        if shard_map is not None:
+            # the shard phase may spend at most HALF the budget: a shard
+            # replica that never satisfies its long-poll (a lost forward
+            # — pushes are one-attempt) must leave the authoritative
+            # driver fallback real time, or one lost push would turn
+            # every cold sync into a TimeoutError
+            sharded = self._fetch_table_sharded(
+                shuffle_id, shard_map, expect_published,
+                deadline - tmo / 2, metrics)
+            if sharded is not None:
+                table, epoch = sharded
+                if table.num_published == table.num_maps:
+                    with self._table_lock:
+                        if self._table_gen == gen:
+                            self.location_plane.put_table(shuffle_id,
+                                                          table, epoch)
+                return table, epoch
+            # fall through: shard host lost/lagging — the driver is
+            # authoritative
         conn = self.driver_conn()
         while True:
             remaining = deadline - time.monotonic()
+            if metrics is not None:
+                metrics.record_metadata_rpc()
             resp = conn.request(
                 M.FetchTableReq(conn.next_req_id(), shuffle_id,
                                 min_published=expect_published,
@@ -1250,8 +1590,9 @@ class ExecutorEndpoint:
                         # (recovery may have repaired the driver table
                         # after our response was cut)
                         if self._table_gen == gen:
-                            self._table_cache[shuffle_id] = table
-                return table
+                            self.location_plane.put_table(
+                                shuffle_id, table, resp.epoch)
+                return table, resp.epoch
             if resp.num_published < 0:
                 # driver doesn't know the shuffle (unregistered mid-poll or
                 # never registered): re-arming would spin, fail now
@@ -1264,14 +1605,69 @@ class ExecutorEndpoint:
             # partial answer before the deadline (sweeper raced a publish
             # burst): re-arm the long-poll for the remaining budget
 
+    def _fetch_table_sharded(self, shuffle_id: int, shard_map,
+                             expect_published: int, deadline: float,
+                             metrics=None
+                             ) -> Optional[Tuple[DriverTable, int]]:
+        """Assemble the driver table from shard-host replicas: one
+        long-poll per shard (contiguous map ranges concatenate back into
+        the positional table). Returns None on ANY shard miss — dead
+        host, no replica, lagging count — and the caller falls back to
+        the authoritative driver. The assembled epoch is the MINIMUM
+        across shards: a lagging replica must make the view look older,
+        never newer, so a pushed bump still invalidates it."""
+        parts: List[bytes] = []
+        total = 0
+        epoch: Optional[int] = None
+        for shard in range(shard_map.num_shards):
+            lo, hi = shard_map.range_of(shard)
+            # distribute the completeness expectation: a full-table
+            # expectation holds each shard for its whole range; anything
+            # lower (recovery's expect=0 probes) reads what's there
+            min_pub = (hi - lo) if expect_published >= shard_map.num_maps \
+                else 0
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                peer = self.member_at(shard_map.shard_slots[shard])
+                conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+                if metrics is not None:
+                    metrics.record_metadata_rpc()
+                resp = conn.request(
+                    M.FetchShardReq(conn.next_req_id(), shuffle_id, lo, hi,
+                                    min_published=min_pub,
+                                    timeout_ms=max(1, int(remaining * 1000))),
+                    timeout=max(0.05, remaining) + 5.0)
+            except (DeadExecutorError, IndexError, TransportError,
+                    TimeoutError) as e:
+                log.debug("shard %d of shuffle %d unreadable (%s); driver "
+                          "fallback", shard, shuffle_id, e)
+                return None
+            if not isinstance(resp, M.FetchShardResp) \
+                    or resp.num_published < min_pub \
+                    or len(resp.table) != (hi - lo) * MAP_ENTRY_SIZE:
+                return None
+            parts.append(resp.table)
+            total += resp.num_published
+            epoch = resp.epoch if epoch is None else min(epoch, resp.epoch)
+        if total < expect_published:
+            return None
+        return DriverTable.from_bytes(b"".join(parts)), epoch or 0
+
     def invalidate_shuffle(self, shuffle_id: int) -> None:
-        """Drop the memoized driver table (stage recovery repaired it, or
-        the shuffle unregistered; ids can be reused by the engine). Bumps
-        the generation so an in-flight long-poll answered with the
-        pre-invalidation table cannot re-memoize it."""
+        """Drop every cached location view of the shuffle (stage recovery
+        repaired it, or the shuffle unregistered; ids can be reused by
+        the engine). Bumps the generation so an in-flight long-poll
+        answered with the pre-invalidation table cannot re-memoize it,
+        and drops the worker-process shuffle cache (mesh results + warm
+        partition ranges) — stale bytes must not serve after a map
+        recomputes."""
         with self._table_lock:
-            self._table_cache.pop(shuffle_id, None)
             self._table_gen += 1
+        self.location_plane.invalidate(shuffle_id)
+        from sparkrdma_tpu.shuffle import dist_cache
+        dist_cache.drop(shuffle_id)
 
     def _failed_fetch(self, exc: TransportError) -> AsyncFetch:
         """An AsyncFetch that already failed (the dial threw before a

@@ -30,6 +30,26 @@ remain exactly the reference's three-level scheme:
                         servers gather the ranges in request order into a
                         single response with a per-sub-block CRC32 trailer.
 
+The METADATA PLANE (shuffle/location_plane.py) adds the one-sided
+publication frames that remove the request/reply cycle from warm-path
+location resolution ("RPC Considered Harmful", PAPERS.md):
+
+* ``EpochBumpMsg``     — driver -> executors push: shuffle S's location
+                        state is now version E (or gone, E = EPOCH_DEAD).
+                        Rides the same broadcast channel as announces, so
+                        invalidation is pushed, never polled.
+* ``ShardMapMsg``      — driver -> executors push at registerShuffle: the
+                        map-range -> shard-host assignment, so a reducer
+                        knows whom to ask without a driver round trip.
+* ``ShardEntryMsg``    — driver -> shard host: one applied driver-table
+                        entry forwarded into the host's shard replica (the
+                        positional WRITE of the reference, re-aimed at a
+                        shard host instead of the one driver table).
+* ``FetchShardReq/Resp`` — reducer -> shard host: long-poll read of one
+                        driver-table map-range out of the shard replica —
+                        thousand-reducer fan-in spreads over shard hosts
+                        instead of serializing on the driver endpoint.
+
 All carry a ``req_id`` echo so clients can pipeline requests per connection
 the way the reference pipelines work requests on a QP.
 """
@@ -128,20 +148,28 @@ class FetchTableReq(RpcMsg):
 class FetchTableResp(RpcMsg):
     """num_published lets clients poll until the maps they need have
     committed (client-side analogue of the reference's wait on
-    partitionLocationFetchTimeout)."""
+    partitionLocationFetchTimeout). ``epoch`` stamps the table bytes with
+    the shuffle's location-state version (location_plane): a reducer
+    caches the table under this epoch and serves later supersteps from
+    the cache until an ``EpochBumpMsg`` invalidates it."""
 
-    def __init__(self, req_id: int, num_published: int, table: bytes):
+    def __init__(self, req_id: int, num_published: int, table: bytes,
+                 epoch: int = 0):
         self.req_id = req_id
         self.num_published = num_published
         self.table = table
+        self.epoch = epoch
 
     def payload(self) -> bytes:
-        return _QI.pack(self.req_id, self.num_published) + self.table
+        return (_QI.pack(self.req_id, self.num_published)
+                + _Q.pack(self.epoch) + self.table)
 
     @classmethod
     def from_payload(cls, payload: bytes) -> "FetchTableResp":
         req_id, num_published = _QI.unpack_from(payload, 0)
-        return cls(req_id, num_published, payload[_QI.size:])
+        (epoch,) = _Q.unpack_from(payload, _QI.size)
+        return cls(req_id, num_published, payload[_QI.size + _Q.size:],
+                   epoch)
 
 
 @register(7)
@@ -447,6 +475,155 @@ class FetchOutputsResp(RpcMsg):
             records.append((map_id, mstatus, payload[off:off + nbytes]))
             off += nbytes
         return cls(req_id, status, records)
+
+
+# Epoch sentinel: the shuffle is unregistered — caches drop their state
+# entirely instead of re-validating against a version that will never
+# exist again.
+EPOCH_DEAD = -1
+
+
+@register(20)
+class EpochBumpMsg(RpcMsg):
+    """Driver -> executors push: shuffle ``shuffle_id``'s location state
+    is now version ``epoch`` (monotone per shuffle; ``EPOCH_DEAD`` =
+    unregistered). Sent on the announce/broadcast channel whenever the
+    driver table is REPAIRED (re-execution overwrote an entry), an
+    executor is tombstoned, or the shuffle unregisters — the push that
+    replaces cache-TTL polling (invalidation is an event, not a timer).
+    One-sided like a publish: no reply, problems observable driver-side
+    only; a lost push is backstopped by the fetch-failure path (a stale
+    location fails its fetch, which invalidates the cache the hard
+    way)."""
+
+    def __init__(self, shuffle_id: int, epoch: int):
+        self.shuffle_id = shuffle_id
+        self.epoch = epoch
+
+    def payload(self) -> bytes:
+        return struct.pack("<iq", self.shuffle_id, self.epoch)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "EpochBumpMsg":
+        shuffle_id, epoch = struct.unpack_from("<iq", payload, 0)
+        return cls(shuffle_id, epoch)
+
+
+@register(21)
+class ShardMapMsg(RpcMsg):
+    """Driver -> executors push at registerShuffle time: the map-range ->
+    shard-host assignment for one shuffle (location_plane.ShardMap wire
+    form). Reducers use it to aim cold-path table reads at shard hosts
+    instead of the driver; executors that never receive it (late
+    joiners) simply stay on the driver path — the shard plane is an
+    optimization, the driver remains authoritative."""
+
+    def __init__(self, shuffle_id: int, epoch: int, num_maps: int,
+                 shard_slots: List[int]):
+        self.shuffle_id = shuffle_id
+        self.epoch = epoch
+        self.num_maps = num_maps
+        self.shard_slots = list(shard_slots)
+
+    def payload(self) -> bytes:
+        head = struct.pack("<iqiI", self.shuffle_id, self.epoch,
+                           self.num_maps, len(self.shard_slots))
+        return head + struct.pack(f"<{len(self.shard_slots)}i",
+                                  *self.shard_slots)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ShardMapMsg":
+        shuffle_id, epoch, num_maps, n = struct.unpack_from("<iqiI",
+                                                            payload, 0)
+        slots = list(struct.unpack_from(f"<{n}i", payload, 20))
+        return cls(shuffle_id, epoch, num_maps, slots)
+
+
+@register(22)
+class ShardEntryMsg(RpcMsg):
+    """Driver -> shard host: one APPLIED driver-table entry forwarded
+    into the host's shard replica (the driver stays the fencing
+    authority — only publishes that survived the fence CAS are
+    forwarded, so replicas can never serve a zombie attempt's
+    location). One-sided, no reply; ``num_maps`` lets the replica answer
+    shard completeness without ever having seen the ShardMapMsg."""
+
+    def __init__(self, shuffle_id: int, epoch: int, map_id: int,
+                 num_maps: int, entry: bytes):
+        self.shuffle_id = shuffle_id
+        self.epoch = epoch
+        self.map_id = map_id
+        self.num_maps = num_maps
+        self.entry = entry
+
+    def payload(self) -> bytes:
+        return struct.pack("<iqii", self.shuffle_id, self.epoch,
+                           self.map_id, self.num_maps) + self.entry
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ShardEntryMsg":
+        shuffle_id, epoch, map_id, num_maps = struct.unpack_from(
+            "<iqii", payload, 0)
+        return cls(shuffle_id, epoch, map_id, num_maps, payload[20:])
+
+
+@register(23)
+class FetchShardReq(RpcMsg):
+    """Reducer -> shard host: long-poll read of driver-table entries
+    [map_lo, map_hi) out of the host's shard replica. Same long-poll
+    contract as ``FetchTableReq`` (``min_published`` counts published
+    maps WITHIN the range; ``timeout_ms`` bounds the hold) so a reducer
+    syncs each shard with one request instead of polling — and the
+    thousand-reducer fan-in lands on shard hosts, not the driver."""
+
+    def __init__(self, req_id: int, shuffle_id: int, map_lo: int,
+                 map_hi: int, min_published: int = 0, timeout_ms: int = 0):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+        self.map_lo = map_lo
+        self.map_hi = map_hi
+        self.min_published = min_published
+        self.timeout_ms = timeout_ms
+
+    def payload(self) -> bytes:
+        return (_QI.pack(self.req_id, self.shuffle_id)
+                + struct.pack("<iiii", self.map_lo, self.map_hi,
+                              self.min_published, self.timeout_ms))
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchShardReq":
+        req_id, shuffle_id = _QI.unpack_from(payload, 0)
+        map_lo, map_hi, min_published, timeout_ms = struct.unpack_from(
+            "<iiii", payload, _QI.size)
+        return cls(req_id, shuffle_id, map_lo, map_hi, min_published,
+                   timeout_ms)
+
+
+@register(24)
+class FetchShardResp(RpcMsg):
+    """``num_published`` counts published maps within the requested
+    range (-1 = the host holds no replica for the shuffle — the client
+    falls back to the driver); ``table`` is the range's MAP_ENTRY_SIZE
+    entries in map order, UNPUBLISHED-filled where nothing has been
+    forwarded yet; ``epoch`` stamps the replica's version."""
+
+    def __init__(self, req_id: int, num_published: int, epoch: int,
+                 table: bytes):
+        self.req_id = req_id
+        self.num_published = num_published
+        self.epoch = epoch
+        self.table = table
+
+    def payload(self) -> bytes:
+        return (_QI.pack(self.req_id, self.num_published)
+                + _Q.pack(self.epoch) + self.table)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchShardResp":
+        req_id, num_published = _QI.unpack_from(payload, 0)
+        (epoch,) = _Q.unpack_from(payload, _QI.size)
+        return cls(req_id, num_published, epoch,
+                   payload[_QI.size + _Q.size:])
 
 
 # Status codes shared by responses.

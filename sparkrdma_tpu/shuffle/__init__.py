@@ -5,3 +5,9 @@ from sparkrdma_tpu.shuffle.map_output import (  # noqa: F401
     ENTRY_SIZE,
     MAP_ENTRY_SIZE,
 )
+from sparkrdma_tpu.shuffle.location_plane import (  # noqa: F401
+    EPOCH_DEAD,
+    LocationPlane,
+    ShardMap,
+    ShardStore,
+)

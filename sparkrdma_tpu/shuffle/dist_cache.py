@@ -1,30 +1,100 @@
-"""Worker-process cache of distributed-mesh-reduce results.
+"""Worker-process cache of shuffle bytes: mesh-reduce results and
+warm iterative reuse.
 
-In the engine's distributed mesh mode each executor PROCESS enters one
-global-mesh collective per parent shuffle (`engine._dist_mesh_reduce`
-ships the collective closure; `parallel/multihost.py` is the data plane).
-The rows a process receives are ITS partitions — this module keeps them
-until the shuffle is invalidated or unregistered, and the worker-side
-task context serves reduce reads from here (falling back to the TCP
-fetcher for partitions another process owns).
+Two stores, one byte budget:
 
-The per-shuffle granularity mirrors the driver's `_MeshCell` cache for
-the in-process mesh mode; cross-process, the cache must live in the
-worker because the driver never holds these rows at all (that is the
-point — the data plane is device-to-device over the collective,
-reference README.md:11-31's NIC-to-NIC redistribution).
+* **Mesh-reduce results** (the original role): in distributed mesh mode
+  each executor PROCESS enters one global-mesh collective per parent
+  shuffle (`engine._dist_mesh_reduce` ships the collective closure;
+  `parallel/multihost.py` is the data plane). The rows a process
+  receives are ITS partitions — kept here until the shuffle is
+  invalidated or unregistered; the worker-side task context serves
+  reduce reads from here (falling back to the TCP fetcher for
+  partitions another process owns).
+
+* **Warm read ranges** (cross-stage shuffle-output reuse,
+  ``warm_read_cache``): a reducer's materialized partition range, keyed
+  by the location EPOCH it was read under (shuffle/location_plane.py).
+  Iteration N+1 over an unchanged shuffle serves the bytes locally —
+  zero RPCs, zero bytes moved — exactly the resident-redistribution-
+  state idea of "Memory-efficient array redistribution" (PAPERS.md).
+  An epoch bump (re-execution, executor loss) makes every stale entry
+  unservable; ``on_epoch`` evicts them eagerly when the push arrives.
+
+Memory is BOUNDED: entries are accounted by payload bytes and whole
+shuffles evict least-recently-used once the budget (``configure``, conf
+``dist_cache_budget``) is exceeded — a long iterative job reusing
+hundreds of shuffles trades cache misses, never an OOM. ``evicted``
+counts budget evictions (surfaced via ``stats()``).
 """
 
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 _lock = threading.Lock()
-# shuffle_id -> partition -> (keys u64[N], payload u8[N, W])
-_cache: Dict[int, Dict[int, Tuple[np.ndarray, np.ndarray]]] = {}
+# shuffle_id -> partition -> (keys u64[N], payload u8[N, W])   (mesh)
+_cache: "OrderedDict[int, Dict[int, Tuple[np.ndarray, np.ndarray]]]" = \
+    OrderedDict()
+# shuffle_id -> (start, end) -> (epoch, keys, payload)         (warm)
+_ranges: "OrderedDict[int, Dict[Tuple[int, int], Tuple[int, np.ndarray, np.ndarray]]]" = OrderedDict()
+# byte accounting per shuffle per store (LRU evicts whole shuffles: the
+# unit invalidation works at, so eviction can never leave a half-valid
+# shuffle behind)
+_bytes: Dict[Tuple[str, int], int] = {}
+_budget = 256 << 20
+evicted = 0  # budget evictions (NOT invalidations/drops), monotone
+
+
+def configure(budget_bytes: int) -> None:
+    """Set the byte budget (conf ``dist_cache_budget``; 0 disables both
+    stores). Shrinking evicts immediately."""
+    global _budget
+    with _lock:
+        _budget = max(0, int(budget_bytes))
+        _evict_to_budget_locked()
+
+
+def _nbytes(*arrays: np.ndarray) -> int:
+    return sum(int(a.nbytes) for a in arrays)
+
+
+def _total_locked() -> int:
+    return sum(_bytes.values())
+
+
+def _evict_to_budget_locked(need: int = 0) -> None:
+    """Drop least-recently-used shuffles (across both stores, oldest
+    touch first) until ``need`` more bytes fit the budget."""
+    global evicted
+    while _bytes and _total_locked() + need > _budget:
+        # the least-recently-touched shuffle across both stores
+        candidates: List[Tuple[str, int]] = []
+        if _cache:
+            candidates.append(("mesh", next(iter(_cache))))
+        if _ranges:
+            candidates.append(("warm", next(iter(_ranges))))
+        if not candidates:
+            break
+        # OrderedDict iteration order IS recency order (oldest first);
+        # with one candidate per store, evict the one carrying bytes —
+        # prefer the warm store (re-fetchable for the price of RPCs)
+        # over mesh results (re-entering a collective costs the group)
+        kind, sid = max(candidates,
+                        key=lambda c: (c[0] == "warm", _bytes.get(c, 0)))
+        if kind == "mesh":
+            _cache.pop(sid, None)
+        else:
+            _ranges.pop(sid, None)
+        _bytes.pop((kind, sid), None)
+        evicted += 1
+
+
+# -- mesh-reduce results (distributed mesh mode) -------------------------
 
 
 def store(shuffle_id: int, device_results: List[tuple]) -> List[int]:
@@ -37,6 +107,7 @@ def store(shuffle_id: int, device_results: List[tuple]) -> List[int]:
     sorted partition ids this process now serves.
     """
     by_part: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+    total = 0
     for keys, payload, parts in device_results:
         if not len(keys):
             continue
@@ -46,10 +117,20 @@ def store(shuffle_id: int, device_results: List[tuple]) -> List[int]:
         bounds = np.r_[starts, len(parts)]
         for i, s in enumerate(starts):
             seg = slice(int(s), int(bounds[i + 1]))
-            by_part[int(parts[s])] = (keys[seg].copy(),
-                                      payload[seg].copy())
+            k, p = keys[seg].copy(), payload[seg].copy()
+            by_part[int(parts[s])] = (k, p)
+            total += _nbytes(k, p)
     with _lock:
+        if total > _budget:
+            # a single oversized shuffle can never fit: don't thrash the
+            # whole cache out for it (callers fall back to the fetcher)
+            _cache.pop(shuffle_id, None)
+            _bytes.pop(("mesh", shuffle_id), None)
+            return sorted(by_part)
+        _evict_to_budget_locked(total - _bytes.get(("mesh", shuffle_id), 0))
         _cache[shuffle_id] = by_part
+        _cache.move_to_end(shuffle_id)
+        _bytes[("mesh", shuffle_id)] = total
     return sorted(by_part)
 
 
@@ -61,6 +142,7 @@ def get(shuffle_id: int, partition: int
         parts = _cache.get(shuffle_id)
         if parts is None:
             return None
+        _cache.move_to_end(shuffle_id)
         return parts.get(partition)
 
 
@@ -69,8 +151,106 @@ def has_shuffle(shuffle_id: int) -> bool:
         return shuffle_id in _cache
 
 
-def drop(shuffle_id: int) -> None:
-    """Invalidate on recovery/unregister: stale collective results must
-    not serve after a map recomputes."""
+# -- warm read ranges (cross-stage shuffle-output reuse) -----------------
+
+
+def put_range(shuffle_id: int, epoch: int, start: int, end: int,
+              keys: np.ndarray, payload: np.ndarray) -> bool:
+    """Cache one reducer's materialized partition range under the
+    location epoch it was read at. Returns False when it didn't fit."""
+    total = _nbytes(keys, payload)
     with _lock:
-        _cache.pop(shuffle_id, None)
+        if total > _budget:
+            return False
+        # detach this shuffle's store first so eviction can't race the
+        # update (re-admitted whole below, newest-touched)
+        ranges = _ranges.pop(shuffle_id, {})
+        prev = _bytes.pop(("warm", shuffle_id), 0)
+        old = ranges.get((start, end))
+        if old is not None:
+            prev -= _nbytes(old[1], old[2])
+        need = max(0, prev) + total
+        _evict_to_budget_locked(need)
+        ranges[(start, end)] = (epoch, keys, payload)
+        _ranges[shuffle_id] = ranges
+        _bytes[("warm", shuffle_id)] = need
+        return True
+
+
+def get_range(shuffle_id: int, epoch: int, start: int, end: int
+              ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """The cached (keys, payload) for [start, end) iff stored under
+    EXACTLY ``epoch`` — an entry from any other version is dropped on
+    sight (a stale location state must never serve bytes)."""
+    with _lock:
+        ranges = _ranges.get(shuffle_id)
+        if ranges is None:
+            return None
+        entry = ranges.get((start, end))
+        if entry is None:
+            return None
+        stored_epoch, keys, payload = entry
+        if stored_epoch != epoch:
+            del ranges[(start, end)]
+            _bytes[("warm", shuffle_id)] = max(
+                0, _bytes.get(("warm", shuffle_id), 0)
+                - _nbytes(keys, payload))
+            if not ranges:
+                _ranges.pop(shuffle_id, None)
+                _bytes.pop(("warm", shuffle_id), None)
+            return None
+        _ranges.move_to_end(shuffle_id)
+        return keys, payload
+
+
+def on_epoch(shuffle_id: int, epoch: int) -> None:
+    """A pushed epoch bump: evict entries the new version obsoletes
+    (``get_range`` would drop them lazily anyway; eager eviction frees
+    the bytes now). A terminal bump (epoch < 0) drops the shuffle from
+    BOTH stores — mesh results predate the bump by construction."""
+    with _lock:
+        if epoch < 0:
+            _drop_locked(shuffle_id)
+            return
+        ranges = _ranges.get(shuffle_id)
+        if not ranges:
+            return
+        stale = [k for k, (e, _k, _p) in ranges.items() if e != epoch]
+        freed = 0
+        for k in stale:
+            _e, keys, payload = ranges.pop(k)
+            freed += _nbytes(keys, payload)
+        if freed:
+            _bytes[("warm", shuffle_id)] = max(
+                0, _bytes.get(("warm", shuffle_id), 0) - freed)
+        if not ranges:
+            _ranges.pop(shuffle_id, None)
+            _bytes.pop(("warm", shuffle_id), None)
+
+
+# -- lifecycle -----------------------------------------------------------
+
+
+def _drop_locked(shuffle_id: int) -> None:
+    _cache.pop(shuffle_id, None)
+    _ranges.pop(shuffle_id, None)
+    _bytes.pop(("mesh", shuffle_id), None)
+    _bytes.pop(("warm", shuffle_id), None)
+
+
+def drop(shuffle_id: int) -> None:
+    """Invalidate on recovery/unregister: stale collective results and
+    warm ranges must not serve after a map recomputes."""
+    with _lock:
+        _drop_locked(shuffle_id)
+
+
+def stats() -> dict:
+    with _lock:
+        return {
+            "budget": _budget,
+            "bytes": _total_locked(),
+            "mesh_shuffles": len(_cache),
+            "warm_shuffles": len(_ranges),
+            "evicted": evicted,
+        }

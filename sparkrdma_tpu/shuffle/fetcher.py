@@ -147,6 +147,16 @@ class ReadMetrics:
     # the RPC-count the coalesced dataplane exists to shrink. The
     # coalescing tier-1 test asserts this drops vs the per-map path.
     requests_per_reduce: int = 0
+    # METADATA RPCs only (driver-table/shard syncs + block-location
+    # reads) — the count the epoch-versioned location plane exists to
+    # zero: a warm superstep over an unchanged shuffle must read as 0
+    # here (asserted by the wire-traffic test and the iterative bench).
+    metadata_rpcs_per_stage: int = 0
+    # location-plane cache hits this reducer resolved without the wire
+    location_cache_hits: int = 0
+    # warm read-range hits (warm_read_cache): whole partition ranges
+    # served from dist_cache without starting a fetch at all
+    warm_range_hits: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_remote(self, nbytes: int, latency_s: float) -> None:
@@ -158,6 +168,14 @@ class ReadMetrics:
     def record_request(self) -> None:
         with self._lock:
             self.requests_per_reduce += 1
+
+    def record_metadata_rpc(self) -> None:
+        with self._lock:
+            self.metadata_rpcs_per_stage += 1
+
+    def record_location_hit(self, n: int = 1) -> None:
+        with self._lock:
+            self.location_cache_hits += n
 
     def record_local(self, nbytes: int) -> None:
         with self._lock:
@@ -243,14 +261,18 @@ class ShuffleFetcher:
         # sleep schedule replays with it
         self._backoff = Backoff.from_conf(conf, rng=random.Random(seed))
         self._threads: List[threading.Thread] = []
+        # location-state version this fetch resolved against (stamped by
+        # start() from the table sync): cached locations and warm
+        # partition ranges store under it, pushed epoch bumps invalidate
+        self.epoch = 0
 
     # -- setup: plan + launch (initialize/startAsyncRemoteFetches) -------
 
     def start(self) -> "ShuffleFetcher":
         with self.tracer.span("fetch.driver_table", "fetch",
                               shuffle=self.shuffle_id):
-            table = self.endpoint.get_driver_table(self.shuffle_id,
-                                                   self.num_maps)
+            table, self.epoch = self.endpoint.get_driver_table_v(
+                self.shuffle_id, self.num_maps, metrics=self.metrics)
         my_index = self._my_index()
         local_maps: List[int] = []
         by_peer: Dict[int, List[int]] = {}
@@ -486,15 +508,31 @@ class ShuffleFetcher:
         on every attempt, which lands here as TransportErrors. Later
         failures ride the normal retry envelope (the peer has already
         proven it speaks the batched protocol)."""
+        # cache-first resolution (location_plane): maps whose entries are
+        # already held under the current epoch never touch the wire —
+        # the warm path resolves the WHOLE peer from cache and issues
+        # zero metadata RPCs
+        plane = self.endpoint.location_plane
         locs_by_map: Dict[int, List] = {}
+        uncached: List[int] = []
+        for m in maps:
+            locs = plane.locations(self.shuffle_id, m,
+                                   self.start_partition, self.end_partition)
+            if locs is None:
+                uncached.append(m)
+            else:
+                locs_by_map[m] = locs
+        if locs_by_map:
+            self.metrics.record_location_hit(len(locs_by_map))
         per = self.endpoint.outputs_batch_maps(self.start_partition,
                                                self.end_partition)
         try:
-            for i in range(0, len(maps), per):
-                chunk = maps[i:i + per]
+            for i in range(0, len(uncached), per):
+                chunk = uncached[i:i + per]
 
                 def read_chunk(chunk=chunk):
                     self.metrics.record_request()
+                    self.metrics.record_metadata_rpc()
                     with self.tracer.span("fetch.locations", "fetch",
                                           peer=exec_idx, maps=len(chunk),
                                           batched=True):
@@ -505,7 +543,7 @@ class ShuffleFetcher:
                 if i == 0:
                     self._suspect_check(exec_idx, chunk[0])
                     try:
-                        locs_by_map.update(read_chunk())
+                        fetched = read_chunk()
                     except FetchStatusError:
                         raise
                     except (TransportError, TimeoutError) as e:
@@ -523,10 +561,16 @@ class ShuffleFetcher:
                                              chunk[0], True, 1)
                         if self._aborted.wait(self._backoff.delay(0)):
                             raise _Aborted()
-                        locs_by_map.update(read_chunk())
+                        fetched = read_chunk()
                 else:
-                    locs_by_map.update(self._with_retries(
-                        "locations", exec_idx, chunk[0], read_chunk))
+                    fetched = self._with_retries(
+                        "locations", exec_idx, chunk[0], read_chunk)
+                locs_by_map.update(fetched)
+                for m, locs in fetched.items():
+                    plane.put_locations(self.shuffle_id, m,
+                                        self.start_partition,
+                                        self.end_partition, locs,
+                                        self.epoch)
         except FetchStatusError as e:
             # authoritative per-map answer (unknown map / bad range): the
             # per-map path would re-fail identically — escalate now
@@ -835,6 +879,15 @@ class ShuffleFetcher:
         verdict = ("corrupt_output"
                    if getattr(err, "status", None) == STATUS_CORRUPT
                    else "peer_lost")
+        # staleness backstop: whatever location view led here is now
+        # suspect — drop it (warm cached BYTES included) so the
+        # post-recovery retry re-syncs a fresh snapshot instead of
+        # re-serving the cache that just failed (covers a lost epoch
+        # push: invalidation by failure, the hard way, costs one refetch
+        # — never a wrong result)
+        self.endpoint.location_plane.invalidate(self.shuffle_id)
+        from sparkrdma_tpu.shuffle import dist_cache
+        dist_cache.drop(self.shuffle_id)
         raise FetchFailedError(
             self.shuffle_id, map_id, exec_idx,
             f"{what} failed after {consumed} attempt(s): {err}",
@@ -888,11 +941,22 @@ class ShuffleFetcher:
         location read then every data read, one at a time. Kept verbatim
         as the regression escape hatch the pipelined path is diffed
         against."""
+        plane = self.endpoint.location_plane
         pending: List[_PendingFetch] = []
         for m in maps:
-            # STEP 2: block locations (:293-315).
+            # STEP 2: block locations (:293-315) — cache-first: an
+            # epoch-current cached range resolves without the wire
+            locs = plane.locations(self.shuffle_id, m,
+                                   self.start_partition,
+                                   self.end_partition)
+            if locs is not None:
+                self.metrics.record_location_hit()
+                pending.extend(self._group_locations(exec_idx, m, locs))
+                continue
+
             def read_locs(m=m):
                 self.metrics.record_request()
+                self.metrics.record_metadata_rpc()
                 with self.tracer.span("fetch.locations", "fetch",
                                       map=m, peer=exec_idx):
                     return self.endpoint.fetch_output_range(
@@ -900,6 +964,8 @@ class ShuffleFetcher:
                         self.start_partition, self.end_partition)
 
             locs = self._with_retries("locations", exec_idx, m, read_locs)
+            plane.put_locations(self.shuffle_id, m, self.start_partition,
+                                self.end_partition, locs, self.epoch)
             pending.extend(self._group_locations(exec_idx, m, locs))
         self._rng.shuffle(pending)
         with count_lock:
@@ -955,6 +1021,25 @@ class ShuffleFetcher:
         ready: deque = deque()        # (_PendingFetch, t_ready)
         inflight: deque = deque()     # (_PendingFetch, AsyncFetch,
         #                                t_ready, t_issue)
+        # cache-first: maps with epoch-current cached locations feed the
+        # data window directly; only misses enter the STEP-2 read-ahead
+        plane = self.endpoint.location_plane
+        misses: List[int] = []
+        now0 = time.monotonic()
+        for m in maps:
+            locs = plane.locations(self.shuffle_id, m,
+                                   self.start_partition,
+                                   self.end_partition)
+            if locs is None:
+                misses.append(m)
+                continue
+            self.metrics.record_location_hit()
+            groups = self._group_locations(exec_idx, m, locs)
+            self._rng.shuffle(groups)
+            with count_lock:
+                self._expected_results += len(groups)
+            ready.extend((g, now0) for g in groups)
+        maps = misses
         mi = 0
         try:
             while mi < len(maps) or loc_pending or ready or inflight:
@@ -971,6 +1056,7 @@ class ShuffleFetcher:
                     self._suspect_check(exec_idx, m)
                     mi += 1
                     self.metrics.record_request()
+                    self.metrics.record_metadata_rpc()
                     loc_pending.append((
                         m,
                         self.endpoint.fetch_output_range_async(
@@ -1039,12 +1125,16 @@ class ShuffleFetcher:
             # would reorder the drain for no benefit)
             def retry_locs(m=m):
                 self.metrics.record_request()
+                self.metrics.record_metadata_rpc()
                 return self.endpoint.fetch_output_range(
                     peer, self.shuffle_id, m,
                     self.start_partition, self.end_partition)
 
             locs = self._with_retries("locations", exec_idx, m, retry_locs,
                                       first_error=e)
+        self.endpoint.location_plane.put_locations(
+            self.shuffle_id, m, self.start_partition, self.end_partition,
+            locs, self.epoch)
         if self.tracer.enabled:
             # same span the sequential path brackets around its blocking
             # location read — STEP-2 latency stays measurable in the
@@ -1210,6 +1300,13 @@ class ShuffleFetcher:
             if result.failure is not None:
                 self._failed = True
                 self.close()
+                # any escalated failure makes this shuffle's cached
+                # locations AND warm bytes suspect (peer-thread crashes
+                # included, which never went through _fail):
+                # refetch-snapshot on retry
+                self.endpoint.location_plane.invalidate(self.shuffle_id)
+                from sparkrdma_tpu.shuffle import dist_cache
+                dist_cache.drop(self.shuffle_id)
                 raise result.failure
             self._consumed += 1
             if not result.is_local:

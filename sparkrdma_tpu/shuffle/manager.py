@@ -107,6 +107,11 @@ class TpuShuffleManager:
         self._handles: Dict[int, ShuffleHandle] = {}
         self._lock = threading.Lock()
         self.pool = BufferPool(self.conf)
+        # worker-process shuffle cache budget (mesh results + warm
+        # iterative ranges, shuffle/dist_cache.py) — process-global, so
+        # co-hosted managers share one bound like they share the process
+        from sparkrdma_tpu.shuffle import dist_cache
+        dist_cache.configure(self.conf.dist_cache_budget)
         self.reader_stats = (ShuffleReaderStats(self.conf)
                              if self.conf.collect_shuffle_reader_stats else None)
         self.tracer = trace_mod.get(self.conf)

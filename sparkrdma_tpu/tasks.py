@@ -116,10 +116,17 @@ def install_task_server(compat_mgr) -> None:
                                              desc["task_id"])
                     result = desc["fn"](ctx, desc["task_id"])
                 elif kind == "invalidate":
+                    # drops the memoized driver table AND the location
+                    # plane's epoch-validated views in this process
+                    # (superstep epoch propagation: the next read here
+                    # re-syncs a fresh snapshot), plus the worker cache
                     compat_mgr.native.executor.invalidate_shuffle(
                         desc["shuffle_id"])
-                    # recovery republishes maps: collective results built
-                    # from the old table must not serve stale rows
+                    # recovery republishes maps: collective results and
+                    # warm ranges built from the old table must not
+                    # serve stale rows (invalidate_shuffle drops them
+                    # too; kept explicit so a custom endpoint can't
+                    # silently lose the contract)
                     dist_cache.drop(desc["shuffle_id"])
                     result = None
                 elif kind == "unregister":

@@ -46,6 +46,11 @@ SEED = int(os.environ.get("CHAOS_SEED", "0"))
 COALESCE = os.environ.get("CHAOS_COALESCE", "1") not in ("0", "false")
 # storage-fault sweep gate (CHAOS_DISK=0 runs the network-only matrix)
 DISK = os.environ.get("CHAOS_DISK", "1") not in ("0", "false")
+# metadata plane under chaos: 1 = epoch-validated location caches (the
+# default), 0 = the cold pre-plane path; run_chaos.sh sweeps both —
+# the failure paths differ (a warm reducer holds locations a loss just
+# invalidated; a cold one re-syncs every time)
+WARM = os.environ.get("CHAOS_WARM", "1") not in ("0", "false")
 
 
 def _conf(**kw):
@@ -54,6 +59,7 @@ def _conf(**kw):
                 fetch_retry_budget=3, use_cpp_runtime=False,
                 pre_warm_connections=False,
                 coalesce_reads=COALESCE,
+                location_epoch_cache=WARM,
                 collect_shuffle_reader_stats=True)
     base.update(kw)
     return TpuShuffleConf(**base)
@@ -336,6 +342,88 @@ def test_chaos_vectored_corruption_refetches_only_affected_ranges(tmp_path):
         # wire accounting: 1 batched location RPC + 1 vectored read + 1
         # range refetch — nothing else
         assert m.requests_per_reduce == 3, f"seed={SEED}: {m}"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def test_chaos_stale_cache_never_serves_dead_peer(tmp_path):
+    """Executor loss mid-iteration: the reducer's warm location cache
+    points at the dead peer. The fetch fails, recovery tombstones +
+    recomputes, the loss BUMPS the epoch, and the re-synced snapshot
+    never names the tombstoned slot — byte-identical output, no stale
+    location served after invalidation."""
+    if not WARM:
+        pytest.skip("cold sweep: no cache to go stale")
+    driver, execs = _cluster(tmp_path, fetch_retry_budget=1)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        # superstep 1 (cold): warms the reducer's location cache
+        got1 = _reduce_fn(execs[0], handle)
+        np.testing.assert_array_equal(got1, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        plane = execs[0].executor.location_plane
+        assert plane.snapshot()["tables"] >= 1, f"seed={SEED}"
+        assert driver.driver.epoch_of(1) == 1, f"seed={SEED}"
+        # the victim dies between supersteps; the warm cache still names
+        # its slot
+        victim_slot = execs[2].executor.exec_index()
+        execs[2].executor.server.stop()
+        # superstep 2: the stale cache leads to a failed fetch — NEVER a
+        # wrong result — and recovery repairs + invalidates
+        got2 = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
+                                     reducer_index=0, driver=driver)
+        np.testing.assert_array_equal(got2, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        # the loss bumped the epoch (pushed invalidation)
+        assert driver.driver.epoch_of(1) > 1, f"seed={SEED}"
+        # the re-synced view never names the tombstoned slot
+        table = execs[0].executor.get_driver_table(1, 6, timeout=5)
+        for m in range(6):
+            assert table.entry(m)[1] != victim_slot, f"seed={SEED}"
+        # superstep 3 over the repaired state: clean, still identical
+        got3 = _reduce_fn(execs[0], handle)
+        np.testing.assert_array_equal(got3, _expected(6),
+                                      err_msg=f"seed={SEED}")
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_chaos_corrupt_reexecution_bumps_epoch_mid_iteration(tmp_path):
+    """Corrupt-output healing mid-iteration: at-rest rot caught at serve
+    time re-executes exactly the rotten map; the repair publish BUMPS
+    the epoch so every reducer's warm cache refreshes — the next
+    superstep reads the healed output under the new epoch,
+    byte-identical."""
+    if not WARM:
+        pytest.skip("cold sweep: no cache to invalidate")
+    driver, execs = _cluster(tmp_path, at_rest_checksum=True)
+    injector = StorageFaultInjector(seed=SEED)
+    injector.install()
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        # one committed output rots right after its commit attested it
+        injector.add(CORRUPT_AT_REST, op="commit", times=1)
+        run_map_stage(execs, handle, _map_fn)
+        assert injector.fired_count(CORRUPT_AT_REST) == 1, f"seed={SEED}"
+        # superstep 1 trips the serve-time check -> corrupt_output
+        # verdict -> re-execution of exactly that map -> repair publish
+        got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
+                                    reducer_index=0, driver=driver)
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        assert driver.driver.epoch_of(1) > 1, \
+            f"seed={SEED}: corrupt re-execution did not bump the epoch"
+        # superstep 2: warm under the NEW epoch, clean and identical
+        got2 = _reduce_fn(execs[0], handle)
+        np.testing.assert_array_equal(got2, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        r = execs[0].get_reader(handle, 0, handle.num_partitions)
+        keys, _ = r.read_all()
+        assert r.metrics.failed_fetches == 0, f"seed={SEED}"
     finally:
         injector.uninstall()
         _shutdown(driver, execs)

@@ -164,6 +164,10 @@ def test_coalescing_disabled_reproduces_per_map_wire_traffic(tmp_path):
         assert served == {"per_map": num_maps, "batched": 0}
 
         served.update(per_map=0, batched=0)
+        # this test measures COLD wire traffic per dataplane: drop the
+        # warm location views the first drain cached (the zero-RPC warm
+        # path has its own wire-traffic test, test_warm_iterative.py)
+        execs[1].executor.location_plane.invalidate(handle.shuffle_id)
         on = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=True))
         assert _drain(_reader(execs, 1, handle, on))
         assert served == {"per_map": 0, "batched": 1}
@@ -314,7 +318,11 @@ def test_batched_failure_falls_back_to_per_map(tmp_path):
         assert served["batched"] == 2 and served["per_map"] == 0
 
         # BOTH attempts torn down (what an old server that drops the
-        # unknown frame type does every time) -> per-map fallback
+        # unknown frame type does every time) -> per-map fallback.
+        # Each phase measures COLD wire traffic: drop the warm location
+        # views the previous drain cached (warm-path behavior has its
+        # own wire-traffic test, test_warm_iterative.py)
+        execs[1].executor.location_plane.invalidate(handle.shuffle_id)
         served.update(per_map=0, batched=0)
         injector.clear()
         injector.add(DISCONNECT, msg_type=M.FetchOutputsResp, times=2)
@@ -324,6 +332,7 @@ def test_batched_failure_falls_back_to_per_map(tmp_path):
         assert injector.fired_count(DISCONNECT) == 3
         assert served["batched"] >= 2  # both attempts reached the peer
         assert served["per_map"] == 4  # the fallback served every map
+        execs[1].executor.location_plane.invalidate(handle.shuffle_id)
         off = TpuShuffleConf(**dict(CONF_KW, coalesce_reads=False))
         assert got == _drain(_reader(execs, 1, handle, off))
     finally:

@@ -1,0 +1,462 @@
+"""Metadata plane: epoch-versioned location tables, pushed invalidation,
+sharded driver state (shuffle/location_plane.py).
+
+Unit coverage of the plane's epoch-validity rules plus control-plane
+integration: epoch allocation/bumps at the driver (repair publish,
+tombstone, unregister), the EpochBumpMsg push reaching executors,
+sharded table reads off shard-host replicas with driver fallback, and
+the long-poll unregister race fix (a poll racing an unregister gets a
+terminal answer now, not a burned deadline).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel.endpoints import DriverEndpoint, ExecutorEndpoint
+from sparkrdma_tpu.shuffle.location_plane import (
+    EPOCH_DEAD,
+    LocationPlane,
+    ShardMap,
+    ShardStore,
+)
+from sparkrdma_tpu.shuffle.map_output import MAP_ENTRY_SIZE, DriverTable
+
+CONF = TpuShuffleConf(connect_timeout_ms=5000, max_connection_attempts=2)
+
+
+@pytest.fixture
+def cluster():
+    driver = DriverEndpoint(CONF)
+    execs = []
+    for i in range(3):
+        ex = ExecutorEndpoint("127.0.0.1", str(i), driver.address,
+                              conf=CONF)
+        execs.append(ex)
+    for ex in execs:
+        ex.start()
+    for ex in execs:
+        ex.wait_for_members(3)
+    yield driver, execs
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+# -- plane unit semantics -------------------------------------------------
+
+
+def test_epoch_sentinels_agree():
+    assert EPOCH_DEAD == M.EPOCH_DEAD
+
+
+def test_plane_epoch_validity_rules():
+    p = LocationPlane()
+    t = DriverTable(2)
+    t.publish(0, 5, 0)
+    t.publish(1, 6, 1)
+    p.put_table(7, t, 1)
+    got = p.table(7)
+    assert got is not None and got[0] is t and got[1] == 1
+    # a newer observed epoch invalidates the cached view
+    assert p.note_epoch(7, 2) is True
+    assert p.table(7) is None
+    # duplicate/stale observations are no-ops
+    assert p.note_epoch(7, 2) is False
+    assert p.note_epoch(7, 1) is False
+    # a response stamped OLDER than the observed epoch never memoizes
+    p.put_table(7, t, 1)
+    assert p.table(7) is None
+    p.put_table(7, t, 2)
+    assert p.table(7) is not None
+    # locations share the rules
+    p.put_locations(7, 0, 0, 4, ["locs"], 2)
+    assert p.locations(7, 0, 0, 4) == ["locs"]
+    assert p.note_epoch(7, 3) is True
+    assert p.locations(7, 0, 0, 4) is None
+    # EPOCH_DEAD drops everything including the observation
+    p.put_locations(7, 0, 0, 4, ["locs"], 3)
+    p.note_epoch(7, EPOCH_DEAD)
+    assert p.locations(7, 0, 0, 4) is None
+    assert p.known_epoch(7) is None
+
+
+def test_plane_partial_table_never_memoized():
+    p = LocationPlane()
+    t = DriverTable(3)
+    t.publish(0, 5, 0)
+    p.put_table(9, t, 1)
+    assert p.table(9) is None
+
+
+def test_plane_hard_invalidate_keeps_observation():
+    p = LocationPlane()
+    t = DriverTable(1)
+    t.publish(0, 5, 0)
+    p.put_table(3, t, 4)
+    p.invalidate(3)
+    assert p.table(3) is None
+    # the observation survives: a racing response from epoch 3 (older
+    # than what we've seen) must still be recognized as stale
+    assert p.known_epoch(3) == 4
+    p.put_table(3, t, 3)
+    assert p.table(3) is None
+
+
+def test_plane_disabled_is_passthrough():
+    p = LocationPlane(enabled=False)
+    t = DriverTable(1)
+    t.publish(0, 5, 0)
+    p.put_table(1, t, 1)
+    assert p.table(1) is None
+    p.put_locations(1, 0, 0, 1, ["x"], 1)
+    assert p.locations(1, 0, 0, 1) is None
+
+
+def test_plane_location_ranges_bounded():
+    p = LocationPlane(max_ranges=4)
+    for m in range(10):
+        p.put_locations(1, m, 0, 2, [m], 1)
+    assert p.snapshot()["ranges"] == 4
+    # oldest evicted FIFO, newest kept
+    assert p.locations(1, 9, 0, 2) == [9]
+    assert p.locations(1, 0, 0, 2) is None
+
+
+# -- shard map / shard store ---------------------------------------------
+
+
+def test_shard_map_assignment_and_ranges():
+    sm = ShardMap.assign(10, [0, 1, 2], 3)
+    assert sm.num_shards == 3
+    assert [sm.range_of(s) for s in range(3)] == [(0, 4), (4, 8), (8, 10)]
+    assert sm.slot_of_map(0) == 0 and sm.slot_of_map(9) == 2
+    # more shards than maps or hosts degrade gracefully
+    assert ShardMap.assign(2, [0, 1, 2], 8).num_shards == 2
+    assert ShardMap.assign(10, [5], 8).num_shards == 1
+    assert ShardMap.assign(10, [], 8) is None
+    assert ShardMap.assign(10, [0, 1], 0) is None
+    # trailing shards whose range would be empty/inverted are dropped
+    # (5 maps over 4 slots = span 2 = 3 REAL shards; an empty shard
+    # would own no maps and fail every sharded sync into the fallback)
+    sm5 = ShardMap.assign(5, [0, 1, 2, 3], 4)
+    assert sm5.num_shards == 3
+    assert [sm5.range_of(s) for s in range(3)] == [(0, 2), (2, 4), (4, 5)]
+    assert all(lo < hi for lo, hi in
+               (sm5.range_of(s) for s in range(sm5.num_shards)))
+    sm9 = ShardMap(9, [0, 1, 2, 3])  # direct construction truncates too
+    assert sm9.num_shards == 3 and sm9.range_of(2) == (6, 9)
+    # truncation is wire-stable: reconstructing from the truncated slot
+    # list derives identical ranges
+    sm5b = ShardMap(sm5.num_maps, sm5.shard_slots)
+    assert [sm5b.range_of(s) for s in range(sm5b.num_shards)] == \
+        [sm5.range_of(s) for s in range(sm5.num_shards)]
+    # wire round trip through ShardMapMsg
+    msg = M.ShardMapMsg(1, 1, sm.num_maps, sm.shard_slots)
+    back = M.ShardMapMsg.from_payload(msg.payload())
+    sm2 = ShardMap(back.num_maps, back.shard_slots)
+    assert [sm2.range_of(s) for s in range(3)] == \
+        [sm.range_of(s) for s in range(3)]
+
+
+def test_shard_store_apply_and_read():
+    ss = ShardStore()
+    assert ss.read_range(1, 0, 4) is None  # no replica
+    e0 = DriverTable.pack_entry(100, 0)
+    e2 = DriverTable.pack_entry(102, 1)
+    ss.apply(1, 1, 0, 4, e0)
+    ss.apply(1, 2, 2, 4, e2)  # repair forward carries a bumped epoch
+    n, epoch, data = ss.read_range(1, 0, 4)
+    assert n == 2 and epoch == 2
+    assert len(data) == 4 * MAP_ENTRY_SIZE
+    t = DriverTable.from_bytes(data)
+    assert t.entry(0) == (100, 0) and t.entry(2) == (102, 1)
+    assert t.entry(1) is None and t.entry(3) is None
+    assert ss.count_in(1, 0, 2) == 1
+    ss.drop(1)
+    assert ss.read_range(1, 0, 4) is None
+
+
+# -- driver epoch lifecycle ----------------------------------------------
+
+
+def _wait(pred, timeout=5.0, every=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every)
+    return False
+
+
+def test_epoch_allocated_and_served_with_table(cluster):
+    driver, execs = cluster
+    driver.register_shuffle(1, num_maps=2)
+    assert driver.epoch_of(1) == 1
+    execs[0].publish_map_output(1, 0, table_token=10)
+    execs[1].publish_map_output(1, 1, table_token=11)
+    table, epoch = execs[2].get_driver_table_v(1, expect_published=2,
+                                               timeout=5)
+    assert epoch == 1 and table.num_published == 2
+    # the complete table memoized under its epoch: a re-read is a cache
+    # hit, no wire traffic
+    before = execs[2].location_plane.snapshot()["hits"]
+    t2, e2 = execs[2].get_driver_table_v(1, expect_published=2, timeout=5)
+    assert t2 is table and e2 == 1
+    assert execs[2].location_plane.snapshot()["hits"] == before + 1
+
+
+def test_repair_publish_bumps_epoch_and_pushes(cluster):
+    driver, execs = cluster
+    driver.register_shuffle(2, num_maps=1)
+    execs[0].publish_map_output(2, 0, table_token=10)
+    table, epoch = execs[2].get_driver_table_v(2, 1, timeout=5)
+    assert epoch == 1
+    # identical republish: no state a cache could hold moved — no bump
+    execs[0].publish_map_output(2, 0, table_token=10)
+    time.sleep(0.2)
+    assert driver.epoch_of(2) == 1
+    # an overwrite (re-execution on another executor) IS a repair
+    execs[1].publish_map_output(2, 0, table_token=20)
+    assert _wait(lambda: driver.epoch_of(2) == 2)
+    # the push invalidates every executor's cached view
+    assert _wait(lambda: execs[2].location_plane.known_epoch(2) == 2)
+    assert execs[2].location_plane.table(2) is None
+    # the re-sync serves the repaired entry under the new epoch
+    t2, e2 = execs[2].get_driver_table_v(2, 1, timeout=5)
+    assert e2 == 2 and t2.entry(0)[0] == 20
+
+
+def test_tombstone_bumps_shuffles_naming_the_dead_slot(cluster):
+    driver, execs = cluster
+    driver.register_shuffle(3, num_maps=1)
+    driver.register_shuffle(4, num_maps=1)
+    # shuffle 3's output lives on the victim; shuffle 4's does not
+    execs[1].publish_map_output(3, 0, table_token=1)
+    execs[0].publish_map_output(4, 0, table_token=2)
+    execs[2].get_driver_table_v(3, 1, timeout=5)
+    execs[2].get_driver_table_v(4, 1, timeout=5)
+    driver.remove_member(execs[1].manager_id)
+    assert _wait(lambda: driver.epoch_of(3) == 2)
+    assert _wait(lambda: execs[2].location_plane.known_epoch(3) == 2)
+    assert execs[2].location_plane.table(3) is None
+    # a shuffle with nothing on the dead slot keeps its epoch AND its
+    # caches — invalidating it would cold-restart reducers for nothing
+    assert driver.epoch_of(4) == 1
+    assert execs[2].location_plane.table(4) is not None
+
+
+def test_unregister_pushes_terminal_epoch(cluster):
+    driver, execs = cluster
+    driver.register_shuffle(5, num_maps=1)
+    execs[0].publish_map_output(5, 0, table_token=1)
+    execs[2].get_driver_table_v(5, 1, timeout=5)
+    assert execs[2].location_plane.snapshot()["tables"] >= 1
+    driver.unregister_shuffle(5)
+    assert driver.epoch_of(5) is None
+    assert _wait(lambda: execs[2].location_plane.known_epoch(5) is None
+                 and execs[2].location_plane.table(5) is None)
+
+
+# -- long-poll unregister race (satellite fix) ----------------------------
+
+
+class _HookLock:
+    """Wraps a lock; fires ``hook`` once, from ``owner`` thread only,
+    BEFORE the acquisition — forcing the exact interleaving where an
+    unregister lands between the poll's table read and its waiter
+    registration."""
+
+    def __init__(self, real, hook, owner):
+        self._real = real
+        self._hook = hook
+        self._owner = owner
+        self.fired = False
+
+    def __enter__(self):
+        if not self.fired and threading.current_thread() is self._owner:
+            self.fired = True
+            self._hook()
+        return self._real.__enter__()
+
+    def __exit__(self, *a):
+        return self._real.__exit__(*a)
+
+
+class _FakeConn:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+
+def test_long_poll_unregister_race_gets_terminal_answer(cluster):
+    """The race: _on_fetch_table reads the table (registered), an
+    unregister fully completes (waiter list popped — nothing to wake),
+    THEN the poll registers its waiter. Pre-fix it sat parked until the
+    deadline sweeper; now the re-check answers it terminally at once."""
+    driver, _execs = cluster
+    driver.register_shuffle(42, num_maps=2)
+    real = driver._waiters_lock
+    driver._waiters_lock = _HookLock(
+        real, lambda: driver.unregister_shuffle(42),
+        threading.current_thread())
+    try:
+        conn = _FakeConn()
+        t0 = time.monotonic()
+        resp = driver._on_fetch_table(
+            conn, M.FetchTableReq(1, 42, min_published=2, timeout_ms=5000))
+        dt = time.monotonic() - t0
+    finally:
+        driver._waiters_lock = real
+    assert driver._waiters_lock is real
+    # answered immediately (returned or sent), terminally, within ms —
+    # NOT the 5 s deadline
+    answers = ([resp] if resp is not None else []) + conn.sent
+    assert len(answers) == 1, answers
+    assert answers[0].num_published < 0
+    assert dt < 1.0, f"poll burned {dt:.2f}s of its deadline"
+    # and no orphan waiter is left behind for the sweeper
+    assert 42 not in driver._waiters
+
+
+def test_long_poll_unregister_while_parked_wakes(cluster):
+    """The pre-existing path: a parked long-poll is woken terminally by
+    unregister (no full-deadline burn) — the client surfaces it as the
+    not-registered TimeoutError immediately."""
+    driver, execs = cluster
+    driver.register_shuffle(43, num_maps=4)
+    execs[0].publish_map_output(43, 0, table_token=1)
+    errs = []
+
+    def poll():
+        t0 = time.monotonic()
+        try:
+            execs[2].get_driver_table(43, expect_published=4, timeout=30)
+            errs.append(("no-error", 0.0))
+        except TimeoutError as e:
+            errs.append((str(e), time.monotonic() - t0))
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.3)  # let the poll park at the driver
+    driver.unregister_shuffle(43)
+    t.join(timeout=5)
+    assert not t.is_alive(), "poll never returned"
+    msg, dt = errs[0]
+    assert "not registered" in msg
+    assert dt < 5.0, f"poll burned {dt:.1f}s instead of waking"
+
+
+# -- sharded cold path ----------------------------------------------------
+
+
+@pytest.fixture
+def sharded_cluster():
+    conf = TpuShuffleConf(connect_timeout_ms=5000,
+                          max_connection_attempts=2, metadata_shards=2)
+    driver = DriverEndpoint(conf)
+    execs = []
+    for i in range(3):
+        ex = ExecutorEndpoint("127.0.0.1", str(i), driver.address,
+                              conf=conf)
+        execs.append(ex)
+    for ex in execs:
+        ex.start()
+    for ex in execs:
+        ex.wait_for_members(3)
+    yield driver, execs
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def test_sharded_table_read_serves_from_shard_hosts(sharded_cluster):
+    driver, execs = sharded_cluster
+    driver.register_shuffle(7, num_maps=6)
+    # the shard map reaches every executor by push
+    assert _wait(lambda: all(ex.location_plane.shard_map(7) is not None
+                             for ex in execs))
+    sm = execs[2].location_plane.shard_map(7)
+    assert sm.num_shards == 2 and sm.num_maps == 6
+    for m in range(6):
+        execs[m % 3].publish_map_output(7, m, table_token=100 + m)
+    # count frames at the driver vs shard hosts
+    served = {"driver": 0, "shard": 0}
+    orig_table = driver._on_fetch_table
+
+    def count_table(conn, msg):
+        served["driver"] += 1
+        return orig_table(conn, msg)
+
+    driver._on_fetch_table = count_table
+    for ex in execs:
+        orig_shard = ex._on_fetch_shard
+
+        def count_shard(conn, msg, orig=orig_shard):
+            served["shard"] += 1
+            return orig(conn, msg)
+
+        ex._on_fetch_shard = count_shard
+    table = execs[2].get_driver_table(7, expect_published=6, timeout=5)
+    assert table.num_published == 6
+    for m in range(6):
+        assert table.entry(m)[0] == 100 + m
+    assert served["driver"] == 0, "cold sync still hit the driver"
+    assert served["shard"] == 2, served
+
+
+def test_sharded_read_long_polls_until_published(sharded_cluster):
+    driver, execs = sharded_cluster
+    driver.register_shuffle(8, num_maps=2)
+    assert _wait(lambda: execs[2].location_plane.shard_map(8) is not None)
+    execs[0].publish_map_output(8, 0, table_token=1)
+
+    def late():
+        time.sleep(0.3)
+        execs[1].publish_map_output(8, 1, table_token=2)
+
+    t = threading.Thread(target=late)
+    t.start()
+    table = execs[2].get_driver_table(8, expect_published=2, timeout=5)
+    t.join()
+    assert table.entry(1)[0] == 2
+
+
+def test_sharded_read_falls_back_to_driver_on_dead_host(sharded_cluster):
+    driver, execs = sharded_cluster
+    driver.register_shuffle(9, num_maps=4)
+    assert _wait(lambda: execs[2].location_plane.shard_map(9) is not None)
+    for m in range(4):
+        execs[m % 3].publish_map_output(9, m, table_token=m)
+    sm = execs[2].location_plane.shard_map(9)
+    # kill a shard host's server: the shard read fails, the driver
+    # (authoritative) serves the sync instead
+    victim_slot = sm.shard_slots[0]
+    victim = next(ex for ex in execs
+                  if ex.manager_id == execs[0].member_at(victim_slot))
+    reader = next(ex for ex in execs if ex is not victim)
+    victim.server.stop()
+    time.sleep(0.1)
+    table = reader.get_driver_table(9, expect_published=4, timeout=10)
+    assert table.num_published == 4
+
+
+def test_metadata_rpc_counting(cluster):
+    """get_driver_table_v charges actual wire syncs to the passed
+    metrics object; cache hits charge nothing."""
+    from sparkrdma_tpu.shuffle.fetcher import ReadMetrics
+
+    driver, execs = cluster
+    driver.register_shuffle(11, num_maps=1)
+    execs[0].publish_map_output(11, 0, table_token=1)
+    m = ReadMetrics()
+    execs[2].get_driver_table_v(11, 1, timeout=5, metrics=m)
+    assert m.metadata_rpcs_per_stage == 1
+    execs[2].get_driver_table_v(11, 1, timeout=5, metrics=m)
+    assert m.metadata_rpcs_per_stage == 1  # warm: zero new RPCs
