@@ -458,6 +458,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("write path done")
     _bench_iterative(detail)
     _progress("iterative warm done")
+    _bench_skew(detail)
+    _progress("skew plan done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -565,6 +567,39 @@ def _bench_iterative(detail: dict) -> None:
         detail["iterative_wall_s"] = res["wall_s_per_superstep"]
     except Exception as e:  # noqa: BLE001
         detail["iterative_warm_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_skew(detail: dict) -> None:
+    """The adaptive reduce planner's win on skewed workloads, measured
+    without hardware: a zipfian-key terasort (and a hot-key join) reduced
+    under the static plan vs the driver's adaptive plan — coalesce tiny
+    partitions, split the hot one by map-range, byte-identical output —
+    in the SAME process on the same worker pool, so the ratio cancels
+    host noise like dense_exchange_guard; see shuffle/plan_bench.py.
+    Pure host path — identical on TPU and CPU-fallback records."""
+    import tempfile
+
+    from sparkrdma_tpu.shuffle.plan_bench import run_skew_microbench
+
+    # per-workload records (same harness; a regression names its
+    # workload): terasort carries the headline skew_speedup plus the
+    # plan/balance detail, the hot-join shape rides as skew_join_*
+    for workload, prefix in (("terasort", "skew"), ("join", "skew_join")):
+        try:
+            with tempfile.TemporaryDirectory(prefix=f"{prefix}bench_") as td:
+                res = run_skew_microbench(td, workload=workload)
+            if not res["identical"]:
+                detail[f"{prefix}_error"] = (f"{workload}: static and "
+                                             "adaptive plans reduced "
+                                             "different bytes")
+                continue
+            detail[f"{prefix}_speedup"] = res["skew_speedup"]
+            if workload == "terasort":
+                detail["skew_wall_s"] = res["wall_s"]
+                detail["skew_plan"] = res["plan"]
+                detail["skew_reduce_balance"] = res["reduce_balance"]
+        except Exception as e:  # noqa: BLE001
+            detail[f"{prefix}_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _bench_dense_guard(detail: dict, mesh, impl: str, small_cfg,

@@ -21,6 +21,12 @@
 #                               epoch-validated warm caches and the cold
 #                               pre-plane path — stale-cache scenarios
 #                               only run warm)
+#   CHAOS_SKEW_MODES="0 1"      reduce-planning modes to sweep (default
+#                               both: static plans, and adaptive_plan=1
+#                               so size-carrying publishes, driver
+#                               histograms, and plan pushes see every
+#                               injected fault; the mid-stage re-plan
+#                               scenario forces adaptive regardless)
 #   CHAOS_DISK=0          drop the storage-fault matrix from the sweep
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -28,26 +34,29 @@ cd "$(dirname "$0")/.."
 SEEDS=${*:-${CHAOS_SEEDS:-"0 1 2 3 4 5 6 7"}}
 MODES=${CHAOS_COALESCE_MODES:-"1 0"}
 WARM_MODES=${CHAOS_WARM_MODES:-"1 0"}
+SKEW_MODES=${CHAOS_SKEW_MODES:-"0 1"}
 DISK=${CHAOS_DISK:-1}
 failed=()
+for skew in $SKEW_MODES; do
 for warm in $WARM_MODES; do
 for coalesce in $MODES; do
   for seed in $SEEDS; do
     echo "=== chaos sweep: seed ${seed} coalesce=${coalesce}" \
-         "warm=${warm} disk=${DISK} ==="
+         "warm=${warm} skew=${skew} disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
-         CHAOS_WARM="${warm}" CHAOS_DISK="${DISK}" \
+         CHAOS_WARM="${warm}" CHAOS_SKEW="${skew}" CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
            -p no:cacheprovider -p no:randomly; then
-      echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm} FAILED —" \
-           "replay with:"
+      echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm}" \
+           "skew=${skew} FAILED — replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
-           "CHAOS_WARM=${warm} CHAOS_DISK=${DISK}" \
+           "CHAOS_WARM=${warm} CHAOS_SKEW=${skew} CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}w${warm}")
+      failed+=("${seed}/c${coalesce}w${warm}s${skew}")
     fi
   done
+done
 done
 done
 
@@ -56,4 +65,4 @@ if [ "${#failed[@]}" -gt 0 ]; then
   exit 1
 fi
 echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
-     "planes (disk=${DISK})"
+     "planes, both reduce-planning modes (disk=${DISK})"

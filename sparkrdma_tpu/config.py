@@ -297,6 +297,42 @@ _KEYS = [
              "it, whole-shuffle entries evict LRU (dist_cache.evicted "
              "counts them) so cross-stage reuse can't OOM a long "
              "iterative job. 0 disables caching entirely."),
+    # --- adaptive reduce planning (TPU-only: shuffle/planner.py,
+    # docs/CONFIG.md "Reduce planning")
+    _Key("adaptive_plan", False, "bool",
+         doc="Skew-aware reduce planning: map publishes carry their "
+             "per-partition byte sizes to the driver, which aggregates "
+             "them into a SizeHistogram and emits an epoch-stamped "
+             "ReducePlan at map-stage completion — coalescing runs of "
+             "tiny partitions into one reducer, splitting hot partitions "
+             "across reducers by map-range (deterministic merge in map "
+             "order), and placing each reducer for locality. The plan is "
+             "pushed on the announce channel (ReducePlanMsg) and "
+             "resolved cache-first; recovery re-plans mid-stage after an "
+             "executor loss (orphaned tasks only, bumped plan epoch). "
+             "Off by default: uniform workloads get the identity plan "
+             "anyway, and the size vectors cost P*4 bytes per publish."),
+    _Key("coalesce_target_bytes", "1m", "bytes", 0, 1 << 40,
+         doc="Adaptive-plan coalescing target: contiguous runs of "
+             "partitions whose total bytes stay at or under this merge "
+             "into ONE reducer task (served as one wider vectored "
+             "fetch). A partition larger than this always gets its own "
+             "task; 0 disables coalescing."),
+    _Key("split_threshold_bytes", "32m", "bytes", 1 << 10, 1 << 44,
+         doc="Adaptive-plan split threshold: a partition carrying more "
+             "bytes than this splits across ceil(bytes/threshold) "
+             "reducer tasks by map-range (bounded by the map count and "
+             "2x the live-executor count), boundaries on the size "
+             "histogram's per-map prefix sums so slices are near-equal. "
+             "The split tasks' outputs concatenate deterministically in "
+             "map order."),
+    _Key("locality_placement", True, "bool",
+         doc="Adaptive-plan placement: each reducer task prefers the "
+             "executor already holding the largest share of its input "
+             "bytes, under a balance cap (no slot takes more than 1.5x "
+             "the even share) so locality can't recreate the straggler "
+             "it exists to remove. Off = tasks carry no placement "
+             "preference (round-robin execution)."),
     _Key("request_deadline_ms", 0, "int", 0, 3600_000,
          doc="Per-request completion deadline on the control plane "
              "(request/AsyncFetch waits); 0 = fall back to "

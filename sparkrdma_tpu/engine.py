@@ -544,6 +544,14 @@ class DAGEngine:
                               stage=stage.stage_id, shuffle=shuffle_id,
                               tasks=stage.num_tasks):
             self._run_stage_tasks(stage)
+        # adaptive reduce planning (shuffle/planner.py): the map stage
+        # just completed, so the driver's size histogram is full — build
+        # + publish the plan NOW so the consuming stage's tasks place on
+        # the executors already holding their bytes. No-op (returns
+        # None) with adaptive_plan off.
+        drv = self.driver.native.driver
+        if drv is not None and self.driver.native.conf.adaptive_plan:
+            drv.build_reduce_plan(shuffle_id, tracer=self.tracer)
         if stage.stage_id in self._pin_counts:
             self._pinned_complete.add(stage.stage_id)
 
@@ -556,8 +564,7 @@ class DAGEngine:
                 if h is not None:
                     self._dist_mesh_reduce(h)
         if self.max_parallel_tasks <= 1 or stage.num_tasks <= 1:
-            return [self._run_task(stage, t,
-                                   mgr=self._dist_preferred(stage, t))
+            return [self._run_task(stage, t, mgr=self._preferred(stage, t))
                     for t in range(stage.num_tasks)]
         from concurrent.futures import ThreadPoolExecutor
 
@@ -568,7 +575,7 @@ class DAGEngine:
             if self.speculation:
                 return self._collect_speculative(stage, pool)
             futures = [pool.submit(self._run_task, stage, t,
-                                   self._dist_preferred(stage, t))
+                                   self._preferred(stage, t))
                        for t in range(stage.num_tasks)]
             return [f.result() for f in futures]
         except BaseException:
@@ -601,8 +608,7 @@ class DAGEngine:
 
         def timed(t: int):
             start[t] = time_mod.monotonic()
-            return self._run_task(stage, t,
-                                  mgr=self._dist_preferred(stage, t))
+            return self._run_task(stage, t, mgr=self._preferred(stage, t))
 
         meta = {pool.submit(timed, t): t for t in range(n)}
         speculated: set = set()  # tasks that got their ONE backup
@@ -648,9 +654,9 @@ class DAGEngine:
                                  statistics.median(durations))
                         try:  # keep the backup off the primary's node —
                             # the owner-preferred executor when placement
-                            # used one (dist mesh mode), else the
-                            # round-robin pick the primary got
-                            avoid = (self._dist_preferred(stage, t)
+                            # used one (dist mesh or plan locality), else
+                            # the round-robin pick the primary got
+                            avoid = (self._preferred(stage, t)
                                      or self._pick_live(t))
                         except RuntimeError:
                             avoid = None
@@ -783,6 +789,39 @@ class DAGEngine:
             owners[task_id] = self._slot_of(target)
 
     # -- mesh data plane (shuffle/mesh_service.py) -----------------------
+
+    def _preferred(self, stage, task_id: int):
+        """Task placement preference, strongest first: the dist-mesh
+        owner (a local cache hit beats everything), else the adaptive
+        reduce plan's locality pick (the executor already holding the
+        largest share of the task's input bytes)."""
+        return (self._dist_preferred(stage, task_id)
+                or self._plan_preferred(stage, task_id))
+
+    def _plan_preferred(self, stage, task_id: int):
+        """The adaptive plan's placement for this reduce task's
+        partition, mapped onto a live executor (shuffle/planner.py).
+        None when no parent has a published plan (adaptive_plan off),
+        the plan has no preference, or the slot is gone — the caller
+        falls back to round-robin, so placement is advisory, never a
+        correctness dependency."""
+        drv = self.driver.native.driver
+        if drv is None or not hasattr(drv, "reduce_plan"):
+            return None
+        for p in stage.parents:
+            h = self._handles.get(p.stage_id)
+            if h is None:
+                continue
+            plan = drv.reduce_plan(h.shuffle_id)
+            if plan is None:
+                continue
+            slot = plan.placement_of(task_id)
+            if slot < 0:
+                continue
+            for ex in self._live():
+                if self._slot_of(ex) == slot:
+                    return ex
+        return None
 
     def _dist_preferred(self, stage, task_id: int):
         """The executor whose process received task_id's partition in the

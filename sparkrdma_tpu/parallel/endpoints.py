@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import struct
 import threading
 import time
 from typing import Dict, List, Optional, Protocol, Tuple
@@ -145,6 +146,14 @@ class DriverEndpoint:
         self._epochs: Dict[int, int] = {}
         self._shard_maps: Dict[int, object] = {}  # shuffle -> ShardMap
         self.epoch_bumps = 0  # audit: pushed invalidations
+        # adaptive reduce planning (shuffle/planner.py): per-shuffle size
+        # histograms fed by publish lengths, the published plans, and the
+        # reduce-partition count the manager registered with. Guarded by
+        # _tables_lock (sizes and tables always move together).
+        self._size_hists: Dict[int, object] = {}
+        self._plans: Dict[int, object] = {}
+        self._num_partitions: Dict[int, int] = {}
+        self.plan_replans = 0  # audit: mid-stage re-plans pushed
         self._clients = ConnectionCache(self.conf)
         # One broadcaster thread + a coalescing slot instead of a thread per
         # membership event: N executors joining produce O(N) sends of the
@@ -188,12 +197,15 @@ class DriverEndpoint:
 
     # -- shuffle registry (driver side of registerShuffle) ---------------
 
-    def register_shuffle(self, shuffle_id: int, num_maps: int) -> None:
+    def register_shuffle(self, shuffle_id: int, num_maps: int,
+                         num_partitions: int = 0) -> None:
         """Allocate the per-shuffle map-output table
         (scala/RdmaShuffleManager.scala:168-172) at epoch 1, and — with
         ``metadata_shards`` on — assign map-range shards over the live
         members and push the assignment so reducers aim cold-path table
-        syncs at shard hosts instead of the driver."""
+        syncs at shard hosts instead of the driver. With
+        ``adaptive_plan`` on, a :class:`~.planner.SizeHistogram` is
+        allocated too (fed by the lengths riding each publish)."""
         from sparkrdma_tpu.shuffle.location_plane import ShardMap
         shard_map = None
         with self._tables_lock:
@@ -201,6 +213,11 @@ class DriverEndpoint:
                 return
             self._tables[shuffle_id] = DriverTable(num_maps)
             self._epochs[shuffle_id] = 1
+            self._num_partitions[shuffle_id] = num_partitions
+            if self.conf.adaptive_plan:
+                from sparkrdma_tpu.shuffle.planner import SizeHistogram
+                self._size_hists[shuffle_id] = SizeHistogram(
+                    num_maps, num_partitions)
             if self.conf.metadata_shards > 0:
                 with self._members_lock:
                     live = [i for i, m in enumerate(self._members)
@@ -218,6 +235,9 @@ class DriverEndpoint:
             known = self._tables.pop(shuffle_id, None) is not None
             self._epochs.pop(shuffle_id, None)
             self._shard_maps.pop(shuffle_id, None)
+            self._size_hists.pop(shuffle_id, None)
+            self._plans.pop(shuffle_id, None)
+            self._num_partitions.pop(shuffle_id, None)
         # unblock long-pollers: the shuffle is gone, answer "unknown"
         with self._waiters_lock:
             waiters = self._waiters.pop(shuffle_id, [])
@@ -251,6 +271,109 @@ class DriverEndpoint:
                  epoch, f" ({reason})" if reason else "")
         self._queue_push(None, M.EpochBumpMsg(shuffle_id, epoch))
         return epoch
+
+    # -- adaptive reduce planning (shuffle/planner.py) -------------------
+
+    def size_histogram(self, shuffle_id: int):
+        """The shuffle's SizeHistogram (None when adaptive planning is
+        off or the shuffle is unregistered)."""
+        with self._tables_lock:
+            return self._size_hists.get(shuffle_id)
+
+    def reduce_plan(self, shuffle_id: int):
+        """The current published ReducePlan, or None."""
+        with self._tables_lock:
+            return self._plans.get(shuffle_id)
+
+    def _plan_inputs(self, shuffle_id: int):
+        """(hist, owners, live_slots) for plan construction, or None."""
+        with self._tables_lock:
+            hist = self._size_hists.get(shuffle_id)
+            table = self._tables.get(shuffle_id)
+        if hist is None or table is None:
+            return None
+        owners = {}
+        for m in range(table.num_maps):
+            entry = table.entry(m)
+            if entry is not None:
+                owners[m] = entry[1]
+        with self._members_lock:
+            live = [i for i, mm in enumerate(self._members)
+                    if mm != TOMBSTONE]
+        return hist, owners, live
+
+    def build_reduce_plan(self, shuffle_id: int, tracer=None):
+        """Build (or rebuild) the shuffle's ReducePlan from the size
+        histogram at map-stage completion and PUSH it on the broadcast
+        channel — the plan is a one-sided, driver-published artifact
+        like the location tables. Returns the plan, or None when
+        adaptive planning is off / the shuffle is unknown / no sizes
+        ever arrived (mixed-version executors): callers fall back to
+        the identity plan, so a size-less cluster degrades to today's
+        behavior, never to an error."""
+        from sparkrdma_tpu.shuffle.planner import ReducePlanner
+        inputs = self._plan_inputs(shuffle_id)
+        if inputs is None:
+            return None
+        hist, owners, live = inputs
+        if hist.maps_recorded == 0 or hist.num_partitions == 0:
+            return None
+        with self._tables_lock:
+            prev = self._plans.get(shuffle_id)
+        epoch = prev.plan_epoch + 1 if prev is not None else 1
+        plan = ReducePlanner(self.conf).plan(shuffle_id, hist, owners,
+                                             live, plan_epoch=epoch,
+                                             tracer=tracer)
+        with self._tables_lock:
+            if shuffle_id not in self._tables:
+                return None  # unregistered while planning
+            self._plans[shuffle_id] = plan
+        self._queue_push(None, M.ReducePlanMsg(plan.to_bytes()))
+        log.info("driver: reduce plan shuffle %d epoch %d: %s",
+                 shuffle_id, plan.plan_epoch, plan.counts())
+        return plan
+
+    def replan_reduce(self, shuffle_id: int, completed_task_ids,
+                      dead_slot: int = -1, tracer=None):
+        """Mid-stage re-plan after an executor loss: surviving reducers
+        keep their completed ranges; only ORPHANED tasks (incomplete,
+        placed on a slot that is dead or tombstoned) re-assign to live
+        slots, under a bumped plan epoch, pushed like the original."""
+        from sparkrdma_tpu.shuffle.planner import ReducePlanner
+        with self._tables_lock:
+            plan = self._plans.get(shuffle_id)
+        if plan is None:
+            return None
+        inputs = self._plan_inputs(shuffle_id)
+        if inputs is None:
+            return None
+        hist, owners, live = inputs
+        if dead_slot >= 0:
+            live = [s for s in live if s != dead_slot]
+        if not live:
+            return None
+        new_plan = ReducePlanner(self.conf).replan(
+            plan, hist, owners, live, completed_task_ids, tracer=tracer)
+        with self._tables_lock:
+            if shuffle_id not in self._tables:
+                return None
+            self._plans[shuffle_id] = new_plan
+        self.plan_replans += 1
+        self._queue_push(None, M.ReducePlanMsg(new_plan.to_bytes()))
+        log.info("driver: reduce RE-plan shuffle %d epoch %d (dead slot "
+                 "%d)", shuffle_id, new_plan.plan_epoch, dead_slot)
+        return new_plan
+
+    def _on_fetch_plan(self, msg: "M.FetchPlanReq") -> RpcMsg:
+        with self._tables_lock:
+            known = msg.shuffle_id in self._tables
+            plan = self._plans.get(msg.shuffle_id)
+        if plan is not None:
+            return M.FetchPlanResp(msg.req_id, M.STATUS_OK,
+                                   plan.to_bytes())
+        return M.FetchPlanResp(
+            msg.req_id,
+            M.STATUS_ERROR if known else M.STATUS_UNKNOWN_SHUFFLE, b"")
 
     def map_entry(self, shuffle_id: int, map_id: int):
         """Current (token, exec_index) for one map, or None (unpublished
@@ -322,6 +445,8 @@ class DriverEndpoint:
             return self._on_publish(msg)
         if isinstance(msg, M.FetchTableReq):
             return self._on_fetch_table(conn, msg)
+        if isinstance(msg, M.FetchPlanReq):
+            return self._on_fetch_plan(msg)
         if isinstance(msg, M.GetBroadcastReq):
             with self._broadcasts_lock:
                 blob = self._broadcasts.get(msg.bcast_id)
@@ -489,6 +614,14 @@ class DriverEndpoint:
                         "%d (exec %d fence %d)", msg.shuffle_id, msg.map_id,
                         exec_index, msg.fence)
             return None
+        # adaptive planning: an APPLIED publish carries its per-partition
+        # sizes into the histogram — positionally, so a repair publish
+        # overwrites the dead attempt's row exactly like the table entry
+        if msg.lengths is not None:
+            with self._tables_lock:
+                hist = self._size_hists.get(msg.shuffle_id)
+            if hist is not None:
+                hist.add(msg.map_id, msg.lengths)
         # epoch semantics: a publish that OVERWROTE a live entry is a
         # REPAIR (re-execution after loss or corrupt output, elastic
         # rejoin under new tokens) — bump + push so epoch-validated
@@ -1052,6 +1185,9 @@ class ExecutorEndpoint:
         if isinstance(msg, M.EpochBumpMsg):
             self._on_epoch_bump(msg)
             return None
+        if isinstance(msg, M.ReducePlanMsg):
+            self._on_reduce_plan(msg)
+            return None
         if isinstance(msg, M.ShardMapMsg):
             from sparkrdma_tpu.shuffle.location_plane import ShardMap
             self.location_plane.put_shard_map(
@@ -1085,7 +1221,8 @@ class ExecutorEndpoint:
         if isinstance(msg, M.PongMsg):
             return None  # pong landed after its ping's deadline: stale
         if isinstance(msg, (M.FetchOutputResp, M.FetchOutputsResp,
-                            M.FetchTableResp, M.FetchShardResp)):
+                            M.FetchTableResp, M.FetchShardResp,
+                            M.FetchPlanResp)):
             # orphan of a cancelled/timed-out request (the fetcher
             # cancels whole read-ahead windows on failure); unlike block
             # responses these carry no credits, so dropping is complete
@@ -1150,6 +1287,57 @@ class ExecutorEndpoint:
         if invalidated:
             self.tracer.instant("meta.epoch_bump", "meta",
                                 shuffle=msg.shuffle_id, epoch=msg.epoch)
+
+    def _on_reduce_plan(self, msg: "M.ReducePlanMsg") -> None:
+        """A pushed reduce plan (initial publish or mid-stage re-plan):
+        cache it for cache-first resolution, and when it REPLACES an
+        older epoch's plan invalidate plan-keyed warm state — a re-plan
+        re-carves the reduce ranges, so warm bytes cached under the old
+        carve-up must never serve (``dist_cache.on_plan_epoch``)."""
+        from sparkrdma_tpu.shuffle.planner import ReducePlan
+        try:
+            plan = ReducePlan.from_bytes(msg.plan_bytes)
+        except (struct.error, ValueError) as e:
+            log.warning("%s: undecodable reduce plan push: %s",
+                        self.manager_id.executor_id.executor, e)
+            return
+        accepted = self.location_plane.put_plan(plan.shuffle_id, plan)
+        if not accepted:
+            return  # stale reordered push: must not touch warm state
+        from sparkrdma_tpu.shuffle import dist_cache
+        dist_cache.on_plan_epoch(plan.shuffle_id, plan.plan_epoch)
+        if plan.plan_epoch > 1:
+            self.tracer.instant("plan.replan", "plan",
+                                shuffle=plan.shuffle_id,
+                                epoch=plan.plan_epoch)
+
+    def get_reduce_plan(self, shuffle_id: int, timeout: float = 5.0):
+        """Cache-first ReducePlan resolution: the pushed plan in the
+        location plane when present, else ONE pull from the driver
+        (``FetchPlanReq`` — the lost-push backstop). Returns None when
+        no plan exists (adaptive planning off, or the map stage hasn't
+        completed): callers run the identity plan."""
+        cached = self.location_plane.plan(shuffle_id)
+        if cached is not None:
+            return cached
+        from sparkrdma_tpu.shuffle.planner import ReducePlan
+        try:
+            conn = self.driver_conn()
+            resp = conn.request(
+                M.FetchPlanReq(conn.next_req_id(), shuffle_id),
+                timeout=timeout)
+        except (TransportError, TimeoutError) as e:
+            log.debug("reduce-plan fetch for shuffle %d failed: %s",
+                      shuffle_id, e)
+            return None
+        assert isinstance(resp, M.FetchPlanResp)
+        if resp.status != M.STATUS_OK:
+            return None
+        plan = ReducePlan.from_bytes(resp.plan_bytes)
+        if self.location_plane.put_plan(shuffle_id, plan):
+            from sparkrdma_tpu.shuffle import dist_cache
+            dist_cache.on_plan_epoch(shuffle_id, plan.plan_epoch)
+        return plan
 
     def _on_shard_entry(self, msg: M.ShardEntryMsg) -> None:
         self.shard_store.apply(msg.shuffle_id, msg.epoch, msg.map_id,
@@ -1506,16 +1694,21 @@ class ExecutorEndpoint:
     # -- client-side fetch calls (used by the fetcher iterator) ----------
 
     def publish_map_output(self, shuffle_id: int, map_id: int,
-                           table_token: int, fence: int = 0) -> None:
+                           table_token: int, fence: int = 0,
+                           lengths=None) -> None:
         """(scala/RdmaShuffleManager.scala:384-418). ``fence`` is the
         committing attempt's fencing token — the driver rejects a publish
         naming the same executor with an older fence, so a zombie
-        speculative attempt can't clobber the winner's location."""
+        speculative attempt can't clobber the winner's location.
+        ``lengths`` (with ``adaptive_plan``) rides the publish so the
+        driver's size histogram sees every committed output's
+        per-partition bytes without an extra round trip."""
         entry = DriverTable.pack_entry(
             table_token,
             self.exec_index(timeout=self.conf.connect_timeout_ms / 1000))
         conn = self.driver_conn()
-        msg = M.PublishMsg(shuffle_id, map_id, entry, fence=fence)
+        msg = M.PublishMsg(shuffle_id, map_id, entry, fence=fence,
+                           lengths=lengths)
         conn.send(msg)
 
     def get_driver_table(self, shuffle_id: int, expect_published: int,

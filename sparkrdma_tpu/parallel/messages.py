@@ -86,30 +86,50 @@ class PublishMsg(RpcMsg):
     for the same (map, executor), so a zombie speculative attempt that
     commits late cannot clobber the winner's location entry. Appended
     after the fixed 12-byte entry; a fence-less (pre-fencing) payload
-    decodes with fence 0, which never out-fences anything."""
+    decodes with fence 0, which never out-fences anything.
+
+    ``lengths`` (adaptive reduce planning, shuffle/planner.py) is the
+    map output's per-partition byte sizes — the u32 "length" column of
+    its MapTaskOutput table, which the writer already has in hand at
+    commit. Appended after the fence as ``count:u32 + u32[count]`` so
+    the driver can aggregate a SizeHistogram without any extra round
+    trip; omitted (count absent) when ``adaptive_plan`` is off, and a
+    pre-planning payload decodes with ``lengths=None``."""
 
     ENTRY_BYTES = 12
 
     def __init__(self, shuffle_id: int, map_id: int, entry: bytes,
-                 fence: int = 0):
+                 fence: int = 0, lengths=None):
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.entry = entry
         self.fence = fence
+        self.lengths = list(lengths) if lengths is not None else None
 
     def payload(self) -> bytes:
-        return (struct.pack("<ii", self.shuffle_id, self.map_id)
-                + self.entry + struct.pack("<q", self.fence))
+        out = (struct.pack("<ii", self.shuffle_id, self.map_id)
+               + self.entry + struct.pack("<q", self.fence))
+        if self.lengths is not None:
+            out += struct.pack(f"<I{len(self.lengths)}I",
+                               len(self.lengths), *self.lengths)
+        return out
 
     @classmethod
     def from_payload(cls, payload: bytes) -> "PublishMsg":
         shuffle_id, map_id = struct.unpack_from("<ii", payload, 0)
         entry = payload[8:8 + cls.ENTRY_BYTES]
         fence = 0
-        if len(payload) >= 8 + cls.ENTRY_BYTES + 8:
-            (fence,) = struct.unpack_from("<q", payload,
-                                          8 + cls.ENTRY_BYTES)
-        return cls(shuffle_id, map_id, entry, fence)
+        lengths = None
+        off = 8 + cls.ENTRY_BYTES
+        if len(payload) >= off + 8:
+            (fence,) = struct.unpack_from("<q", payload, off)
+            off += 8
+        if len(payload) >= off + 4:
+            (n,) = struct.unpack_from("<I", payload, off)
+            if len(payload) >= off + 4 + 4 * n:
+                lengths = list(struct.unpack_from(f"<{n}I", payload,
+                                                  off + 4))
+        return cls(shuffle_id, map_id, entry, fence, lengths)
 
 
 # Wire type 4 reserved (was an ack; publish is one-sided like the
@@ -624,6 +644,66 @@ class FetchShardResp(RpcMsg):
         (epoch,) = _Q.unpack_from(payload, _QI.size)
         return cls(req_id, num_published, epoch,
                    payload[_QI.size + _Q.size:])
+
+
+@register(25)
+class ReducePlanMsg(RpcMsg):
+    """Driver -> executors push: the shuffle's reduce plan (adaptive
+    skew-aware planning, shuffle/planner.py) — an epoch-stamped,
+    one-sided, driver-published artifact like the location tables it
+    rides beside. Pushed at plan build and on every mid-stage re-plan
+    (bumped ``plan_epoch``); reducers cache it in their LocationPlane
+    and resolve cache-first. A lost push is backstopped by the pull
+    path (``FetchPlanReq``). ``payload`` is ``ReducePlan.to_bytes()``."""
+
+    def __init__(self, plan_bytes: bytes):
+        self.plan_bytes = plan_bytes
+
+    def payload(self) -> bytes:
+        return self.plan_bytes
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ReducePlanMsg":
+        return cls(payload)
+
+
+@register(26)
+class FetchPlanReq(RpcMsg):
+    """Reducer -> driver: pull one shuffle's current reduce plan (the
+    cold path / lost-push backstop of ``ReducePlanMsg``)."""
+
+    def __init__(self, req_id: int, shuffle_id: int):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.shuffle_id)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchPlanReq":
+        req_id, shuffle_id = _QI.unpack_from(payload, 0)
+        return cls(req_id, shuffle_id)
+
+
+@register(27)
+class FetchPlanResp(RpcMsg):
+    """``STATUS_OK`` with the plan bytes; ``STATUS_ERROR`` when the
+    driver holds no plan (adaptive planning off, or the map stage has
+    not completed) — the reducer falls back to the identity plan;
+    ``STATUS_UNKNOWN_SHUFFLE`` when the shuffle is unregistered."""
+
+    def __init__(self, req_id: int, status: int, plan_bytes: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.plan_bytes = plan_bytes
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.status) + self.plan_bytes
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchPlanResp":
+        req_id, status = _QI.unpack_from(payload, 0)
+        return cls(req_id, status, payload[_QI.size:])
 
 
 # Status codes shared by responses.

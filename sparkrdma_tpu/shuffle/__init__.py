@@ -11,3 +11,10 @@ from sparkrdma_tpu.shuffle.location_plane import (  # noqa: F401
     ShardMap,
     ShardStore,
 )
+from sparkrdma_tpu.shuffle.planner import (  # noqa: F401
+    PlanTask,
+    ReducePlan,
+    ReducePlanner,
+    SizeHistogram,
+    identity_plan,
+)

@@ -40,8 +40,15 @@ _lock = threading.Lock()
 # shuffle_id -> partition -> (keys u64[N], payload u8[N, W])   (mesh)
 _cache: "OrderedDict[int, Dict[int, Tuple[np.ndarray, np.ndarray]]]" = \
     OrderedDict()
-# shuffle_id -> (start, end) -> (epoch, keys, payload)         (warm)
-_ranges: "OrderedDict[int, Dict[Tuple[int, int], Tuple[int, np.ndarray, np.ndarray]]]" = OrderedDict()
+# shuffle_id -> (start, end, map_lo, map_hi) -> (epoch, keys, payload)
+# (warm; (map_lo, map_hi) = (-1, -1) for a full-map-range read, so
+# pre-planner callers and adaptive split tasks never alias one key)
+_ranges: "OrderedDict[int, Dict[Tuple[int, int, int, int], Tuple[int, np.ndarray, np.ndarray]]]" = OrderedDict()
+# adaptive reduce planning: the last plan epoch OBSERVED per shuffle —
+# a changed plan re-carves the reduce ranges, so warm entries cached
+# under the old plan must not serve (on_plan_epoch drops them)
+_plan_epochs: Dict[int, int] = {}
+plan_invalidations = 0  # warm-range drops caused by plan-epoch changes
 # byte accounting per shuffle per store (LRU evicts whole shuffles: the
 # unit invalidation works at, so eviction can never leave a half-valid
 # shuffle behind)
@@ -154,11 +161,21 @@ def has_shuffle(shuffle_id: int) -> bool:
 # -- warm read ranges (cross-stage shuffle-output reuse) -----------------
 
 
+def _range_key(start: int, end: int,
+               map_range: Optional[Tuple[int, int]]) -> Tuple[int, int, int, int]:
+    lo, hi = map_range if map_range is not None else (-1, -1)
+    return (start, end, lo, hi)
+
+
 def put_range(shuffle_id: int, epoch: int, start: int, end: int,
-              keys: np.ndarray, payload: np.ndarray) -> bool:
+              keys: np.ndarray, payload: np.ndarray,
+              map_range: Optional[Tuple[int, int]] = None) -> bool:
     """Cache one reducer's materialized partition range under the
-    location epoch it was read at. Returns False when it didn't fit."""
+    location epoch it was read at. ``map_range`` keys a plan-split
+    task's map slice (None = the full map space). Returns False when it
+    didn't fit."""
     total = _nbytes(keys, payload)
+    key = _range_key(start, end, map_range)
     with _lock:
         if total > _budget:
             return False
@@ -166,32 +183,34 @@ def put_range(shuffle_id: int, epoch: int, start: int, end: int,
         # update (re-admitted whole below, newest-touched)
         ranges = _ranges.pop(shuffle_id, {})
         prev = _bytes.pop(("warm", shuffle_id), 0)
-        old = ranges.get((start, end))
+        old = ranges.get(key)
         if old is not None:
             prev -= _nbytes(old[1], old[2])
         need = max(0, prev) + total
         _evict_to_budget_locked(need)
-        ranges[(start, end)] = (epoch, keys, payload)
+        ranges[key] = (epoch, keys, payload)
         _ranges[shuffle_id] = ranges
         _bytes[("warm", shuffle_id)] = need
         return True
 
 
-def get_range(shuffle_id: int, epoch: int, start: int, end: int
+def get_range(shuffle_id: int, epoch: int, start: int, end: int,
+              map_range: Optional[Tuple[int, int]] = None
               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """The cached (keys, payload) for [start, end) iff stored under
     EXACTLY ``epoch`` — an entry from any other version is dropped on
     sight (a stale location state must never serve bytes)."""
+    key = _range_key(start, end, map_range)
     with _lock:
         ranges = _ranges.get(shuffle_id)
         if ranges is None:
             return None
-        entry = ranges.get((start, end))
+        entry = ranges.get(key)
         if entry is None:
             return None
         stored_epoch, keys, payload = entry
         if stored_epoch != epoch:
-            del ranges[(start, end)]
+            del ranges[key]
             _bytes[("warm", shuffle_id)] = max(
                 0, _bytes.get(("warm", shuffle_id), 0)
                 - _nbytes(keys, payload))
@@ -201,6 +220,25 @@ def get_range(shuffle_id: int, epoch: int, start: int, end: int
             return None
         _ranges.move_to_end(shuffle_id)
         return keys, payload
+
+
+def on_plan_epoch(shuffle_id: int, plan_epoch: int) -> None:
+    """A pushed reduce-plan change (shuffle/planner.py): a re-plan (or
+    first plan after warm entries were cached plan-less) re-carves the
+    reduce ranges, so every warm range of the shuffle cached under a
+    DIFFERENT plan epoch is dropped — a re-plan must never serve a
+    stale coalesced range. First observation records without dropping
+    (nothing was cached under another plan)."""
+    global plan_invalidations
+    with _lock:
+        prev = _plan_epochs.get(shuffle_id)
+        _plan_epochs[shuffle_id] = plan_epoch
+        if prev is None or prev == plan_epoch:
+            return
+        ranges = _ranges.pop(shuffle_id, None)
+        _bytes.pop(("warm", shuffle_id), None)
+        if ranges:
+            plan_invalidations += 1
 
 
 def on_epoch(shuffle_id: int, epoch: int) -> None:
@@ -236,6 +274,7 @@ def _drop_locked(shuffle_id: int) -> None:
     _ranges.pop(shuffle_id, None)
     _bytes.pop(("mesh", shuffle_id), None)
     _bytes.pop(("warm", shuffle_id), None)
+    _plan_epochs.pop(shuffle_id, None)
 
 
 def drop(shuffle_id: int) -> None:
@@ -253,4 +292,5 @@ def stats() -> dict:
             "mesh_shuffles": len(_cache),
             "warm_shuffles": len(_ranges),
             "evicted": evicted,
+            "plan_invalidations": plan_invalidations,
         }

@@ -226,11 +226,20 @@ class ShuffleFetcher:
                  conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
                  start_partition: int, end_partition: int,
                  seed: Optional[int] = None, reader_stats=None, tracer=None,
-                 pool=None):
+                 pool=None, map_range=None):
         from sparkrdma_tpu.utils import trace as trace_mod
         self.endpoint = endpoint
         self.resolver = resolver
         self.conf = conf
+        # map-range restriction (adaptive reduce planning): a SPLIT task
+        # reads its partition from a disjoint [map_start, map_end) slice
+        # of the map space — the rest of the fetch machinery (grouping,
+        # coalescing, retries, blame) is untouched, it just sees fewer
+        # maps. None = the full map space (every pre-planner caller).
+        self.map_start, self.map_end = map_range or (0, num_maps)
+        if not 0 <= self.map_start <= self.map_end <= num_maps:
+            raise ValueError(f"bad map_range ({self.map_start}, "
+                             f"{self.map_end}) for {num_maps} maps")
         # staging pool (runtime/pool.py): when present, each vectored
         # response lands in ONE refcounted multi-view RegisteredBuffer
         # lease — many logical blocks, one pool buffer, returned on last
@@ -265,10 +274,13 @@ class ShuffleFetcher:
         # start() from the table sync): cached locations and warm
         # partition ranges store under it, pushed epoch bumps invalidate
         self.epoch = 0
+        self._started = False
+        self._reducer_bytes_recorded = False
 
     # -- setup: plan + launch (initialize/startAsyncRemoteFetches) -------
 
     def start(self) -> "ShuffleFetcher":
+        self._started = True
         with self.tracer.span("fetch.driver_table", "fetch",
                               shuffle=self.shuffle_id):
             table, self.epoch = self.endpoint.get_driver_table_v(
@@ -276,7 +288,7 @@ class ShuffleFetcher:
         my_index = self._my_index()
         local_maps: List[int] = []
         by_peer: Dict[int, List[int]] = {}
-        for m in range(self.num_maps):
+        for m in range(self.map_start, self.map_end):
             entry = table.entry(m)
             if entry is None:
                 raise FetchFailedError(self.shuffle_id, m, -1,
@@ -1283,6 +1295,20 @@ class ShuffleFetcher:
         with self._in_flight_cv:
             self._in_flight_cv.notify_all()
         self._drain_unconsumed()
+        # skew observability: this reducer's input-byte total lands in
+        # the pow2 bytes_per_reducer histogram exactly once per fetch
+        # lifetime (every read path funnels through close) — and ONLY
+        # for a cleanly COMPLETED fetch: a failed or abandoned fetch
+        # would record partial bytes, and its stage retry would record
+        # the same logical reducer again, skewing the reduce_balance
+        # gauge with tasks that never existed
+        if (self.reader_stats is not None and self._started
+                and not self._reducer_bytes_recorded
+                and not self._failed
+                and self._consumed >= self._expected_results):
+            self._reducer_bytes_recorded = True
+            self.reader_stats.record_reducer_bytes(
+                self.metrics.remote_bytes + self.metrics.local_bytes)
 
     # -- iteration (:342-382) -------------------------------------------
 

@@ -227,6 +227,11 @@ class LocationPlane:
         self._tables: Dict[int, Tuple[DriverTable, int]] = {}
         self._locations: "OrderedDict[Tuple[int, int, int, int], Tuple[list, int]]" = OrderedDict()
         self._shard_maps: Dict[int, Tuple[ShardMap, int]] = {}
+        # reduce plans (shuffle/planner.py): versioned by their OWN
+        # plan_epoch, independent of the location epoch — a location
+        # repair moves bytes, not the carve-up of reduce work. Newest
+        # plan_epoch wins; EPOCH_DEAD drops the plan with the rest.
+        self._plans: Dict[int, object] = {}
         self._max_ranges = max_ranges
         # audit counters (surfaced via snapshot(); the warm-path test and
         # the iterative bench read these)
@@ -250,6 +255,7 @@ class LocationPlane:
                 had = (self._tables.pop(shuffle_id, None) is not None)
                 self._epochs.pop(shuffle_id, None)
                 self._shard_maps.pop(shuffle_id, None)
+                self._plans.pop(shuffle_id, None)
                 dropped = self._drop_locations_locked(shuffle_id)
                 if had or dropped:
                     self.invalidations += 1
@@ -357,6 +363,27 @@ class LocationPlane:
             cached = self._shard_maps.get(shuffle_id)
             return cached[0] if cached is not None else None
 
+    # -- reduce plan ------------------------------------------------------
+
+    def put_plan(self, shuffle_id: int, plan) -> bool:
+        """Cache one shuffle's ReducePlan; newest ``plan_epoch`` wins
+        (pushes may reorder — a stale re-delivery must never roll a
+        re-plan back). Returns True when the plan was ACCEPTED (first
+        plan or a newer epoch) — plan-keyed warm invalidation gates on
+        this, so a rejected stale push can't wipe warm state either."""
+        with self._lock:
+            prev = self._plans.get(shuffle_id)
+            if prev is not None and plan.plan_epoch <= prev.plan_epoch:
+                return False
+            self._plans[shuffle_id] = plan
+            return True
+
+    def plan(self, shuffle_id: int):
+        """The cached ReducePlan (cache-first resolution; validity is by
+        plan_epoch monotonicity, not the location epoch)."""
+        with self._lock:
+            return self._plans.get(shuffle_id)
+
     # -- invalidation -----------------------------------------------------
 
     def _drop_locations_locked(self, shuffle_id: int) -> bool:
@@ -375,6 +402,10 @@ class LocationPlane:
             dropped = (self._tables.pop(shuffle_id, None) is not None)
             dropped |= self._drop_locations_locked(shuffle_id)
             self._shard_maps.pop(shuffle_id, None)
+            # the plan drops too: invalidate() is also the unregister
+            # backstop, and engine shuffle ids are reused — a re-read
+            # refetches the plan from the driver for the price of one RPC
+            self._plans.pop(shuffle_id, None)
             if dropped:
                 self.invalidations += 1
 
@@ -384,6 +415,7 @@ class LocationPlane:
                 "tables": len(self._tables),
                 "ranges": len(self._locations),
                 "shard_maps": len(self._shard_maps),
+                "plans": len(self._plans),
                 "hits": self.hits,
                 "misses": self.misses,
                 "invalidations": self.invalidations,

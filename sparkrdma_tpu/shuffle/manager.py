@@ -151,7 +151,7 @@ class TpuShuffleManager:
         """Driver-side (scala/RdmaShuffleManager.scala:143-183)."""
         if self.driver is None:
             raise RuntimeError("register_shuffle is a driver-role call")
-        self.driver.register_shuffle(shuffle_id, num_maps)
+        self.driver.register_shuffle(shuffle_id, num_maps, num_partitions)
         handle = ShuffleHandle(shuffle_id, num_maps, num_partitions,
                                row_payload_bytes, partitioner, combiner)
         with self._lock:
@@ -176,8 +176,10 @@ class TpuShuffleManager:
         return _PublishingWriter(inner, self.executor, tracer=self.tracer)
 
     def get_reader(self, handle: ShuffleHandle, start_partition: int,
-                   end_partition: int) -> TpuShuffleReader:
-        """(scala/RdmaShuffleManager.scala:234-261)."""
+                   end_partition: int, map_range=None) -> TpuShuffleReader:
+        """(scala/RdmaShuffleManager.scala:234-261). ``map_range`` is the
+        adaptive plan's split-task map slice — ``(map_lo, map_hi)`` reads
+        the partition range from just those maps; None reads all."""
         if self.executor is None:
             raise RuntimeError("get_reader is an executor-role call")
         return TpuShuffleReader(self.executor, self.resolver, self.conf,
@@ -185,7 +187,18 @@ class TpuShuffleManager:
                                 start_partition, end_partition,
                                 handle.row_payload_bytes,
                                 reader_stats=self.reader_stats,
-                                tracer=self.tracer, pool=self.pool)
+                                tracer=self.tracer, pool=self.pool,
+                                map_range=map_range)
+
+    def plan_reduce(self, handle: ShuffleHandle):
+        """Driver-role: build + publish the shuffle's adaptive
+        ReducePlan at map-stage completion (shuffle/planner.py). Returns
+        the plan, or None when ``adaptive_plan`` is off or no sizes were
+        collected — callers fall back to the identity plan."""
+        if self.driver is None:
+            raise RuntimeError("plan_reduce is a driver-role call")
+        return self.driver.build_reduce_plan(handle.shuffle_id,
+                                             tracer=self.tracer)
 
     def recover_and_republish(self) -> dict:
         """Elastic rejoin: recover committed spills from disk and
@@ -196,9 +209,18 @@ class TpuShuffleManager:
         recovered = self.resolver.recover()
         for shuffle_id, entries in recovered.items():
             for m, token in entries:
+                lengths = None
+                if self.conf.adaptive_plan:
+                    # re-publishes must feed the size histogram too, or
+                    # a post-rejoin plan would undercount this executor
+                    table = self.resolver.get_output_table(shuffle_id, m)
+                    if table is not None:
+                        lengths = [table.get_block_location(p).length
+                                   for p in range(table.num_partitions)]
                 self.executor.publish_map_output(
                     shuffle_id, m, token,
-                    fence=self.resolver.committed_fence(shuffle_id, m))
+                    fence=self.resolver.committed_fence(shuffle_id, m),
+                    lengths=lengths)
         return recovered
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
@@ -272,10 +294,16 @@ class _PublishingWriter:
             # the publish carries the attempt's fencing token: a stale
             # (zombie) attempt can't even get here — its commit already
             # raised StaleAttemptError — and the driver's fence check
-            # rejects lateness the resolver couldn't see
+            # rejects lateness the resolver couldn't see. With adaptive
+            # planning the partition lengths (already in hand from the
+            # commit) ride along so the driver's size histogram needs no
+            # extra round trip.
+            lengths = ([int(n) for n in partition_lengths]
+                       if self._endpoint.conf.adaptive_plan else None)
             self._endpoint.publish_map_output(self._inner.shuffle_id,
                                               self._inner.map_id, token,
-                                              fence=self._inner.fence)
+                                              fence=self._inner.fence,
+                                              lengths=lengths)
         return token, partition_lengths
 
     @property

@@ -30,12 +30,15 @@ class TpuShuffleReader:
                  conf: TpuShuffleConf, shuffle_id: int, num_maps: int,
                  start_partition: int, end_partition: int,
                  row_payload_bytes: int, reader_stats=None, tracer=None,
-                 pool=None):
+                 pool=None, map_range=None):
         self.row_payload_bytes = row_payload_bytes
+        # adaptive reduce planning: a plan-SPLIT task reads its partition
+        # from a [map_lo, map_hi) slice of the map space; None = all maps
+        self.map_range = tuple(map_range) if map_range is not None else None
         self.fetcher = ShuffleFetcher(endpoint, resolver, conf, shuffle_id,
                                       num_maps, start_partition, end_partition,
                                       reader_stats=reader_stats, tracer=tracer,
-                                      pool=pool)
+                                      pool=pool, map_range=map_range)
 
     @property
     def metrics(self) -> ReadMetrics:
@@ -90,7 +93,8 @@ class TpuShuffleReader:
             if known is not None and known > 0:
                 cached = dist_cache.get_range(f.shuffle_id, known,
                                               f.start_partition,
-                                              f.end_partition)
+                                              f.end_partition,
+                                              map_range=self.map_range)
                 if cached is not None:
                     f.metrics.warm_range_hits += 1
                     return cached[0].copy(), cached[1].copy()
@@ -109,7 +113,7 @@ class TpuShuffleReader:
 
             dist_cache.put_range(f.shuffle_id, f.epoch, f.start_partition,
                                  f.end_partition, keys.copy(),
-                                 payload.copy())
+                                 payload.copy(), map_range=self.map_range)
         return keys, payload
 
     def read_sorted(self) -> Batch:

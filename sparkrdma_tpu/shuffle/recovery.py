@@ -12,13 +12,23 @@ idempotent positional writes), and reducers retry.
 On a TPU mesh the same concern appears as "a failed participant stalls the
 collective"; the recovery mirrors the reference's: drop the dead member
 (tombstone), re-form, re-run the round (SURVEY.md §7 hard part #4).
+
+:func:`run_planned_reduce` is the adaptive-planner execution loop
+(shuffle/planner.py): it drives a driver-published :class:`ReducePlan`
+across the cluster and RE-PLANS mid-stage on executor loss — completed
+tasks keep their results and exact ranges, only orphaned tasks are
+re-assigned to survivors under a bumped plan epoch, so a loss costs the
+orphans plus the recompute, never a duplicate or lost row.
 """
 
 from __future__ import annotations
 
 import logging
 import time
-from typing import Callable, Dict, List, Optional, Sequence, TypeVar
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, TypeVar
+
+import numpy as np
 
 from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
@@ -37,24 +47,38 @@ ReduceTask = Callable[[TpuShuffleManager, ShuffleHandle], T]
 def run_map_stage(executors: Sequence[TpuShuffleManager],
                   handle: ShuffleHandle, map_fn: MapTask,
                   map_ids: Sequence[int] = (),
-                  placement: Dict[int, int] = None) -> Dict[int, int]:
+                  placement: Dict[int, int] = None,
+                  slot_loads: Optional[Dict[int, float]] = None
+                  ) -> Dict[int, int]:
     """Run map tasks round-robin (or per ``placement``); returns the
     executor index that ran each map.
 
     A :class:`WriteFailedError` — the attempt failed its DISK writes
     cleanly (spill retries and fallback dirs exhausted, merge/commit
     error, dead spill worker; every tmp/spill file already reaped) — is
-    the write-side twin of a lost peer: the map re-places on the next
-    live executor instead of failing the stage, up to one attempt per
-    live executor."""
+    the write-side twin of a lost peer: the map re-places on the
+    LEAST-LOADED live executor (not blindly the next slot), up to one
+    attempt per live executor. Load = ``slot_loads`` (the caller's view
+    of bytes already owned per slot — recovery feeds the planner's size
+    stats here) plus the bytes this call has placed so far, so a burst
+    of re-placements spreads instead of piling onto one lucky
+    survivor."""
     live = [i for i, ex in enumerate(executors)
             if ex.executor is not None and not ex.executor.server.stopped]
+    loads: Dict[int, float] = {s: 0.0 for s in live}
+    if slot_loads:
+        for s, v in slot_loads.items():
+            if s in loads:
+                loads[s] += float(v)
     ran: Dict[int, int] = {}
     ids = list(map_ids) if map_ids else list(range(handle.num_maps))
     for k, m in enumerate(ids):
         first = (placement or {}).get(m, live[k % len(live)])
         # candidate order: the planned slot, then every other live slot
-        candidates = [first] + [s for s in live if s != first]
+        # least-loaded first (deterministic: ties break on slot index)
+        candidates = [first] + sorted(
+            (s for s in live if s != first),
+            key=lambda s: (loads.get(s, 0.0), s))
         last_err: Optional[WriteFailedError] = None
         for slot in candidates:
             writer = executors[slot].get_writer(handle, m)
@@ -62,12 +86,18 @@ def run_map_stage(executors: Sequence[TpuShuffleManager],
                 map_fn(writer, m)
                 writer.close()
                 ran[m] = slot
+                try:
+                    written = int(writer.metrics.get("bytes_written", 0))
+                except (AttributeError, TypeError):
+                    written = 0
+                loads[slot] = loads.get(slot, 0.0) + max(1, written)
                 last_err = None
                 break
             except WriteFailedError as e:
                 last_err = e
                 log.warning("map %d write attempt failed on executor slot "
-                            "%d (%s); re-placing", m, slot, e)
+                            "%d (%s); re-placing on the least-loaded "
+                            "survivor", m, slot, e)
                 if not getattr(writer, "closed", True):
                     # the failure came from write_batch: abort the
                     # attempt so nothing of it survives on disk
@@ -115,6 +145,128 @@ def _tombstone_slot(driver: object, dead_slot: int) -> None:
     endpoint.remove_member(dead)
 
 
+def _recovery_slot_loads(table, num_maps: int, hist=None) -> Dict[int, float]:
+    """Per-slot load view for recompute placement: bytes each slot
+    already owns when the size histogram has them (the planner's stats),
+    else a map count — the same stats the planner places with."""
+    loads: Dict[int, float] = {}
+    for m in range(num_maps):
+        entry = table.entry(m)
+        if entry is None:
+            continue
+        weight = 1.0
+        if hist is not None:
+            weight = float(hist.map_bytes(m, 0, hist.num_partitions)) or 1.0
+        loads[entry[1]] = loads.get(entry[1], 0.0) + weight
+    return loads
+
+
+def recover_lost_maps(executors: Sequence[TpuShuffleManager],
+                      handle: ShuffleHandle, map_fn: MapTask,
+                      failure: FetchFailedError, endpoint,
+                      driver: object = None, attempt: int = 1) -> int:
+    """The shared recompute step behind every reduce retry: identify the
+    maps lost with (or corrupted on) the blamed slot, recompute them on
+    survivors — placed least-loaded using the same size stats the
+    planner keeps — and wait for the repair publishes to become visible.
+    ``endpoint`` is the recovering reducer's ExecutorEndpoint (table
+    reads + cache invalidation go through it). Returns the dead slot
+    (-1 for a corrupt-output verdict, where the owner stays live)."""
+    dead_slot = failure.exec_index
+    corrupt = getattr(failure, "verdict", "peer_lost") == "corrupt_output"
+    table = endpoint.get_driver_table(handle.shuffle_id, 0, timeout=5)
+    if corrupt and failure.map_id >= 0:
+        # the owner is ALIVE — its committed output for THIS map
+        # failed at-rest verification (and is quarantined on the
+        # owner). Re-execute just that map; never tombstone a
+        # live peer over bit-rot, and don't recompute its healthy
+        # outputs
+        lost_maps: List[int] = [failure.map_id]
+        log.warning("stage retry %d: re-executing map %d of "
+                    "shuffle %d (committed output corrupt on "
+                    "slot %d)", attempt, failure.map_id,
+                    handle.shuffle_id, dead_slot)
+    else:
+        # every map currently owned by the failed slot must be
+        # recomputed, not just the one that tripped the fetch
+        _tombstone_slot(driver, dead_slot)
+        lost_maps = []
+        for m in range(handle.num_maps):
+            entry = table.entry(m)
+            if entry is None or entry[1] == dead_slot:
+                lost_maps.append(m)
+        if not lost_maps and failure.map_id >= 0:
+            lost_maps = [failure.map_id]
+        log.warning("stage retry %d: recomputing maps %s lost with "
+                    "executor slot %d", attempt, lost_maps,
+                    dead_slot)
+    # the entries being replaced, so the repair-visibility poll
+    # below can tell an overwrite from the stale original even
+    # when the new owner is the SAME slot (corrupt verdict)
+    old_entries = {m: table.entry(m) for m in lost_maps}
+    # survivors = executors whose endpoint slot is not the dead
+    # one AND whose server is still up: with TWO dead executors,
+    # the first repair must not place recomputes on the second
+    # (its resolver would happily write, its publishes would
+    # advertise an unreachable owner, and the reduce would burn a
+    # whole extra stage retry discovering it). For a corrupt
+    # verdict the blamed slot is alive and eligible — a
+    # re-execution there replaces the quarantined file in place.
+    survivors = []
+    for i, ex in enumerate(executors):
+        if ex.executor is None or ex.executor.server.stopped:
+            continue
+        try:
+            if corrupt or ex.executor.exec_index(timeout=1) != dead_slot:
+                survivors.append(i)
+        except KeyError:
+            continue
+    if not survivors:
+        raise failure
+    placement = {m: survivors[k % len(survivors)]
+                 for k, m in enumerate(lost_maps)}
+    # recompute placement prefers the least-loaded survivor, weighed by
+    # the planner's size stats when the driver keeps them (satellite of
+    # the adaptive planner: re-placement uses the same byte view)
+    hist = None
+    drv_ep = getattr(driver, "driver", driver)
+    if drv_ep is not None and hasattr(drv_ep, "size_histogram"):
+        hist = drv_ep.size_histogram(handle.shuffle_id)
+    loads = _recovery_slot_loads(table, handle.num_maps, hist)
+    run_map_stage(executors, handle, map_fn, lost_maps, placement,
+                  slot_loads=loads)
+    # publishes are one-sided (no ack) and a repair OVERWRITE
+    # doesn't change the publish count, so the long-poll can't
+    # sync on it: poll until the table visibly stops naming the
+    # dead slot, else the next attempt races the in-flight
+    # republish, reads the stale entry, and burns a whole stage
+    # retry on the same failure (engine.py's recovery waits the
+    # same way)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        endpoint.invalidate_shuffle(handle.shuffle_id)
+        table = endpoint.get_driver_table(handle.shuffle_id, 0, timeout=5)
+        entries = {m: table.entry(m) for m in lost_maps}
+        if corrupt:
+            # the re-execution may land on the SAME slot (new
+            # token, new fence): visible = the entry CHANGED
+            done = all(ent is not None and ent != old_entries[m]
+                       for m, ent in entries.items())
+        else:
+            done = all(ent is not None and ent[1] != dead_slot
+                       for ent in entries.values())
+        if done:
+            break
+        time.sleep(0.005)
+    else:
+        log.warning("repair publishes for shuffle %d maps %s not "
+                    "visible within 5s; the retry may re-fail",
+                    handle.shuffle_id, lost_maps)
+    # the repaired table must be re-read, not served from cache
+    endpoint.invalidate_shuffle(handle.shuffle_id)
+    return -1 if corrupt else dead_slot
+
+
 def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
                           handle: ShuffleHandle, map_fn: MapTask,
                           reduce_fn: ReduceTask, reducer_index: int,
@@ -142,88 +294,132 @@ def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
             attempt += 1
             if attempt > max_stage_retries:
                 raise
-            dead_slot = e.exec_index
-            corrupt = getattr(e, "verdict", "peer_lost") == "corrupt_output"
-            table = executors[reducer_index].executor.get_driver_table(
-                handle.shuffle_id, 0, timeout=5)
-            if corrupt and e.map_id >= 0:
-                # the owner is ALIVE — its committed output for THIS map
-                # failed at-rest verification (and is quarantined on the
-                # owner). Re-execute just that map; never tombstone a
-                # live peer over bit-rot, and don't recompute its healthy
-                # outputs
-                lost_maps: List[int] = [e.map_id]
-                log.warning("stage retry %d: re-executing map %d of "
-                            "shuffle %d (committed output corrupt on "
-                            "slot %d)", attempt, e.map_id,
-                            handle.shuffle_id, dead_slot)
-            else:
-                # every map currently owned by the failed slot must be
-                # recomputed, not just the one that tripped the fetch
-                _tombstone_slot(driver, dead_slot)
-                lost_maps = []
-                for m in range(handle.num_maps):
-                    entry = table.entry(m)
-                    if entry is None or entry[1] == dead_slot:
-                        lost_maps.append(m)
-                if not lost_maps and e.map_id >= 0:
-                    lost_maps = [e.map_id]
-                log.warning("stage retry %d: recomputing maps %s lost with "
-                            "executor slot %d", attempt, lost_maps,
-                            dead_slot)
-            # the entries being replaced, so the repair-visibility poll
-            # below can tell an overwrite from the stale original even
-            # when the new owner is the SAME slot (corrupt verdict)
-            old_entries = {m: table.entry(m) for m in lost_maps}
-            # survivors = executors whose endpoint slot is not the dead
-            # one AND whose server is still up: with TWO dead executors,
-            # the first repair must not place recomputes on the second
-            # (its resolver would happily write, its publishes would
-            # advertise an unreachable owner, and the reduce would burn a
-            # whole extra stage retry discovering it). For a corrupt
-            # verdict the blamed slot is alive and eligible — a
-            # re-execution there replaces the quarantined file in place.
-            survivors = []
-            for i, ex in enumerate(executors):
-                if ex.executor is None or ex.executor.server.stopped:
-                    continue
-                try:
-                    if corrupt or ex.executor.exec_index(timeout=1) != dead_slot:
-                        survivors.append(i)
-                except KeyError:
-                    continue
-            if not survivors:
+            recover_lost_maps(executors, handle, map_fn, e,
+                              executors[reducer_index].executor,
+                              driver=driver, attempt=attempt)
+
+
+@dataclass
+class PlannedReduceResult:
+    """What :func:`run_planned_reduce` hands back: the stage's rows in
+    deterministic task order, plus the plan state for audits/tests."""
+
+    keys: np.ndarray
+    payload: np.ndarray
+    plan: object                      # the FINAL ReducePlan executed
+    task_slots: Dict[int, int] = field(default_factory=dict)
+    replans: int = 0
+    tasks_rerun: int = 0              # tasks executed more than once (0 =
+    #                                   every completed range was kept)
+
+
+def _live_slot_managers(executors: Sequence[TpuShuffleManager]
+                        ) -> Dict[int, TpuShuffleManager]:
+    out: Dict[int, TpuShuffleManager] = {}
+    for ex in executors:
+        if ex.executor is None or ex.executor.server.stopped:
+            continue
+        try:
+            out[ex.executor.exec_index(timeout=1)] = ex
+        except KeyError:
+            continue
+    return out
+
+
+def run_planned_reduce(executors: Sequence[TpuShuffleManager],
+                       handle: ShuffleHandle, map_fn: MapTask,
+                       driver: object, max_stage_retries: int = 2,
+                       on_task_done=None) -> PlannedReduceResult:
+    """Execute the shuffle's adaptive :class:`ReducePlan` across the
+    cluster, re-planning mid-stage on executor loss.
+
+    Resolution is cache-first against the driver's published plan; with
+    no plan (adaptive planning off, mixed-version cluster) the identity
+    plan runs — one reducer per partition, exactly today's behavior.
+    Each task reads its ``[start_partition, end_partition)`` x
+    ``[map_start, map_end)`` slice on its placed executor (falling back
+    round-robin over live slots when the placement is gone).
+
+    On ``FetchFailedError`` the lost maps recompute on survivors
+    (:func:`recover_lost_maps`), then the driver RE-PLANS: completed
+    tasks keep their results and exact ranges, only orphaned tasks are
+    re-assigned under a bumped plan epoch — zero duplicate and zero
+    lost rows, asserted by the chaos matrix. ``on_task_done(task,
+    slot)`` is the chaos hook (scenarios kill executors between tasks).
+
+    Returns rows concatenated in deterministic task order (sorted by
+    ``(start_partition, map_start)`` — split slices merge in map order).
+    """
+    from sparkrdma_tpu.shuffle.planner import identity_plan
+
+    endpoint = getattr(driver, "driver", driver)
+    plan = None
+    if endpoint is not None and hasattr(endpoint, "reduce_plan"):
+        plan = endpoint.reduce_plan(handle.shuffle_id)
+        if plan is None:
+            plan = endpoint.build_reduce_plan(handle.shuffle_id)
+    if plan is None:
+        plan = identity_plan(handle.shuffle_id, handle.num_maps,
+                             handle.num_partitions)
+    completed: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+    result = PlannedReduceResult(np.zeros(0, dtype=np.uint64),
+                                 np.zeros((0, handle.row_payload_bytes),
+                                          dtype=np.uint8), plan)
+    executions: Dict[int, int] = {}
+    replans = 0
+    attempt = 0
+    while True:
+        pending = [t for t in plan.tasks if t.task_id not in completed]
+        if not pending:
+            break
+        try:
+            for i, task in enumerate(pending):
+                slot_mgrs = _live_slot_managers(executors)
+                if not slot_mgrs:
+                    raise RuntimeError("no live executors")
+                live_sorted = sorted(slot_mgrs)
+                slot = (task.placement if task.placement in slot_mgrs
+                        else live_sorted[i % len(live_sorted)])
+                mgr = slot_mgrs[slot]
+                reader = mgr.get_reader(
+                    handle, task.start_partition, task.end_partition,
+                    map_range=(task.map_start, task.map_end))
+                keys, payload = reader.read_all()
+                executions[task.task_id] = \
+                    executions.get(task.task_id, 0) + 1
+                completed[task.task_id] = (keys, payload)
+                result.task_slots[task.task_id] = slot
+                if on_task_done is not None:
+                    on_task_done(task, slot)
+        except FetchFailedError as e:
+            attempt += 1
+            if attempt > max_stage_retries:
                 raise
-            placement = {m: survivors[k % len(survivors)]
-                         for k, m in enumerate(lost_maps)}
-            run_map_stage(executors, handle, map_fn, lost_maps, placement)
-            # publishes are one-sided (no ack) and a repair OVERWRITE
-            # doesn't change the publish count, so the long-poll can't
-            # sync on it: poll until the table visibly stops naming the
-            # dead slot, else the next attempt races the in-flight
-            # republish, reads the stale entry, and burns a whole stage
-            # retry on the same failure (engine.py's recovery waits the
-            # same way)
-            ep = executors[reducer_index].executor
-            deadline = time.monotonic() + 5.0
-            while time.monotonic() < deadline:
-                ep.invalidate_shuffle(handle.shuffle_id)
-                table = ep.get_driver_table(handle.shuffle_id, 0, timeout=5)
-                entries = {m: table.entry(m) for m in lost_maps}
-                if corrupt:
-                    # the re-execution may land on the SAME slot (new
-                    # token, new fence): visible = the entry CHANGED
-                    done = all(ent is not None and ent != old_entries[m]
-                               for m, ent in entries.items())
-                else:
-                    done = all(ent is not None and ent[1] != dead_slot
-                               for ent in entries.values())
-                if done:
-                    break
-                time.sleep(0.005)
-            else:
-                log.warning("repair publishes for shuffle %d maps %s not "
-                            "visible within 5s; the retry may re-fail",
-                            handle.shuffle_id, lost_maps)
-            # the repaired table must be re-read, not served from cache
-            ep.invalidate_shuffle(handle.shuffle_id)
+            slot_mgrs = _live_slot_managers(executors)
+            if not slot_mgrs:
+                raise
+            recover_ep = next(iter(slot_mgrs.values())).executor
+            dead_slot = recover_lost_maps(executors, handle, map_fn, e,
+                                          recover_ep, driver=driver,
+                                          attempt=attempt)
+            if endpoint is not None and hasattr(endpoint, "replan_reduce"):
+                new_plan = endpoint.replan_reduce(
+                    handle.shuffle_id, set(completed),
+                    dead_slot=dead_slot)
+                if new_plan is not None:
+                    plan = new_plan
+                    replans += 1
+    result.plan = plan
+    result.replans = replans
+    result.tasks_rerun = sum(1 for n in executions.values() if n > 1)
+    # deterministic merge: coalesced runs in partition order, split
+    # slices of one partition in map order
+    order = sorted(plan.tasks, key=lambda t: (t.start_partition,
+                                              t.map_start,
+                                              t.end_partition))
+    keys_parts = [completed[t.task_id][0] for t in order]
+    payload_parts = [completed[t.task_id][1] for t in order]
+    if keys_parts:
+        result.keys = np.concatenate(keys_parts)
+        result.payload = np.concatenate(payload_parts)
+    return result

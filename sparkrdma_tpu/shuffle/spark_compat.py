@@ -71,9 +71,13 @@ class SparkCompatShuffleManager:
                                                combiner=combiner))
 
     def getReader(self, handle: ShuffleHandle, startPartition: int,
-                  endPartition: int, context=None) -> "CompatReader":
+                  endPartition: int, context=None,
+                  mapRange=None) -> "CompatReader":
+        """``mapRange`` is the adaptive plan's split-task map slice
+        (``(map_lo, map_hi)``); None reads the full map space."""
         return CompatReader(self._m.get_reader(handle, startPartition,
-                                               endPartition))
+                                               endPartition,
+                                               map_range=mapRange))
 
     def unregisterShuffle(self, shuffleId: int) -> bool:
         self._m.unregister_shuffle(shuffleId)

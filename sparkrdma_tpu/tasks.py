@@ -66,8 +66,19 @@ class _RemoteTaskContext:
         self._parents = parent_handles
         self.task_id = task_id
 
-    def read(self, parent_index: int = 0):
+    def read(self, parent_index: int = 0, start=None, end=None,
+             map_range=None):
+        """Default: this task's own partition. A PLANNED reduce task
+        (adaptive planner, shuffle/planner.py) passes an explicit
+        coalesced partition range and/or a split map slice — those
+        bypass the mesh cache (it holds whole single partitions) and go
+        through the ordinary fetcher, which understands both."""
         handle = self._parents[parent_index]
+        if start is not None or end is not None or map_range is not None:
+            lo = self.task_id if start is None else start
+            hi = lo + 1 if end is None else end
+            return self.manager.getReader(handle, lo, hi,
+                                          mapRange=map_range)
         cached = dist_cache.get(handle.shuffle_id, self.task_id)
         if cached is not None:
             from sparkrdma_tpu.shuffle.mesh_service import CachedPartitionReader

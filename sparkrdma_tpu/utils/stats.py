@@ -298,6 +298,13 @@ class ShuffleReaderStats:
         # whole point is fewer, larger requests — visible here as mass
         # shifting into the high buckets
         self.request_bytes = BytesHistogram()
+        # skew observability (adaptive reduce planner): total input bytes
+        # per REDUCER task, pow2-bucketed, plus the max for the
+        # reduce_balance gauge (max/mean — 1.0 is perfectly balanced,
+        # a zipfian stage under the static plan reads >> 1, and the
+        # planner's whole job is pulling it back toward 1)
+        self.bytes_per_reducer = BytesHistogram()
+        self._reducer_max_bytes = 0
 
     def update(self, exec_index: int, latency_s: float,
                nbytes: int = -1) -> None:
@@ -311,6 +318,24 @@ class ShuffleReaderStats:
             if nbytes >= 0:
                 self.request_bytes.add(nbytes)
 
+    def record_reducer_bytes(self, nbytes: int) -> None:
+        """One reducer task's total input bytes (recorded once per fetch
+        lifetime, at fetcher close)."""
+        with self._lock:
+            self.bytes_per_reducer.add(nbytes)
+            self._reducer_max_bytes = max(self._reducer_max_bytes,
+                                          max(0, int(nbytes)))
+
+    def reduce_balance(self) -> float:
+        """max/mean bytes across recorded reducer tasks (the skew
+        gauge); 0.0 before any reducer finished."""
+        with self._lock:
+            hist = self.bytes_per_reducer
+            if not hist.count:
+                return 0.0
+            mean = hist.total_bytes / hist.count
+            return float(self._reducer_max_bytes / mean) if mean else 0.0
+
     def snapshot(self) -> dict:
         with self._lock:
             snap = {
@@ -320,6 +345,13 @@ class ShuffleReaderStats:
             }
             if self.request_bytes.count:
                 snap["request_bytes"] = self.request_bytes.summary()
+            if self.bytes_per_reducer.count:
+                snap["bytes_per_reducer"] = self.bytes_per_reducer.summary()
+                mean = (self.bytes_per_reducer.total_bytes
+                        / self.bytes_per_reducer.count)
+                snap["reduce_balance"] = (
+                    round(self._reducer_max_bytes / mean, 3) if mean
+                    else 0.0)
         pipeline = self.pipeline.snapshot()
         if pipeline["per_peer"]:
             snap["pipeline"] = pipeline

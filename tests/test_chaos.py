@@ -51,6 +51,12 @@ DISK = os.environ.get("CHAOS_DISK", "1") not in ("0", "false")
 # the failure paths differ (a warm reducer holds locations a loss just
 # invalidated; a cold one re-syncs every time)
 WARM = os.environ.get("CHAOS_WARM", "1") not in ("0", "false")
+# adaptive reduce planning under chaos: 1 runs the whole matrix with
+# adaptive_plan on (publishes carry size vectors into the driver's
+# histogram, plans build on demand) so the planner's publish/plan paths
+# see every injected fault; run_chaos.sh sweeps both. The mid-stage
+# re-plan scenario below forces it on regardless.
+SKEW = os.environ.get("CHAOS_SKEW", "0") not in ("0", "false")
 
 
 def _conf(**kw):
@@ -60,6 +66,7 @@ def _conf(**kw):
                 pre_warm_connections=False,
                 coalesce_reads=COALESCE,
                 location_epoch_cache=WARM,
+                adaptive_plan=SKEW,
                 collect_shuffle_reader_stats=True)
     base.update(kw)
     return TpuShuffleConf(**base)
@@ -426,6 +433,78 @@ def test_chaos_corrupt_reexecution_bumps_epoch_mid_iteration(tmp_path):
         assert r.metrics.failed_fetches == 0, f"seed={SEED}"
     finally:
         injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def _skew_map_fn(writer, map_id):
+    rng = np.random.default_rng(4000 + map_id)
+    keys = np.where(rng.random(1500) < 0.7, 3,
+                    rng.integers(0, 8, 1500)).astype(np.uint64)
+    writer.write_batch(keys)
+
+
+def _skew_expected(num_maps):
+    parts = []
+    for m in range(num_maps):
+        rng = np.random.default_rng(4000 + m)
+        parts.append(np.where(rng.random(1500) < 0.7, 3,
+                              rng.integers(0, 8, 1500)).astype(np.uint64))
+    return np.sort(np.concatenate(parts))
+
+
+def test_chaos_replan_mid_stage_after_executor_loss(tmp_path):
+    """The adaptive planner's mid-stage re-plan: a skewed shuffle plans
+    into coalesced + split tasks placed across executors; one executor
+    dies AFTER the first task completes. The lost maps recompute on
+    survivors, the driver re-plans under a bumped plan epoch — completed
+    tasks keep their ranges and results, only orphaned tasks re-assign —
+    and the stage finishes with ZERO duplicate and ZERO lost rows
+    (exact multiset equality against the fault-free ground truth)."""
+    from sparkrdma_tpu.shuffle.recovery import run_planned_reduce
+
+    driver, execs = _cluster(tmp_path, adaptive_plan=True,
+                             coalesce_target_bytes=2048,
+                             split_threshold_bytes=4096)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=8,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _skew_map_fn)
+        plan = driver.plan_reduce(handle)
+        assert plan is not None and len(plan.tasks) >= 3, f"seed={SEED}"
+        assert plan.counts()["split_partitions"] >= 1, f"seed={SEED}"
+
+        victim_slot = execs[2].executor.exec_index()
+        state = {"killed": False}
+
+        def kill_after_first(task, slot):
+            if not state["killed"]:
+                state["killed"] = True
+                execs[2].executor.server.stop()
+
+        res = run_planned_reduce(execs, handle, _skew_map_fn, driver,
+                                 on_task_done=kill_after_first)
+        # zero lost, zero duplicate rows: exact multiset equality
+        np.testing.assert_array_equal(np.sort(res.keys),
+                                      _skew_expected(6),
+                                      err_msg=f"seed={SEED}")
+        assert state["killed"], f"seed={SEED}"
+        # the loss forced at least one re-plan under a bumped epoch...
+        assert res.plan.plan_epoch > plan.plan_epoch, f"seed={SEED}"
+        assert driver.driver.plan_replans >= 1, f"seed={SEED}"
+        # ...that kept every task's exact ranges (only placement moved)
+        by_id = {t.task_id: t for t in res.plan.tasks}
+        for t in plan.tasks:
+            n = by_id[t.task_id]
+            assert (n.start_partition, n.end_partition, n.map_start,
+                    n.map_end) == (t.start_partition, t.end_partition,
+                                   t.map_start, t.map_end), f"seed={SEED}"
+        # completed ranges were never re-executed
+        assert res.tasks_rerun == 0, f"seed={SEED}"
+        # the repaired table no longer names the dead slot
+        table = execs[0].executor.get_driver_table(1, 6, timeout=5)
+        for m in range(6):
+            assert table.entry(m)[1] != victim_slot, f"seed={SEED}"
+    finally:
         _shutdown(driver, execs)
 
 

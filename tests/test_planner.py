@@ -1,0 +1,556 @@
+"""Adaptive skew-aware reduce planner (shuffle/planner.py).
+
+Unit layer: plan determinism, exact (partition x map) tiling,
+coalesce/split boundary cases, placement policy, re-plan orphan rules,
+wire round-trips. Cluster layer: byte-identical output vs the static
+plan on every dataplane combo, plan push/cache-first resolution, warm
+read-cache invalidation on plan-epoch change, least-loaded re-placement,
+and the skew microbench acceptance gates (``SKEW_SEED`` sweeps extra
+seeds via scripts/run_skew_bench.sh).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.shuffle import dist_cache
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+from sparkrdma_tpu.shuffle.planner import (
+    PlanTask,
+    ReducePlan,
+    ReducePlanner,
+    SizeHistogram,
+    identity_plan,
+    reduce_balance,
+)
+from sparkrdma_tpu.shuffle.recovery import (
+    run_map_stage,
+    run_planned_reduce,
+)
+
+SEED = int(os.environ.get("SKEW_SEED", "0"))
+
+
+def _conf(**kw):
+    base = dict(coalesce_target_bytes=4096,
+                split_threshold_bytes=16384,
+                locality_placement=True)
+    base.update(kw)
+    return TpuShuffleConf(**base)
+
+
+def _hist(num_maps, rows):
+    h = SizeHistogram(num_maps, len(rows[0]))
+    for m, row in enumerate(rows):
+        h.add(m, row)
+    return h
+
+
+def _tiles(plan):
+    """Every (partition, map) cell covered by the plan's tasks."""
+    cells = []
+    for t in plan.tasks:
+        for p in range(t.start_partition, t.end_partition):
+            for m in range(t.map_start, t.map_end):
+                cells.append((p, m))
+    return cells
+
+
+# -- unit: plan construction ---------------------------------------------
+
+
+def test_plan_deterministic_and_wire_stable():
+    rng = np.random.default_rng(SEED)
+    rows = [rng.integers(0, 60000, 16).tolist() for _ in range(5)]
+    conf = _conf()
+    owners = {m: m % 3 for m in range(5)}
+    a = ReducePlanner(conf).plan(9, _hist(5, rows), owners, [0, 1, 2])
+    b = ReducePlanner(conf).plan(9, _hist(5, rows), owners, [0, 1, 2])
+    assert a == b
+    assert ReducePlan.from_bytes(a.to_bytes()) == a
+
+
+def test_plan_tiles_partition_map_space_exactly():
+    """No duplicate and no lost cell, whatever the skew: the tiling is
+    what makes re-plans row-exact."""
+    rng = np.random.default_rng(SEED + 1)
+    for _ in range(5):
+        rows = [rng.integers(0, 80000, 12).tolist() for _ in range(4)]
+        plan = ReducePlanner(_conf()).plan(
+            9, _hist(4, rows), {m: 0 for m in range(4)}, [0, 1])
+        cells = _tiles(plan)
+        assert len(cells) == len(set(cells)) == 12 * 4, cells
+
+
+def test_all_tiny_coalesces_into_runs():
+    rows = [[10] * 12 for _ in range(4)]
+    plan = ReducePlanner(_conf()).plan(9, _hist(4, rows),
+                                       {m: 0 for m in range(4)}, [0])
+    assert len(plan.tasks) < 12
+    assert plan.counts()["coalesced_runs"] >= 1
+    assert plan.counts()["split_partitions"] == 0
+    assert sorted(_tiles(plan)) == [(p, m) for p in range(12)
+                                    for m in range(4)]
+
+
+def test_one_hot_partition_splits_by_map_range():
+    rows = [[100, 100, 30000, 100] for _ in range(6)]
+    plan = ReducePlanner(_conf(coalesce_target_bytes=1)).plan(
+        9, _hist(6, rows), {m: m % 3 for m in range(6)}, [0, 1, 2])
+    splits = [t for t in plan.tasks if t.is_split(6)]
+    assert splits, plan
+    assert all(t.start_partition == 2 and t.end_partition == 3
+               for t in splits)
+    # the split slices partition the map space in order, no overlap
+    spans = sorted((t.map_start, t.map_end) for t in splits)
+    assert spans[0][0] == 0 and spans[-1][1] == 6
+    assert all(spans[i][1] == spans[i + 1][0]
+               for i in range(len(spans) - 1))
+    # near-equal bytes per slice (uniform per-map contribution here)
+    sizes = [(hi - lo) for lo, hi in spans]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_uniform_input_produces_identity_plan():
+    """Sizes between the coalesce target and the split threshold: the
+    plan must be exactly today's static plan (no regression for
+    balanced workloads)."""
+    rows = [[8000] * 8 for _ in range(4)]
+    plan = ReducePlanner(_conf()).plan(9, _hist(4, rows),
+                                       {m: 0 for m in range(4)}, [0, 1])
+    assert plan.is_identity
+    assert len(plan.tasks) == 8
+
+
+def test_single_map_never_splits():
+    rows = [[100, 10 ** 6, 100, 100]]
+    plan = ReducePlanner(_conf(coalesce_target_bytes=1)).plan(
+        9, _hist(1, rows), {0: 0}, [0])
+    assert all(not t.is_split(1) for t in plan.tasks)
+
+
+def test_split_bounds_forced_cuts_cover_scarce_maps():
+    h = _hist(6, [[100] * 4 for _ in range(6)])
+    assert h.split_bounds(1, 6) == [(m, m + 1) for m in range(6)]
+    assert h.split_bounds(1, 4) == [(0, 2), (2, 4), (4, 5), (5, 6)]
+    assert h.split_bounds(1, 1) == [(0, 6)]
+    # more pieces than maps clamps
+    assert h.split_bounds(1, 99) == [(m, m + 1) for m in range(6)]
+
+
+def test_empty_histogram_plans_nothing_weird():
+    h = SizeHistogram(4, 8)
+    plan = ReducePlanner(_conf()).plan(9, h, {}, [0])
+    assert sorted(_tiles(plan)) == [(p, m) for p in range(8)
+                                    for m in range(4)]
+
+
+# -- unit: placement + re-plan -------------------------------------------
+
+
+def test_locality_placement_prefers_largest_owner():
+    # slot 1 owns the maps carrying partition 0's bytes (sizes below
+    # the split threshold so the partition stays one task)
+    rows = [[8000, 100], [7000, 100], [100, 100]]
+    owners = {0: 1, 1: 1, 2: 0}
+    plan = ReducePlanner(_conf(coalesce_target_bytes=1)).plan(
+        9, _hist(3, rows), owners, [0, 1, 2])
+    assert plan.placement_of(0) == 1
+
+
+def test_balance_cap_spreads_single_owner_stage():
+    """Every byte owned by slot 0 must NOT pile every task onto slot 0
+    — the cap re-creates the spread locality would destroy."""
+    rows = [[20000] * 8 for _ in range(4)]
+    owners = {m: 0 for m in range(4)}
+    plan = ReducePlanner(_conf()).plan(9, _hist(4, rows), owners,
+                                       [0, 1, 2])
+    used = {t.placement for t in plan.tasks}
+    assert len(used) >= 2, plan
+
+
+def test_locality_placement_off_leaves_no_preference():
+    rows = [[8000] * 4 for _ in range(2)]
+    plan = ReducePlanner(_conf(locality_placement=False)).plan(
+        9, _hist(2, rows), {0: 0, 1: 1}, [0, 1])
+    assert all(t.placement == -1 for t in plan.tasks)
+
+
+def test_replan_moves_only_orphans_and_bumps_epoch():
+    rng = np.random.default_rng(SEED + 2)
+    rows = [rng.integers(100, 60000, 10).tolist() for _ in range(4)]
+    planner = ReducePlanner(_conf())
+    owners = {m: m % 3 for m in range(4)}
+    plan = planner.plan(9, _hist(4, rows), owners, [0, 1, 2])
+    dead = 1
+    completed = [t.task_id for t in plan.tasks[:2]]
+    new = planner.replan(plan, _hist(4, rows), owners, [0, 2],
+                         completed)
+    assert new.plan_epoch == plan.plan_epoch + 1
+    by_id = {t.task_id: t for t in new.tasks}
+    for t in plan.tasks:
+        n = by_id[t.task_id]
+        # ranges NEVER change on a re-plan
+        assert (n.start_partition, n.end_partition, n.map_start,
+                n.map_end) == (t.start_partition, t.end_partition,
+                               t.map_start, t.map_end)
+        if t.task_id in completed or t.placement != dead:
+            assert n.placement == t.placement  # kept
+        else:
+            assert n.placement in (0, 2)  # orphan moved off the dead slot
+
+
+def test_reduce_balance_gauge():
+    assert reduce_balance([]) == 0.0
+    assert reduce_balance([10, 10, 10]) == pytest.approx(1.0)
+    assert reduce_balance([10, 10, 80]) == pytest.approx(2.4)
+
+
+# -- unit: wire messages --------------------------------------------------
+
+
+def test_publish_msg_lengths_roundtrip():
+    entry = b"\x01" * 12
+    with_l = M.PublishMsg(3, 7, entry, fence=9, lengths=[1, 2, 3])
+    back = M.PublishMsg.from_payload(with_l.payload())
+    assert (back.shuffle_id, back.map_id, back.fence) == (3, 7, 9)
+    assert back.entry == entry and back.lengths == [1, 2, 3]
+    # a pre-planning publish (no lengths) decodes with lengths=None
+    legacy = M.PublishMsg(3, 7, entry, fence=9)
+    assert M.PublishMsg.from_payload(legacy.payload()).lengths is None
+    # empty lengths survive too (an empty-partition map)
+    empty = M.PublishMsg(3, 7, entry, fence=9, lengths=[])
+    assert M.PublishMsg.from_payload(empty.payload()).lengths == []
+
+
+def test_plan_wire_messages_roundtrip():
+    plan = identity_plan(5, 3, 4, plan_epoch=7)
+    push = M.ReducePlanMsg.from_payload(
+        M.ReducePlanMsg(plan.to_bytes()).payload())
+    assert ReducePlan.from_bytes(push.plan_bytes) == plan
+    req = M.FetchPlanReq.from_payload(M.FetchPlanReq(11, 5).payload())
+    assert (req.req_id, req.shuffle_id) == (11, 5)
+    resp = M.FetchPlanResp.from_payload(
+        M.FetchPlanResp(11, M.STATUS_OK, plan.to_bytes()).payload())
+    assert resp.status == M.STATUS_OK
+    assert ReducePlan.from_bytes(resp.plan_bytes) == plan
+
+
+# -- cluster layer --------------------------------------------------------
+
+
+def _cluster(tmp_path, n=3, **kw):
+    base = dict(connect_timeout_ms=15000, use_cpp_runtime=False,
+                pre_warm_connections=False, adaptive_plan=True,
+                coalesce_target_bytes=4096, split_threshold_bytes=16384,
+                collect_shuffle_reader_stats=True)
+    base.update(kw)
+    conf = TpuShuffleConf(**base)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(TpuShuffleConf(**base),
+                               driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"p{i}"))
+             for i in range(n)]
+    for ex in execs:
+        ex.executor.wait_for_members(n)
+    return driver, execs
+
+
+def _shutdown(driver, execs):
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+PARTS = 8
+
+
+def _skewed_map_fn(writer, m):
+    rng = np.random.default_rng(7000 + SEED * 100 + m)
+    keys = np.where(rng.random(2000) < 0.7, 3,
+                    rng.integers(0, PARTS, 2000)).astype(np.uint64)
+    writer.write_batch(keys, rng.integers(
+        0, 255, (len(keys), 8), dtype=np.uint64).astype(np.uint8))
+
+
+def _canonical(keys, payload):
+    order = np.lexsort(tuple(payload[:, c] for c in
+                             range(payload.shape[1] - 1, -1, -1))
+                       + (keys,))
+    return keys[order], payload[order]
+
+
+@pytest.mark.parametrize("coalesce", [True, False])
+@pytest.mark.parametrize("warm", [True, False])
+def test_planned_reduce_matches_static_on_every_dataplane(tmp_path,
+                                                          coalesce, warm):
+    """Byte-identical output vs the static plan on all four dataplane
+    combos (coalesced/per-map x epoch-cache on/off), with real splits
+    and coalesced runs in the plan."""
+    driver, execs = _cluster(tmp_path, coalesce_reads=coalesce,
+                             location_epoch_cache=warm)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=6, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+        run_map_stage(execs, handle, _skewed_map_fn)
+        plan = driver.plan_reduce(handle)
+        assert plan is not None and not plan.is_identity
+        assert plan.counts()["split_partitions"] >= 1
+        res = run_planned_reduce(execs, handle, _skewed_map_fn, driver)
+        static_reader = execs[1].get_reader(handle, 0, PARTS)
+        ks, ps = _canonical(*static_reader.read_all())
+        ka, pa = _canonical(res.keys, res.payload)
+        np.testing.assert_array_equal(ka, ks)
+        np.testing.assert_array_equal(pa, ps)
+        assert res.replans == 0 and res.tasks_rerun == 0
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_plan_pushed_and_resolved_cache_first(tmp_path):
+    import time
+    driver, execs = _cluster(tmp_path)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=4, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+        run_map_stage(execs, handle, _skewed_map_fn)
+        plan = driver.plan_reduce(handle)
+        # the push lands on the broadcast channel; executors resolve it
+        # from their LocationPlane without a driver round trip
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(ex.executor.location_plane.plan(1) is not None
+                   for ex in execs):
+                break
+            time.sleep(0.01)
+        for ex in execs:
+            cached = ex.executor.location_plane.plan(1)
+            assert cached is not None and cached.plan_epoch == 1
+            assert ex.executor.get_reduce_plan(1) == plan
+        # an executor whose push was lost pulls it (drop + refetch)
+        execs[0].executor.location_plane.invalidate(1)
+        assert execs[0].executor.location_plane.plan(1) is None
+        assert execs[0].executor.get_reduce_plan(1) == plan
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_no_plan_without_adaptive_conf(tmp_path):
+    driver, execs = _cluster(tmp_path, adaptive_plan=False)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=4, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+        run_map_stage(execs, handle, _skewed_map_fn)
+        assert driver.plan_reduce(handle) is None
+        assert execs[0].executor.get_reduce_plan(1) is None
+        # run_planned_reduce degrades to the identity plan
+        res = run_planned_reduce(execs, handle, _skewed_map_fn, driver)
+        assert res.plan.is_identity
+        static_reader = execs[1].get_reader(handle, 0, PARTS)
+        ks, ps = _canonical(*static_reader.read_all())
+        ka, pa = _canonical(res.keys, res.payload)
+        np.testing.assert_array_equal(ka, ks)
+        np.testing.assert_array_equal(pa, ps)
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_replan_invalidates_warm_read_cache(tmp_path):
+    """Satellite: warm dist_cache ranges are keyed by plan epoch — a
+    re-plan push must drop them so a stale coalesced range never
+    serves."""
+    import time
+    driver, execs = _cluster(tmp_path, warm_read_cache=True)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=4, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+        run_map_stage(execs, handle, _skewed_map_fn)
+        plan = driver.plan_reduce(handle)
+        time.sleep(0.2)  # let the plan push land (plan epoch observed)
+        reader = execs[1].get_reader(handle, 0, 2)
+        reader.read_all()
+        ep = execs[1].executor.location_plane.known_epoch(1)
+        assert dist_cache.get_range(1, ep, 0, 2) is not None
+        before = dist_cache.stats()["plan_invalidations"]
+        new = driver.driver.replan_reduce(1, completed_task_ids=set())
+        assert new is not None and new.plan_epoch == plan.plan_epoch + 1
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if dist_cache.get_range(1, ep, 0, 2) is None:
+                break
+            time.sleep(0.01)
+        assert dist_cache.get_range(1, ep, 0, 2) is None
+        assert dist_cache.stats()["plan_invalidations"] == before + 1
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_stale_plan_push_keeps_plan_and_warm_state(tmp_path):
+    """A delayed, reordered push of an OLDER plan epoch must neither
+    roll the cached plan back nor wipe warm ranges cached under the
+    newer plan (broadcast pushes may reorder)."""
+    import time
+    driver, execs = _cluster(tmp_path, warm_read_cache=True)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=4, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+        run_map_stage(execs, handle, _skewed_map_fn)
+        plan1 = driver.plan_reduce(handle)
+        plan2 = driver.driver.replan_reduce(1, completed_task_ids=set())
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            cached = execs[1].executor.location_plane.plan(1)
+            if cached is not None and cached.plan_epoch == 2:
+                break
+            time.sleep(0.01)
+        # warm a range under the current (epoch-2) plan regime
+        execs[1].get_reader(handle, 0, 2).read_all()
+        ep = execs[1].executor.location_plane.known_epoch(1)
+        assert dist_cache.get_range(1, ep, 0, 2) is not None
+        # the stale epoch-1 push re-delivers late
+        execs[1].executor._handle(None, M.ReducePlanMsg(plan1.to_bytes()))
+        assert execs[1].executor.location_plane.plan(1).plan_epoch == \
+            plan2.plan_epoch
+        assert dist_cache.get_range(1, ep, 0, 2) is not None, \
+            "stale push wiped warm state"
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_split_map_range_reads_are_warm_keyed_separately(tmp_path):
+    """A split task's (partition, map-slice) read must not alias the
+    full-range warm entry for the same partitions."""
+    driver, execs = _cluster(tmp_path, warm_read_cache=True)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=4, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+        run_map_stage(execs, handle, _skewed_map_fn)
+        full = execs[1].get_reader(handle, 3, 4)
+        kf, pf = full.read_all()
+        half = execs[1].get_reader(handle, 3, 4, map_range=(0, 2))
+        kh, ph = half.read_all()
+        assert len(kh) < len(kf)
+        # re-reads serve the right entry for each key shape
+        kf2, _ = execs[1].get_reader(handle, 3, 4).read_all()
+        kh2, _ = execs[1].get_reader(handle, 3, 4,
+                                     map_range=(0, 2)).read_all()
+        assert np.array_equal(np.sort(kf), np.sort(kf2))
+        assert np.array_equal(np.sort(kh), np.sort(kh2))
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_bytes_per_reducer_histogram_and_balance(tmp_path):
+    driver, execs = _cluster(tmp_path)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=4, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+        run_map_stage(execs, handle, _skewed_map_fn)
+        for p in range(PARTS):
+            execs[1].get_reader(handle, p, p + 1).read_all()
+        snap = execs[1].reader_stats.snapshot()
+        assert snap["bytes_per_reducer"]["count"] == PARTS
+        # the zipf-ish hot partition makes the gauge read well over 1
+        assert snap["reduce_balance"] > 2.0, snap
+        assert execs[1].reader_stats.reduce_balance() == pytest.approx(
+            snap["reduce_balance"], abs=0.001)
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_run_map_stage_replaces_on_least_loaded(tmp_path, monkeypatch):
+    """Satellite: a write-failed map re-places on the LEAST-LOADED live
+    executor per the caller's load view, not blindly the next slot."""
+    from sparkrdma_tpu.shuffle.writer import WriteFailedError
+
+    driver, execs = _cluster(tmp_path)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=1, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+
+        class _FailingWriter:
+            closed = True
+
+            def write_batch(self, *a, **kw):
+                raise WriteFailedError("injected disk failure")
+
+            def close(self, success=True):
+                return None
+
+        monkeypatch.setattr(execs[0], "get_writer",
+                            lambda *a, **kw: _FailingWriter())
+        # slot 1 is heavily loaded, slot 2 idle: the re-place must pick 2
+        ran = run_map_stage(execs, handle, _skewed_map_fn, [0],
+                            placement={0: 0},
+                            slot_loads={1: 10 ** 9, 2: 0})
+        assert ran[0] == 2
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_recover_uses_planner_size_stats_for_replacement(tmp_path):
+    """The recompute path feeds the planner's per-slot byte view into
+    run_map_stage (the 'same stats the planner keeps' satellite)."""
+    from sparkrdma_tpu.shuffle.recovery import _recovery_slot_loads
+
+    driver, execs = _cluster(tmp_path)
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=4, num_partitions=PARTS,
+            partitioner=PartitionerSpec("modulo"), row_payload_bytes=8)
+        ran = run_map_stage(execs, handle, _skewed_map_fn)
+        table = execs[0].executor.get_driver_table(1, 4, timeout=5)
+        hist = driver.driver.size_histogram(1)
+        assert hist is not None and hist.maps_recorded == 4
+        loads = _recovery_slot_loads(table, 4, hist)
+        # byte-weighted: each owning slot's load is its maps' real bytes
+        for m, slot in ran.items():
+            assert loads.get(slot, 0) > 0
+        assert sum(loads.values()) == hist.total_bytes()
+    finally:
+        _shutdown(driver, execs)
+
+
+# -- microbench acceptance (the skew_speedup secondary's gates) ----------
+
+
+def test_skew_microbench_speedup_and_parity(tmp_path):
+    from sparkrdma_tpu.shuffle.plan_bench import run_skew_microbench
+
+    res = run_skew_microbench(str(tmp_path), workload="terasort",
+                              seed=SEED)
+    assert res["identical"], res
+    assert not res["is_identity"], res
+    assert res["skew_speedup"] >= 1.5, res
+    # the plan visibly rebalances the stage
+    assert res["reduce_balance"]["adaptive"] < \
+        res["reduce_balance"]["static"], res
+
+
+def test_skew_microbench_uniform_is_identity(tmp_path):
+    from sparkrdma_tpu.shuffle.plan_bench import run_skew_microbench
+
+    res = run_skew_microbench(str(tmp_path), uniform=True, seed=SEED,
+                              reps=1)
+    assert res["identical"] and res["is_identity"], res
+
+
+@pytest.mark.slow
+def test_skew_microbench_join_workload(tmp_path):
+    from sparkrdma_tpu.shuffle.plan_bench import run_skew_microbench
+
+    res = run_skew_microbench(str(tmp_path), workload="join", seed=SEED)
+    assert res["identical"], res
+    assert res["skew_speedup"] >= 1.5, res
