@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Invariant-analyzer sweep (sparkrdma_tpu/analysis/ — see docs/ANALYSIS.md).
+#
+#   scripts/run_analysis.sh               static passes + analyzer tests
+#   scripts/run_analysis.sh --sanitize    ... + ASan/UBSan native harness
+#                                         (builds instrumented .so's)
+#   scripts/run_analysis.sh --lockgraph   ... + the WHOLE tier-1 suite under
+#                                         the lock-order shim (exit 3 on any
+#                                         lock-order cycle)
+#   scripts/run_analysis.sh --all         everything above
+#
+# The fast subset (static passes + tests/test_analysis.py) is what tier-1
+# already runs; this script exists for the gated extras and for running
+# the sweep standalone in CI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SANITIZE=0; LOCKGRAPH=0
+for arg in "$@"; do
+  case "$arg" in
+    --sanitize) SANITIZE=1 ;;
+    --lockgraph) LOCKGRAPH=1 ;;
+    --all) SANITIZE=1; LOCKGRAPH=1 ;;
+    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+  esac
+done
+[[ "${RUN_SANITIZERS:-0}" == "1" ]] && SANITIZE=1
+
+echo "== static passes: wire / concurrency / drift =="
+JAX_PLATFORMS=cpu python -m sparkrdma_tpu.analysis
+
+echo "== analyzer self-tests (fixtures + lockgraph e2e) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q \
+  -p no:cacheprovider
+
+if [[ "$SANITIZE" == "1" ]]; then
+  echo "== native sanitizer harness (ASan, then UBSan) =="
+  make -C csrc asan ubsan
+  ASAN_OPTIONS=detect_leaks=0 \
+    LD_PRELOAD="$(${CXX:-g++} -print-file-name=libasan.so)" \
+    JAX_PLATFORMS=cpu python -m sparkrdma_tpu.analysis.native_harness \
+    sparkrdma_tpu/runtime/libtpushuffle_asan.so
+  JAX_PLATFORMS=cpu python -m sparkrdma_tpu.analysis.native_harness \
+    sparkrdma_tpu/runtime/libtpushuffle_ubsan.so
+fi
+
+if [[ "$LOCKGRAPH" == "1" ]]; then
+  echo "== tier-1 under the lockgraph shim =="
+  ANALYSIS_LOCKGRAPH=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+fi
+
+echo "analysis sweep: done"

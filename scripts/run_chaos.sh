@@ -28,6 +28,11 @@
 #                               injected fault; the mid-stage re-plan
 #                               scenario forces adaptive regardless)
 #   CHAOS_DISK=0          drop the storage-fault matrix from the sweep
+#   CHAOS_LOCKGRAPH=1     run every scenario under the lock-order shim
+#                         (sparkrdma_tpu/analysis/lockgraph.py): the
+#                         sweep then doubles as race detection — any
+#                         lock-order cycle observed across a module's
+#                         scenarios fails that module
 set -uo pipefail
 cd "$(dirname "$0")/.."
 

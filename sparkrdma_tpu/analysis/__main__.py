@@ -1,0 +1,29 @@
+"""CLI: ``python -m sparkrdma_tpu.analysis [--write-docs]``.
+
+Runs the static passes (wire, concurrency, drift) over the live tree,
+prints findings as ``path:line: [pass] message``, exits 1 on any.
+``--write-docs`` regenerates the message-ID table in docs/CONFIG.md
+from the registry instead (the fix for a doc-table drift finding).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from sparkrdma_tpu.analysis import run_all
+from sparkrdma_tpu.analysis.core import format_report
+
+
+def main(argv) -> int:
+    if "--write-docs" in argv:
+        from sparkrdma_tpu.analysis import wire
+
+        print(f"regenerated message-ID table in {wire.write_doc_table()}")
+        return 0
+    findings = run_all()
+    print(format_report(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,242 @@
+"""Pass 4 — sanitizer exercises for the native runtime (csrc/).
+
+Loads a sanitizer-instrumented build of ``libtpushuffle.so`` (ASan or
+UBSan, ``make -C csrc asan ubsan``) and drives the two native components
+with real memory on the line through their edge cases:
+
+* ``writer_scatter`` — the streaming write path's counting-sort kernel:
+  empty batches, zero-byte payloads, single partition, multi-threaded
+  stability split, and the out-of-range-dest error path. A one-byte
+  cursor slip here is silent data corruption in production; under ASan
+  it aborts this harness.
+* the native block server — over a real socket: vectored scatter reads,
+  zero-length blocks, CRC32 trailer verification against zlib,
+  unknown-token and bad-range statuses, a request frame at EXACTLY
+  ``kMaxReqFrame`` (65534 blocks — the biggest parse the server must
+  survive), and the over-max protocol error that must CLOSE the
+  connection rather than wander off the frame.
+
+Run via ``scripts/run_analysis.sh --sanitize`` (which builds the
+instrumented .so and sets LD_PRELOAD for ASan), or directly::
+
+    python -m sparkrdma_tpu.analysis.native_harness <path/to/.so>
+
+Exit 0 = every exercise passed and no sanitizer report fired (sanitizer
+failures abort the process with their own diagnostics). The harness is
+self-checking beyond the sanitizers: responses are verified
+byte-for-byte, so it doubles as a native-server protocol test.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import sys
+import tempfile
+import zlib
+
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel.rpc_msg import HEADER
+
+
+def _load(path: str) -> ctypes.CDLL:
+    lib = ctypes.CDLL(path)
+    u64, i64, vp, cp = (ctypes.c_uint64, ctypes.c_int64, ctypes.c_void_p,
+                        ctypes.c_char_p)
+    lib.writer_scatter.argtypes = [ctypes.POINTER(u64), cp, u64, u64,
+                                   ctypes.POINTER(i64), ctypes.c_uint32,
+                                   cp, ctypes.POINTER(u64), ctypes.c_int]
+    lib.writer_scatter.restype = i64
+    lib.bs_create.argtypes = [cp, ctypes.c_uint16, ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.bs_create.restype = vp
+    lib.bs_port.argtypes = [vp]
+    lib.bs_port.restype = ctypes.c_uint16
+    lib.bs_set_checksum.argtypes = [vp, ctypes.c_int]
+    lib.bs_set_checksum.restype = None
+    lib.bs_register_file.argtypes = [vp, ctypes.c_uint32, cp]
+    lib.bs_register_file.restype = ctypes.c_int
+    lib.bs_unregister_file.argtypes = [vp, ctypes.c_uint32]
+    lib.bs_unregister_file.restype = ctypes.c_int
+    lib.bs_stop.argtypes = [vp]
+    lib.bs_stop.restype = None
+    return lib
+
+
+def _check(cond: bool, what: str) -> None:
+    if not cond:
+        raise AssertionError(f"native harness: {what}")
+    print(f"  ok: {what}")
+
+
+# ------------------------------------------------------------- scatter
+
+def _scatter(lib, keys, payload_bytes, payload, dests, num_partitions,
+             nthreads):
+    n = len(keys)
+    u64 = ctypes.c_uint64
+    keys_a = (u64 * max(1, n))(*keys)
+    dest_a = (ctypes.c_int64 * max(1, n))(*dests)
+    out = ctypes.create_string_buffer(max(1, n * (8 + payload_bytes)))
+    counts = (u64 * num_partitions)()
+    total = lib.writer_scatter(
+        keys_a, payload if payload else b"", n, payload_bytes, dest_a,
+        num_partitions, out, counts, nthreads)
+    return total, bytes(out.raw[:max(0, total)]), list(counts)
+
+
+def exercise_writer_scatter(lib) -> None:
+    print("writer_scatter:")
+    import random
+    rng = random.Random(7)
+
+    # multi-threaded scatter with payload: verify totals, counts, and
+    # per-partition stable content against a reference scatter
+    n, pb, parts = 4096, 8, 16
+    keys = [rng.randrange(1 << 62) for _ in range(n)]
+    payload = bytes(rng.randrange(256) for _ in range(n * pb))
+    dests = [rng.randrange(parts) for _ in range(n)]
+    total, out, counts = _scatter(lib, keys, pb, payload, dests, parts, 4)
+    _check(total == n * (8 + pb), "scatter total bytes")
+    _check(sum(counts) == n, "scatter per-partition counts sum")
+    want = {p: b"" for p in range(parts)}
+    for i in range(n):
+        want[dests[i]] += (struct.pack("<Q", keys[i])
+                           + payload[i * pb:(i + 1) * pb])
+    got, off = [], 0
+    for p in range(parts):
+        seg = out[off:off + counts[p] * (8 + pb)]
+        off += len(seg)
+        got.append(seg)
+    _check(all(got[p] == want[p] for p in range(parts)),
+           "scatter stability: per-partition rows in arrival order")
+
+    total, _, _ = _scatter(lib, [], 8, b"", [], 4, 2)
+    _check(total == 0, "empty batch")
+    total, out, counts = _scatter(lib, [5, 6], 0, b"", [0, 0], 1, 8)
+    _check(total == 16 and counts == [2],
+           "zero payload_bytes, single partition, threads > rows")
+    total, _, _ = _scatter(lib, [1], 8, b"\x00" * 8, [9], 4, 1)
+    _check(total == -1, "out-of-range dest returns -1")
+
+
+# --------------------------------------------------------- block server
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    head = b""
+    while len(head) < HEADER.size:
+        chunk = sock.recv(HEADER.size - len(head))
+        if not chunk:
+            return b""
+        head += chunk
+    total, _ = HEADER.unpack_from(head, 0)
+    buf = head
+    while len(buf) < total:
+        chunk = sock.recv(min(1 << 20, total - len(buf)))
+        if not chunk:
+            return b""
+        buf += chunk
+    return buf
+
+
+def _fetch(sock, req_id, shuffle_id, blocks) -> M.FetchBlocksResp:
+    sock.sendall(M.FetchBlocksReq(req_id, shuffle_id, blocks).encode())
+    frame = _recv_frame(sock)
+    assert frame, "server closed connection unexpectedly"
+    _, msg_type = HEADER.unpack_from(frame, 0)
+    assert msg_type == M.FetchBlocksResp.MSG_TYPE
+    return M.FetchBlocksResp.from_payload(frame[HEADER.size:])
+
+
+def exercise_block_server(lib) -> None:
+    print("block server:")
+    data = bytes((i * 131 + 17) % 256 for i in range(1 << 16))
+    with tempfile.NamedTemporaryFile(suffix=".data", delete=False) as f:
+        f.write(data)
+        path = f.name
+    server = lib.bs_create(b"127.0.0.1", 0, 2, None, 0)
+    try:
+        _check(bool(server), "bs_create")
+        lib.bs_set_checksum(server, 1)
+        port = lib.bs_port(server)
+        _check(lib.bs_register_file(server, 42, path.encode()) == 0,
+               "bs_register_file")
+
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            # vectored read incl. zero-length block + CRC trailer check
+            blocks = [(42, 0, 100), (42, 500, 0), (42, 4096, 1024),
+                      (42, len(data) - 7, 7)]
+            resp = _fetch(sock, 1, 0, blocks)
+            _check(resp.status == M.STATUS_OK and resp.flags & M.FLAG_CRC32,
+                   "vectored read: OK + FLAG_CRC32")
+            body_len = sum(ln for _, _, ln in blocks)
+            body, trailer = resp.data[:body_len], resp.data[body_len:]
+            want = b"".join(data[o:o + ln] for _, o, ln in blocks)
+            _check(body == want, "vectored read: payload bytes")
+            crcs = struct.unpack(f"<{len(blocks)}I", trailer)
+            pos = 0
+            ok = True
+            for (_, _, ln), crc in zip(blocks, crcs):
+                ok = ok and crc == zlib.crc32(body[pos:pos + ln])
+                pos += ln
+            _check(ok, "vectored read: per-block CRC32 trailer == zlib")
+
+            resp = _fetch(sock, 2, 0, [(7, 0, 16)])
+            _check(resp.status == M.STATUS_UNKNOWN_SHUFFLE,
+                   "unknown buffer token -> STATUS_UNKNOWN")
+            resp = _fetch(sock, 3, 0, [(42, len(data), 64)])
+            _check(resp.status == M.STATUS_BAD_RANGE,
+                   "offset past EOF -> STATUS_BAD_RANGE")
+            resp = _fetch(sock, 4, 0, [])
+            _check(resp.status == M.STATUS_OK and len(resp.data) == 0,
+                   "zero-block request")
+
+            # the biggest frame the server must parse: exactly under
+            # kMaxReqFrame (65534 zero-length blocks = 1048568 bytes)
+            nmax = (M.NATIVE_MAX_REQ_FRAME - M.BLOCKS_REQ_FIXED_BYTES
+                    - HEADER.size) // M.BLOCK_WIRE_BYTES
+            resp = _fetch(sock, 5, 0, [(42, 0, 0)] * nmax)
+            _check(resp.status == M.STATUS_OK
+                   and len(resp.data) == 4 * nmax,
+                   f"max-frame request ({nmax} blocks) parses clean")
+        finally:
+            sock.close()
+
+        # over-max frame: a protocol error must CLOSE the connection
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            huge = M.NATIVE_MAX_REQ_FRAME + 8
+            sock.sendall(HEADER.pack(huge, M.FetchBlocksReq.MSG_TYPE))
+            sock.sendall(b"\x00" * 64)
+            _check(_recv_frame(sock) == b"",
+                   "over-kMaxReqFrame frame closes the connection")
+        finally:
+            sock.close()
+
+        _check(lib.bs_unregister_file(server, 42) == 0,
+               "bs_unregister_file")
+    finally:
+        lib.bs_stop(server)
+        os.unlink(path)
+
+
+def main(argv) -> int:
+    so = (argv[0] if argv else
+          os.environ.get("TPU_SHUFFLE_SANITIZER_SO", ""))
+    if not so or not os.path.exists(so):
+        print("usage: python -m sparkrdma_tpu.analysis.native_harness "
+              "<instrumented libtpushuffle .so>", file=sys.stderr)
+        return 2
+    print(f"native harness: {so}")
+    lib = _load(so)
+    exercise_writer_scatter(lib)
+    exercise_block_server(lib)
+    print("native harness: all exercises passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

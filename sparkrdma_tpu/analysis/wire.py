@@ -1,0 +1,468 @@
+"""Pass 1 — wire-protocol checker.
+
+The control plane's correctness lives in client-side protocol discipline
+(one-sided design: no server handler validates a request shape twice).
+This pass machine-checks that discipline:
+
+* **registry** — every ``WIRE_IDS`` row registered, ids unique, and the
+  id space DENSE over 1..max except the ids pinned (with a reason) in
+  ``RESERVED_WIRE_IDS`` — a typo'd or recycled wire number cannot land.
+* **round-trip** — fuzzed ``payload()``/``from_payload()`` parity per
+  message class: decode(encode(m)) must re-encode byte-identically, so
+  a field a packer writes but the unpacker drops (or vice versa) fails
+  here instead of in a mixed-version cluster.
+* **truncation** — the legacy decode matrix: payloads truncated at every
+  historical format boundary (fence-less publishes, lengths-less
+  publishes, epoch-less table responses) must still decode to the
+  documented defaults.
+* **native constants** — parses ``csrc/*.cpp`` for every ``constexpr``
+  constant and checks each against its declared Python mirror
+  (generalizing the old single-constant grep test); a NEW native
+  constant that is neither mirrored nor explicitly ignored is itself a
+  finding, so triage can't be skipped.
+* **doc table** — the message-ID table in docs/CONFIG.md is generated
+  from the registry; committed text must match the generator.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import random
+import re
+import struct
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+from sparkrdma_tpu.analysis.core import Finding, rel, repo_root
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel import rpc_msg
+from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId
+
+PASS = "wire"
+
+
+def _anchor(cls) -> Tuple[str, int]:
+    """(path, line) of a message class definition, for findings."""
+    try:
+        path = inspect.getsourcefile(cls) or "<unknown>"
+        line = inspect.getsourcelines(cls)[1]
+    except (OSError, TypeError):
+        path, line = "<unknown>", 0
+    return path, line
+
+
+def _finding(root: str, cls, message: str) -> Finding:
+    path, line = _anchor(cls)
+    return Finding(PASS, rel(root, path), line, message)
+
+
+# ---------------------------------------------------------------- registry
+
+def check_registry(pairs: Sequence[Tuple[int, type]],
+                   wire_ids: Optional[Dict[str, int]] = None,
+                   reserved: Optional[Dict[int, str]] = None,
+                   root: Optional[str] = None) -> List[Finding]:
+    """Id uniqueness + density + table/registry agreement.
+
+    ``pairs`` is ``[(msg_type, cls), ...]`` — a list, not a dict, so
+    fixture files can seed duplicate ids.
+    """
+    root = root or repo_root()
+    wire_ids = rpc_msg.WIRE_IDS if wire_ids is None else wire_ids
+    reserved = rpc_msg.RESERVED_WIRE_IDS if reserved is None else reserved
+    findings: List[Finding] = []
+
+    seen: Dict[int, type] = {}
+    for msg_type, cls in pairs:
+        if msg_type in seen:
+            findings.append(_finding(
+                root, cls,
+                f"duplicate wire id {msg_type}: {cls.__name__} collides "
+                f"with {seen[msg_type].__name__}"))
+            continue
+        seen[msg_type] = cls
+        expected = wire_ids.get(cls.__name__)
+        if expected is None:
+            findings.append(_finding(
+                root, cls,
+                f"{cls.__name__} registered with id {msg_type} but has "
+                f"no WIRE_IDS row"))
+        elif expected != msg_type:
+            findings.append(_finding(
+                root, cls,
+                f"{cls.__name__} registered as {msg_type} but WIRE_IDS "
+                f"says {expected}"))
+        if getattr(cls, "MSG_TYPE", None) != msg_type:
+            findings.append(_finding(
+                root, cls,
+                f"{cls.__name__}.MSG_TYPE={getattr(cls, 'MSG_TYPE', None)}"
+                f" != registered id {msg_type}"))
+
+    for name, msg_type in wire_ids.items():
+        if msg_type not in seen:
+            findings.append(Finding(
+                PASS, "sparkrdma_tpu/parallel/rpc_msg.py", 0,
+                f"WIRE_IDS row {name}={msg_type} has no registered class"))
+
+    if seen:
+        lo, hi = 1, max(max(seen), max(wire_ids.values(), default=1))
+        for i in range(lo, hi + 1):
+            if i in seen and i in reserved:
+                findings.append(_finding(
+                    root, seen[i],
+                    f"wire id {i} is RESERVED ({reserved[i]}) but "
+                    f"{seen[i].__name__} uses it"))
+            elif i not in seen and i not in reserved:
+                findings.append(Finding(
+                    PASS, "sparkrdma_tpu/parallel/rpc_msg.py", 0,
+                    f"wire id space has an unexplained hole at {i}: "
+                    f"register it or pin it in RESERVED_WIRE_IDS with a "
+                    f"reason"))
+    return findings
+
+
+def live_pairs() -> List[Tuple[int, type]]:
+    return sorted(rpc_msg.registry().items())
+
+
+# ------------------------------------------------------------- round-trip
+
+def _mk_manager_id(rng: random.Random) -> ShuffleManagerId:
+    i = rng.randrange(1 << 8)
+    return ShuffleManagerId(
+        ExecutorId(str(i), f"host{i}.example", 7000 + i),
+        f"host{i}.example", 9000 + i, rng.randrange(1 << 16))
+
+
+def _gen_arg(name: str, rng: random.Random):
+    """Generate one constructor argument by parameter-name convention.
+
+    The conventions are the codebase's own: ``req_id``/``epoch``/
+    ``fence`` are i64-ish, ``entry`` is the 12-byte driver-table entry,
+    ``blocks`` the (buf, offset, length) scatter list, etc. A NEW
+    message class whose parameter names fall outside the table fails
+    loudly (None -> TypeError inside the fuzz loop), which is the
+    desired "teach the fuzzer about your field" nudge.
+    """
+    if name in ("req_id", "fence", "bcast_id", "consumed"):
+        return rng.randrange(1 << 62)
+    if name == "epoch":
+        # non-negative only: AnnounceMsg's broadcast epoch packs u64.
+        # The signed location-plane epochs get EPOCH_DEAD coverage from
+        # _EXTRA_CASES below.
+        return rng.choice([0, 1, rng.randrange(1 << 40)])
+    if name == "entry":
+        return bytes(rng.randrange(256) for _ in range(M.PublishMsg.ENTRY_BYTES))
+    if name == "table":
+        # driver-table bytes: always whole 12-byte MAP_ENTRY_SIZE entries
+        # (FetchTableResp's legacy-epoch disambiguation depends on it)
+        return bytes(rng.randrange(256)
+                     for _ in range(M.PublishMsg.ENTRY_BYTES
+                                    * rng.randrange(6)))
+    if name in ("data", "plan_bytes", "entries", "payload"):
+        return bytes(rng.randrange(256) for _ in range(4 * rng.randrange(17)))
+    if name == "blocks":
+        return [(rng.randrange(1 << 32), rng.randrange(1 << 48),
+                 rng.randrange(1 << 31)) for _ in range(rng.randrange(5))]
+    if name == "records":
+        return [(rng.randrange(1 << 20), rng.randrange(6),
+                 bytes(rng.randrange(256) for _ in range(16 * rng.randrange(4))))
+                for _ in range(rng.randrange(4))]
+    if name in ("map_ids", "shard_slots"):
+        return [rng.randrange(1 << 20) for _ in range(rng.randrange(6))]
+    if name == "lengths":
+        return rng.choice([None,
+                           [rng.randrange(1 << 31)
+                            for _ in range(rng.randrange(8))]])
+    if name == "manager_id":
+        return _mk_manager_id(rng)
+    if name == "manager_ids":
+        return [_mk_manager_id(rng) for _ in range(rng.randrange(4))]
+    if name in ("flags", "status"):
+        return rng.randrange(8)
+    return rng.randrange(1 << 20)  # generic i32-ish field
+
+
+def _build(cls: type, rng: random.Random):
+    sig = inspect.signature(cls.__init__)
+    kwargs = {}
+    for pname, param in list(sig.parameters.items())[1:]:  # skip self
+        if param.kind in (inspect.Parameter.VAR_POSITIONAL,
+                          inspect.Parameter.VAR_KEYWORD):
+            continue
+        kwargs[pname] = _gen_arg(pname, rng)
+    return cls(**kwargs)
+
+
+# Hand-built instances covering domain corners the name-based generator
+# deliberately avoids (signed location epochs carry EPOCH_DEAD; the
+# driver answers dead shuffles with num_published=-1).
+_EXTRA_CASES: Dict[str, List[Callable[[], "rpc_msg.RpcMsg"]]] = {
+    "EpochBumpMsg": [lambda: M.EpochBumpMsg(5, M.EPOCH_DEAD)],
+    "FetchTableResp": [lambda: M.FetchTableResp(1, -1, b"", M.EPOCH_DEAD)],
+    "FetchShardResp": [lambda: M.FetchShardResp(1, -1, M.EPOCH_DEAD, b"")],
+}
+
+
+def fuzz_roundtrip(pairs: Sequence[Tuple[int, type]], trials: int = 8,
+                   seed: int = 0, root: Optional[str] = None
+                   ) -> List[Finding]:
+    """decode(encode(m)) must RE-ENCODE byte-identically for every
+    registered class: asymmetric pack/unpack (field written but not
+    read, wrong offset, dropped trailer) shows up as a payload diff."""
+    root = root or repo_root()
+    findings: List[Finding] = []
+    for msg_type, cls in pairs:
+        extras = _EXTRA_CASES.get(cls.__name__, [])
+        for t in range(trials + len(extras)):
+            rng = random.Random(seed * 1_000_003 + msg_type * 131 + t)
+            try:
+                msg = (_build(cls, rng) if t < trials
+                       else extras[t - trials]())
+                p1 = msg.payload()
+                p2 = cls.from_payload(p1).payload()
+            except Exception as e:  # noqa: BLE001 — any crash is a finding
+                findings.append(_finding(
+                    root, cls,
+                    f"{cls.__name__} round-trip crashed (trial {t}): "
+                    f"{type(e).__name__}: {e}"))
+                break
+            if p1 != p2:
+                findings.append(_finding(
+                    root, cls,
+                    f"{cls.__name__} pack/unpack asymmetry (trial {t}): "
+                    f"re-encoded payload differs at byte "
+                    f"{next(i for i in range(min(len(p1), len(p2)) + 1) if i >= min(len(p1), len(p2)) or p1[i] != p2[i])} "
+                    f"(len {len(p1)} -> {len(p2)})"))
+                break
+    return findings
+
+
+# ------------------------------------------------------------- truncation
+
+def _legacy_cases() -> List[Tuple[type, bytes, Callable, str]]:
+    """(cls, legacy_payload, accept(msg) -> bool, description).
+
+    Each case is a payload a PRE-UPGRADE peer actually emitted: the
+    format grew by appending, so decoding the historical prefix must
+    yield the documented defaults — that is the whole mixed-version
+    story, and nothing else checks it.
+    """
+    entry = bytes(range(M.PublishMsg.ENTRY_BYTES))
+    full_pub = M.PublishMsg(7, 3, entry, fence=9,
+                            lengths=[1, 2, 3]).payload()
+    table = b"\xab" * 24
+    cases = [
+        (M.PublishMsg, full_pub[:8 + M.PublishMsg.ENTRY_BYTES],
+         lambda m: m.fence == 0 and m.lengths is None
+         and m.entry == entry and (m.shuffle_id, m.map_id) == (7, 3),
+         "fence-less publish (pre-fencing peer) must decode with "
+         "fence=0, lengths=None"),
+        (M.PublishMsg, full_pub[:8 + M.PublishMsg.ENTRY_BYTES + 8],
+         lambda m: m.fence == 9 and m.lengths is None,
+         "lengths-less publish (pre-planning peer) must decode with "
+         "lengths=None"),
+        (M.FetchTableResp,
+         struct.pack("<qi", 5, 2) + table,
+         lambda m: m.req_id == 5 and m.num_published == 2
+         and m.epoch == 0 and m.table == table,
+         "epoch-less table response (pre-metadata-plane peer) must "
+         "decode with epoch=0"),
+        (M.FetchTableResp, struct.pack("<qi", 5, 0),
+         lambda m: m.epoch == 0 and m.table == b"",
+         "header-only (empty, epoch-less) table response must decode"),
+    ]
+    return cases
+
+
+def check_truncation(root: Optional[str] = None) -> List[Finding]:
+    root = root or repo_root()
+    findings: List[Finding] = []
+    for cls, payload, accept, desc in _legacy_cases():
+        try:
+            msg = cls.from_payload(payload)
+        except Exception as e:  # noqa: BLE001 — decode crash is the finding
+            findings.append(_finding(
+                root, cls, f"{desc}; decode raised "
+                f"{type(e).__name__}: {e}"))
+            continue
+        if not accept(msg):
+            findings.append(_finding(
+                root, cls, f"{desc}; decoded fields are wrong"))
+    return findings
+
+
+# ------------------------------------------------------- native constants
+
+# constexpr <type> <name> = <expr>;  — the tiny expression grammar csrc
+# actually uses: "<int>[u|ul|ull] [<< <int>]".
+_CONSTEXPR_RE = re.compile(
+    r"^\s*constexpr\s+[\w:<>]+\s+(k\w+)\s*=\s*([^;]+);", re.MULTILINE)
+_EXPR_RE = re.compile(
+    r"^\s*(\d+)\s*(?:u|ul|ull)?\s*(?:<<\s*(\d+))?\s*$")
+
+
+def parse_native_constants(cpp_text: str) -> Dict[str, Tuple[int, int]]:
+    """name -> (value, line) for every integer ``constexpr k...``."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for m in _CONSTEXPR_RE.finditer(cpp_text):
+        name, expr = m.group(1), m.group(2).strip()
+        em = _EXPR_RE.match(expr)
+        if not em:
+            continue  # non-integer constexpr: out of scope
+        value = int(em.group(1)) << (int(em.group(2)) if em.group(2) else 0)
+        line = cpp_text.count("\n", 0, m.start()) + 1
+        out[name] = (value, line)
+    return out
+
+
+# The mirror spec: every protocol-visible native constant and the Python
+# value it must equal. ``IGNORED`` = server-internal tuning with no
+# Python mirror, pinned here so the coverage rule stays exhaustive.
+def _mirror_spec() -> Dict[str, Dict[str, Callable[[], int]]]:
+    return {
+        "blockserver.cpp": {
+            "kReqType": lambda: M.FetchBlocksReq.MSG_TYPE,
+            "kRespType": lambda: M.FetchBlocksResp.MSG_TYPE,
+            "kStatusOk": lambda: M.STATUS_OK,
+            "kStatusUnknown": lambda: M.STATUS_UNKNOWN_SHUFFLE,
+            "kStatusBadRange": lambda: M.STATUS_BAD_RANGE,
+            "kMaxReqFrame": lambda: M.NATIVE_MAX_REQ_FRAME,
+            "kFlagCrc32": lambda: M.FLAG_CRC32,
+        },
+    }
+
+
+_IGNORED_NATIVE = {
+    "blockserver.cpp": {
+        "kMaxRespPayload",  # server-side response cap; clients discover
+                            # it as kStatusBadRange, never plan against it
+        "kOutHighWater",    # per-connection outbound buffering threshold
+        "kInHighWater",     # inbound buffering threshold
+    },
+    "arena.cpp": {
+        "kMaxRegion",       # allocator carve-region size, never on the wire
+    },
+    "staging.cpp": set(),
+    "writer.cpp": set(),
+}
+
+
+def check_native_constants(root: Optional[str] = None) -> List[Finding]:
+    root = root or repo_root()
+    findings: List[Finding] = []
+    spec = _mirror_spec()
+    csrc = os.path.join(root, "csrc")
+    for fname in sorted(os.listdir(csrc)):
+        if not fname.endswith(".cpp"):
+            continue
+        path = os.path.join(csrc, fname)
+        with open(path) as f:
+            constants = parse_native_constants(f.read())
+        mirrors = spec.get(fname, {})
+        ignored = _IGNORED_NATIVE.get(fname, set())
+        relpath = rel(root, path)
+        for name, (value, line) in sorted(constants.items()):
+            if name in mirrors:
+                expected = mirrors[name]()
+                if value != expected:
+                    findings.append(Finding(
+                        PASS, relpath, line,
+                        f"native constant {name}={value} drifted from "
+                        f"its Python mirror ({expected})"))
+            elif name not in ignored:
+                findings.append(Finding(
+                    PASS, relpath, line,
+                    f"unclassified native constant {name}: add it to "
+                    f"the mirror spec or the ignore list in "
+                    f"analysis/wire.py"))
+        for name in sorted(set(mirrors) - set(constants)):
+            findings.append(Finding(
+                PASS, relpath, 0,
+                f"mirror spec expects {name} in {fname} but it is gone"))
+
+    # Frame-geometry invariants the C++ request parser hardcodes:
+    # [total:4][type:4][req_id:8][shuffle:4][count:4][(buf:4,off:8,len:4)*].
+    if M.BLOCKS_REQ_FIXED_BYTES != 24:
+        findings.append(Finding(
+            PASS, "sparkrdma_tpu/parallel/messages.py", 0,
+            f"BLOCKS_REQ_FIXED_BYTES={M.BLOCKS_REQ_FIXED_BYTES} no longer "
+            f"matches the native frame layout (req_id:8 + shuffle:4 + "
+            f"count:4 + header:8 = 24)"))
+    if M.BLOCK_WIRE_BYTES != 16:
+        findings.append(Finding(
+            PASS, "sparkrdma_tpu/parallel/messages.py", 0,
+            f"BLOCK_WIRE_BYTES={M.BLOCK_WIRE_BYTES} != the native "
+            f"16-byte (buf:u32, offset:u64, length:u32) range"))
+    return findings
+
+
+# --------------------------------------------------------------- doc table
+
+DOC_BEGIN = "<!-- analysis:wire-ids:begin -->"
+DOC_END = "<!-- analysis:wire-ids:end -->"
+
+
+def render_msg_id_table() -> str:
+    """The docs/CONFIG.md message-ID table, generated from the registry
+    (run ``python -m sparkrdma_tpu.analysis --write-docs`` to refresh)."""
+    rows = ["| ID | Message | Defined in |", "|---|---|---|"]
+    by_id = dict(rpc_msg.registry())
+    hi = max(list(by_id) + list(rpc_msg.RESERVED_WIRE_IDS))
+    for i in range(1, hi + 1):
+        if i in by_id:
+            cls = by_id[i]
+            mod = cls.__module__.rsplit(".", 1)[-1]
+            rows.append(f"| {i} | `{cls.__name__}` | `parallel/{mod}.py` |")
+        elif i in rpc_msg.RESERVED_WIRE_IDS:
+            rows.append(f"| {i} | *reserved* — "
+                        f"{rpc_msg.RESERVED_WIRE_IDS[i]} | |")
+        else:
+            rows.append(f"| {i} | **UNASSIGNED HOLE** | |")
+    return "\n".join(rows)
+
+
+def check_doc_table(root: Optional[str] = None) -> List[Finding]:
+    root = root or repo_root()
+    doc = os.path.join(root, "docs", "CONFIG.md")
+    relpath = rel(root, doc)
+    with open(doc) as f:
+        text = f.read()
+    if DOC_BEGIN not in text or DOC_END not in text:
+        return [Finding(PASS, relpath, 0,
+                        f"docs/CONFIG.md is missing the generated "
+                        f"message-ID table markers {DOC_BEGIN}/{DOC_END}")]
+    committed = text.split(DOC_BEGIN, 1)[1].split(DOC_END, 1)[0].strip()
+    generated = render_msg_id_table().strip()
+    if committed != generated:
+        line = text[:text.index(DOC_BEGIN)].count("\n") + 1
+        return [Finding(PASS, relpath, line,
+                        "committed message-ID table drifted from the "
+                        "registry: run `python -m sparkrdma_tpu.analysis "
+                        "--write-docs`")]
+    return []
+
+
+def write_doc_table(root: Optional[str] = None) -> str:
+    """Regenerate the committed table in place; returns the doc path."""
+    root = root or repo_root()
+    doc = os.path.join(root, "docs", "CONFIG.md")
+    with open(doc) as f:
+        text = f.read()
+    head, rest = text.split(DOC_BEGIN, 1)
+    _, tail = rest.split(DOC_END, 1)
+    with open(doc, "w") as f:
+        f.write(head + DOC_BEGIN + "\n" + render_msg_id_table()
+                + "\n" + DOC_END + tail)
+    return doc
+
+
+def run(root: Optional[str] = None) -> List[Finding]:
+    root = root or repo_root()
+    pairs = live_pairs()
+    findings = check_registry(pairs, root=root)
+    findings += fuzz_roundtrip(pairs, root=root)
+    findings += check_truncation(root=root)
+    findings += check_native_constants(root=root)
+    findings += check_doc_table(root=root)
+    return findings

@@ -353,11 +353,15 @@ class DAGEngine:
         state (shared by job teardown and unpin)."""
         handle = self._handles.pop(stage.stage_id, None)
         self._stages.pop(stage.stage_id, None)
-        self._owners.pop(stage.stage_id, None)
+        with self._recover_lock:
+            self._owners.pop(stage.stage_id, None)
         if handle is None:
             return
-        self._recovered = {k for k in self._recovered
-                           if k[0] != handle.shuffle_id}
+        with self._recover_lock:
+            # a late concurrent recovery must see either the full memo
+            # or the post-teardown one, never a half-rebuilt set
+            self._recovered = {k for k in self._recovered
+                               if k[0] != handle.shuffle_id}
         with self._mesh_lock:
             self._mesh_cache.pop(handle.shuffle_id, None)
         self._dist_owner.pop(handle.shuffle_id, None)
@@ -539,7 +543,8 @@ class DAGEngine:
                                              stage.dep)
         self._handles[stage.stage_id] = handle
         self._stages[stage.stage_id] = stage
-        self._owners[stage.stage_id] = {}
+        with self._recover_lock:
+            self._owners[stage.stage_id] = {}
         with self.tracer.span("engine.stage", "engine",
                               stage=stage.stage_id, shuffle=shuffle_id,
                               tasks=stage.num_tasks):

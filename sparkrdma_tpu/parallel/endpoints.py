@@ -499,7 +499,10 @@ class DriverEndpoint:
                 while (self._announce_pending is None
                        and not self._push_pending
                        and not self._announce_stop):
-                    self._announce_cond.wait()
+                    # 1s deadline: stop() notifies under the lock, but a
+                    # lost wake must cost one re-check, not a hung
+                    # broadcaster at teardown
+                    self._announce_cond.wait(timeout=1.0)
                 if self._announce_stop:
                     return
                 snapshot_epoch = self._announce_pending
@@ -938,6 +941,7 @@ class ExecutorEndpoint:
         # it (and closes its own connection) or inserts into the cache
         # before close_all drains it — no window where a fresh dial can
         # outlive this teardown
+        # analysis: unguarded-ok(set-once monotonic flag; ordering vs close_all documented above)
         self._stopping = True
         self._hb_wake.set()  # ends the heartbeat monitor, if started
         if self._task_pool is not None:

@@ -77,7 +77,7 @@ BLOCKS_REQ_FIXED_BYTES = 8 + _QI.size + 4   # header + req_id/shuffle + count
 BLOCK_WIRE_BYTES = _BLOCK.size          # one (buf, offset, length) range
 
 
-@register(3)
+@register()
 class PublishMsg(RpcMsg):
     """Executor -> driver: positional driver-table entry write.
 
@@ -132,11 +132,11 @@ class PublishMsg(RpcMsg):
         return cls(shuffle_id, map_id, entry, fence, lengths)
 
 
-# Wire type 4 reserved (was an ack; publish is one-sided like the
-# reference's RDMA WRITE, so nothing acks).
+# Wire type 4 reserved — see rpc_msg.RESERVED_WIRE_IDS (was an ack;
+# publish is one-sided like the reference's RDMA WRITE, so nothing acks).
 
 
-@register(5)
+@register()
 class FetchTableReq(RpcMsg):
     """``min_published > 0`` turns the fetch into a long-poll: the driver
     holds the response until that many maps have published (or
@@ -164,7 +164,7 @@ class FetchTableReq(RpcMsg):
         return cls(req_id, shuffle_id, min_published, timeout_ms)
 
 
-@register(6)
+@register()
 class FetchTableResp(RpcMsg):
     """num_published lets clients poll until the maps they need have
     committed (client-side analogue of the reference's wait on
@@ -187,12 +187,22 @@ class FetchTableResp(RpcMsg):
     @classmethod
     def from_payload(cls, payload: bytes) -> "FetchTableResp":
         req_id, num_published = _QI.unpack_from(payload, 0)
-        (epoch,) = _Q.unpack_from(payload, _QI.size)
-        return cls(req_id, num_published, payload[_QI.size + _Q.size:],
-                   epoch)
+        rest = payload[_QI.size:]
+        # Mixed-version tolerance: a pre-metadata-plane peer sends no
+        # epoch field. The table is whole MAP_ENTRY_SIZE (12-byte)
+        # driver-table entries, so the i64 epoch's presence is decidable
+        # from the length residue: 8 mod 12 when it leads, 0 mod 12 when
+        # it does not. A legacy payload decodes with epoch 0, which
+        # never validates a cache entry — staleness costs a re-sync,
+        # never correctness.
+        epoch = 0
+        if len(rest) % PublishMsg.ENTRY_BYTES == _Q.size:
+            (epoch,) = _Q.unpack_from(rest, 0)
+            rest = rest[_Q.size:]
+        return cls(req_id, num_published, bytes(rest), epoch)
 
 
-@register(7)
+@register()
 class FetchOutputReq(RpcMsg):
     """Read 16B location entries [start, end) of one map's output table."""
 
@@ -215,7 +225,7 @@ class FetchOutputReq(RpcMsg):
         return cls(req_id, shuffle_id, map_id, start, end)
 
 
-@register(8)
+@register()
 class FetchOutputResp(RpcMsg):
     def __init__(self, req_id: int, status: int, entries: bytes):
         self.req_id = req_id
@@ -231,7 +241,7 @@ class FetchOutputResp(RpcMsg):
         return cls(req_id, status, payload[_QI.size:])
 
 
-@register(9)
+@register()
 class FetchBlocksReq(RpcMsg):
     """Scatter-read: list of (buf token, offset, length) to pack in order."""
 
@@ -278,7 +288,7 @@ FLAG_CRC32 = 4    # the logical payload carries a trailer of one
 _QII = struct.Struct("<qii")
 
 
-@register(10)
+@register()
 class FetchBlocksResp(RpcMsg):
     def __init__(self, req_id: int, status: int, data: bytes, flags: int = 0):
         self.req_id = req_id
@@ -295,7 +305,7 @@ class FetchBlocksResp(RpcMsg):
         return cls(req_id, status, payload[_QII.size:], flags)
 
 
-@register(11)
+@register()
 class RunTaskReq(RpcMsg):
     """Ship one serialized task to an executor (the role Spark's task
     scheduler plays for the reference: tasks arrive at executors with the
@@ -315,7 +325,7 @@ class RunTaskReq(RpcMsg):
         return cls(req_id, payload[8:])
 
 
-@register(12)
+@register()
 class RunTaskResp(RpcMsg):
     """status: TASK_OK / TASK_ERROR / TASK_FETCH_FAILED; payload is the
     serialized result or error detail."""
@@ -334,7 +344,7 @@ class RunTaskResp(RpcMsg):
         return cls(req_id, status, payload[12:])
 
 
-@register(13)
+@register()
 class CreditReport(RpcMsg):
     """Reader -> server: ``consumed`` logical response bytes were drained
     by the consumer — replenish that much of this connection's serving
@@ -357,7 +367,7 @@ class CreditReport(RpcMsg):
         return cls(consumed)
 
 
-@register(14)
+@register()
 class GetBroadcastReq(RpcMsg):
     """Executor -> driver: fetch a broadcast blob by id (the delivery
     half of shared_vars.Broadcast — once per executor PROCESS, cached
@@ -377,7 +387,7 @@ class GetBroadcastReq(RpcMsg):
         return cls(req_id, bcast_id)
 
 
-@register(15)
+@register()
 class GetBroadcastResp(RpcMsg):
     """status STATUS_OK with the pickled blob, or STATUS_ERROR when the
     id is unknown (unpersisted or never registered)."""
@@ -396,7 +406,7 @@ class GetBroadcastResp(RpcMsg):
         return cls(req_id, status, payload[12:])
 
 
-@register(16)
+@register()
 class PingMsg(RpcMsg):
     """Peer-health probe (endpoint heartbeat monitor): carries a
     ``req_id`` so it rides the same ``request_async`` pipelining as
@@ -415,7 +425,7 @@ class PingMsg(RpcMsg):
         return cls(req_id)
 
 
-@register(17)
+@register()
 class PongMsg(RpcMsg):
     """Echoed heartbeat completion."""
 
@@ -431,7 +441,7 @@ class PongMsg(RpcMsg):
         return cls(req_id)
 
 
-@register(18)
+@register()
 class FetchOutputsReq(RpcMsg):
     """Batched block-location read: the 16B entries [start, end) of MANY
     maps' output tables in one round trip (one per (shuffle, peer) for
@@ -461,7 +471,7 @@ class FetchOutputsReq(RpcMsg):
         return cls(req_id, shuffle_id, map_ids, start, end)
 
 
-@register(19)
+@register()
 class FetchOutputsResp(RpcMsg):
     """Per-map records ``(map_id, status, entries)`` in request order.
     ``status`` is the overall verdict (a non-OK overall status carries no
@@ -503,7 +513,7 @@ class FetchOutputsResp(RpcMsg):
 EPOCH_DEAD = -1
 
 
-@register(20)
+@register()
 class EpochBumpMsg(RpcMsg):
     """Driver -> executors push: shuffle ``shuffle_id``'s location state
     is now version ``epoch`` (monotone per shuffle; ``EPOCH_DEAD`` =
@@ -529,7 +539,7 @@ class EpochBumpMsg(RpcMsg):
         return cls(shuffle_id, epoch)
 
 
-@register(21)
+@register()
 class ShardMapMsg(RpcMsg):
     """Driver -> executors push at registerShuffle time: the map-range ->
     shard-host assignment for one shuffle (location_plane.ShardMap wire
@@ -559,7 +569,7 @@ class ShardMapMsg(RpcMsg):
         return cls(shuffle_id, epoch, num_maps, slots)
 
 
-@register(22)
+@register()
 class ShardEntryMsg(RpcMsg):
     """Driver -> shard host: one APPLIED driver-table entry forwarded
     into the host's shard replica (the driver stays the fencing
@@ -587,7 +597,7 @@ class ShardEntryMsg(RpcMsg):
         return cls(shuffle_id, epoch, map_id, num_maps, payload[20:])
 
 
-@register(23)
+@register()
 class FetchShardReq(RpcMsg):
     """Reducer -> shard host: long-poll read of driver-table entries
     [map_lo, map_hi) out of the host's shard replica. Same long-poll
@@ -619,7 +629,7 @@ class FetchShardReq(RpcMsg):
                    timeout_ms)
 
 
-@register(24)
+@register()
 class FetchShardResp(RpcMsg):
     """``num_published`` counts published maps within the requested
     range (-1 = the host holds no replica for the shuffle — the client
@@ -646,7 +656,7 @@ class FetchShardResp(RpcMsg):
                    payload[_QI.size + _Q.size:])
 
 
-@register(25)
+@register()
 class ReducePlanMsg(RpcMsg):
     """Driver -> executors push: the shuffle's reduce plan (adaptive
     skew-aware planning, shuffle/planner.py) — an epoch-stamped,
@@ -667,7 +677,7 @@ class ReducePlanMsg(RpcMsg):
         return cls(payload)
 
 
-@register(26)
+@register()
 class FetchPlanReq(RpcMsg):
     """Reducer -> driver: pull one shuffle's current reduce plan (the
     cold path / lost-push backstop of ``ReducePlanMsg``)."""
@@ -685,7 +695,7 @@ class FetchPlanReq(RpcMsg):
         return cls(req_id, shuffle_id)
 
 
-@register(27)
+@register()
 class FetchPlanResp(RpcMsg):
     """``STATUS_OK`` with the plan bytes; ``STATUS_ERROR`` when the
     driver holds no plan (adaptive planning off, or the map stage has

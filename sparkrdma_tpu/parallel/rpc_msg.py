@@ -26,15 +26,75 @@ HEADER = struct.Struct("<II")  # (total_length incl. header, msg_type)
 
 _REGISTRY: Dict[int, Type["RpcMsg"]] = {}
 
+# THE authoritative wire-number table: every message class's type id, in
+# one place, keyed by class name. ``@register()`` call sites look their
+# id up here, so a new message means one new row — the id can never be
+# assigned twice or drift between the class and a doc. The analyzer
+# suite (sparkrdma_tpu/analysis/wire.py) asserts the live registry
+# matches this table exactly (unique, dense over the reserved gaps) and
+# regenerates the message-ID table in docs/CONFIG.md from it.
+WIRE_IDS: Dict[str, int] = {
+    "HelloMsg": 1,
+    "AnnounceMsg": 2,
+    "PublishMsg": 3,
+    # 4 reserved: was the publish ack (publish is one-sided now)
+    "FetchTableReq": 5,
+    "FetchTableResp": 6,
+    "FetchOutputReq": 7,
+    "FetchOutputResp": 8,
+    "FetchBlocksReq": 9,
+    "FetchBlocksResp": 10,
+    "RunTaskReq": 11,
+    "RunTaskResp": 12,
+    "CreditReport": 13,
+    "GetBroadcastReq": 14,
+    "GetBroadcastResp": 15,
+    "PingMsg": 16,
+    "PongMsg": 17,
+    "FetchOutputsReq": 18,
+    "FetchOutputsResp": 19,
+    "EpochBumpMsg": 20,
+    "ShardMapMsg": 21,
+    "ShardEntryMsg": 22,
+    "FetchShardReq": 23,
+    "FetchShardResp": 24,
+    "ReducePlanMsg": 25,
+    "FetchPlanReq": 26,
+    "FetchPlanResp": 27,
+}
 
-def register(msg_type: int):
+# Ids deliberately absent from the dense 1..max range, with the reason
+# pinned here so the density check can never be silenced by accident.
+RESERVED_WIRE_IDS: Dict[int, str] = {
+    4: "was the publish ack; publish is one-sided like the reference's "
+       "RDMA WRITE, nothing acks",
+}
+
+
+def register(msg_type: Optional[int] = None):
+    """Class decorator registering an ``RpcMsg`` subclass for decode.
+
+    With no argument (every production call site) the wire number comes
+    from ``WIRE_IDS[cls.__name__]`` — the one table above. An explicit
+    id remains accepted for test/fixture message types outside it.
+    """
     def deco(cls: Type["RpcMsg"]):
-        if msg_type in _REGISTRY:
-            raise ValueError(f"duplicate msg_type {msg_type}")
-        cls.MSG_TYPE = msg_type
-        _REGISTRY[msg_type] = cls
+        mt = msg_type
+        if mt is None:
+            if cls.__name__ not in WIRE_IDS:
+                raise ValueError(f"{cls.__name__} has no WIRE_IDS row")
+            mt = WIRE_IDS[cls.__name__]
+        if mt in _REGISTRY:
+            raise ValueError(f"duplicate msg_type {mt}")
+        cls.MSG_TYPE = mt
+        _REGISTRY[mt] = cls
         return cls
     return deco
+
+
+def registry() -> Dict[int, Type["RpcMsg"]]:
+    """Snapshot of the live decode registry (analyzer + doc generation)."""
+    return dict(_REGISTRY)
 
 
 class RpcMsg:
@@ -96,7 +156,7 @@ class Reassembler:
             yield decode_message(frame)
 
 
-@register(1)
+@register()
 class HelloMsg(RpcMsg):
     """Executor → driver introduction (scala/RdmaRpcMsg.scala:81-112)."""
 
@@ -115,7 +175,7 @@ class HelloMsg(RpcMsg):
         return isinstance(other, HelloMsg) and self.manager_id == other.manager_id
 
 
-@register(2)
+@register()
 class AnnounceMsg(RpcMsg):
     """Driver → all executors membership broadcast
     (scala/RdmaRpcMsg.scala:114-173).

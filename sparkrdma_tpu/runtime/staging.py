@@ -153,10 +153,13 @@ class SpillFile:
             while self._readers > 0 and deadline > 0:
                 self._rc_cv.wait(timeout=0.1)
                 deadline -= 0.1
-        if self._native_handle is not None:
-            native.LIB.staging_unmap(self._native_handle)
-            self._native_handle = None
-        self._py_data = None
+        with self._rc_cv:
+            # re-entering the cv keeps the handle teardown ordered
+            # against a reader that lost the drain race to the deadline
+            if self._native_handle is not None:
+                native.LIB.staging_unmap(self._native_handle)
+                self._native_handle = None
+            self._py_data = None
         if self._delete and os.path.exists(self.path):
             os.unlink(self.path)
 

@@ -394,7 +394,7 @@ class TpuShuffleWriter:
                 f"worker is dead")
             self._cv.notify_all()
 
-    def _ensure_spill_workers(self) -> None:
+    def _ensure_spill_workers_locked(self) -> None:
         if self._spill_queue is None:
             self._spill_queue = queue.Queue()
         while len(self._spill_workers) < self._max_inflight:
@@ -414,7 +414,7 @@ class TpuShuffleWriter:
         self._spill_seq += 1
         self._inflight += 1
         self._inflight_bytes += nbytes
-        self._ensure_spill_workers()
+        self._ensure_spill_workers_locked()
         self._spill_queue.put((seq, runs, nbytes))
 
     def _spill_worker(self) -> None:
@@ -758,10 +758,12 @@ class TpuShuffleWriter:
             self._raise_spill_error_locked()
 
     def _free_runs(self) -> None:
-        for run in self._runs:
-            run.free()
-        self._runs = []
-        self._buffered = 0
+        with self._cv:
+            runs, self._runs = self._runs, []
+            self._buffered = 0
+        for run in runs:
+            run.free()  # pool lease release: outside the cv, it takes
+            #             the pool's own lock
 
     def _cleanup_spill_files(self) -> None:
         with self._cv:
@@ -776,7 +778,8 @@ class TpuShuffleWriter:
                 self._spill_queue.put(None)
             for t in self._spill_workers:
                 t.join(timeout=30)
-            self._spill_workers = []
+            with self._cv:
+                self._spill_workers = []
 
     def _abort_cleanup(self) -> None:
         """Abort path: nothing of this attempt survives on disk — not the

@@ -1,0 +1,60 @@
+"""The trace-name registry: every span/instant/counter name the
+codebase may emit, in one place.
+
+Trace names are load-bearing: dashboards, the chaos assertions, and the
+bench harness all select events by exact name, so a typo'd emission
+(``plan.coalese``) silently forks a series instead of failing anything.
+The drift pass (``sparkrdma_tpu/analysis/drift.py``) AST-scans every
+``tracer.span/complete_span/instant/counter`` call site and requires
+the emitted literal to resolve HERE — and, symmetrically, every name
+here to still be emitted somewhere, so the registry can't rot into a
+wishlist.
+
+Adding an event = one line here + the emission. Names are
+``<subsystem>.<event>``; keep new ones consistent.
+"""
+
+from __future__ import annotations
+
+# Duration spans: ``tracer.span(...)`` context managers and the
+# explicit-boundary ``complete_span`` emissions of the async fetcher.
+SPANS = frozenset({
+    "engine.dist_reduce",
+    "engine.stage",
+    "engine.task",
+    "fetch.blocks",
+    "fetch.complete",
+    "fetch.driver_table",
+    "fetch.issue",
+    "fetch.locations",
+    "fetch.refetch_range",
+    "fetch.vectored",
+    "write.merge",
+    "write.scatter",
+    "write.spill",
+    "writer.commit",
+    "writer.publish",
+})
+
+# Point-in-time instants (fault/decision markers).
+INSTANTS = frozenset({
+    "commit.fenced",
+    "fetch.coalesce_fallback",
+    "fetch.retry",
+    "meta.epoch_bump",
+    "peer.suspect",
+    "plan.coalesce",
+    "plan.replan",
+    "plan.split",
+    "serve.corrupt",
+    "write.cleanup_error",
+    "write.spill_retry",
+    "write.spill_shrink",
+})
+
+# Chrome "C"-phase counter series.
+COUNTERS = frozenset({
+    "peer.suspects",
+})
+
+ALL = SPANS | INSTANTS | COUNTERS

@@ -34,3 +34,32 @@ def pytest_configure(config):
         "chaos: seeded fault-injection scenario (parallel/faults.py); "
         "fast ones run in tier-1, the wide sweep is chaos+slow and "
         "driven by scripts/run_chaos.sh across CHAOS_SEED values")
+    # ANALYSIS_LOCKGRAPH=1: run the whole session under the lock-order
+    # shim (sparkrdma_tpu/analysis/lockgraph.py). Every lock the package
+    # creates during the run is tracked; a lock-order cycle fails the
+    # session at exit (scripts/run_analysis.sh --lockgraph drives this).
+    global _lockgraph
+    if os.environ.get("ANALYSIS_LOCKGRAPH", "0") not in ("0", "false", ""):
+        from sparkrdma_tpu.analysis import lockgraph
+
+        _lockgraph = lockgraph.install()
+
+
+_lockgraph = None
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _lockgraph is None:
+        return
+    from sparkrdma_tpu.analysis import lockgraph
+
+    lockgraph.uninstall()
+    cycles = _lockgraph.cycles()
+    if cycles:
+        import sys
+
+        print("\n" + _lockgraph.format_cycles(), file=sys.stderr)
+        session.exitstatus = 3
+    else:
+        print(f"\nlockgraph: acyclic "
+              f"({len(_lockgraph.edges())} distinct orderings)")

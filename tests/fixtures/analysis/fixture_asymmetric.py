@@ -1,0 +1,28 @@
+"""Seeded violation for the wire pass: pack/unpack asymmetry.
+
+``payload()`` writes (req_id, flags) but ``from_payload`` drops flags,
+so a decoded message re-encodes differently — exactly the
+field-written-but-never-read drift the fuzzer exists to catch.
+"""
+
+import struct
+
+
+class LossyMsg:  # seeded-violation: from_payload drops the flags field
+    MSG_TYPE = 1
+
+    def __init__(self, req_id=0, flags=0):
+        self.req_id = req_id
+        self.flags = flags
+
+    def payload(self):
+        return struct.pack("<qi", self.req_id, self.flags)
+
+    @classmethod
+    def from_payload(cls, payload):
+        (req_id,) = struct.unpack_from("<q", payload, 0)
+        return cls(req_id)  # flags lost: decodes as 0
+
+
+FIXTURE_PAIRS = [(1, LossyMsg)]
+FIXTURE_WIRE_IDS = {"LossyMsg": 1}

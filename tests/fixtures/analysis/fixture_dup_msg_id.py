@@ -1,0 +1,30 @@
+"""Seeded violation for the wire pass: two classes claim wire id 1.
+
+Never imported by production code — tests/test_analysis.py feeds
+``FIXTURE_PAIRS`` to ``wire.check_registry`` and asserts the duplicate
+is caught with this file and the second class's line.
+"""
+
+import struct
+
+
+class PingA:
+    MSG_TYPE = 1
+
+    def __init__(self, req_id=0):
+        self.req_id = req_id
+
+    def payload(self):
+        return struct.pack("<q", self.req_id)
+
+    @classmethod
+    def from_payload(cls, payload):
+        return cls(*struct.unpack_from("<q", payload, 0))
+
+
+class PingB(PingA):  # seeded-violation: same wire id as PingA
+    MSG_TYPE = 1
+
+
+FIXTURE_PAIRS = [(1, PingA), (1, PingB)]
+FIXTURE_WIRE_IDS = {"PingA": 1, "PingB": 1}

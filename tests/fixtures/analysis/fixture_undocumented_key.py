@@ -1,0 +1,14 @@
+"""Seeded violation for the drift pass: ``mystery_key`` is declared but
+has no row in the paired fixture doc (fixture_undocumented_key.md),
+which in turn documents a ``ghost_key`` no declaration backs.
+"""
+
+
+def _Key(name, default, kind):
+    return (name, default, kind)
+
+
+_KEYS = [
+    _Key("documented_key", 1, "int"),
+    _Key("mystery_key", 2, "int"),  # seeded-violation: no doc row
+]

@@ -1,0 +1,19 @@
+"""Seeded violation for the concurrency pass: ``_count`` is mutated
+under ``self._lock`` on the hot path but clobbered without it in
+``reset`` — the classic teardown race the unguarded-write lint flags.
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def incr(self):
+        with self._lock:
+            self._count += 1
+
+    def reset(self):
+        self._count = 0  # seeded-violation: write outside the lock

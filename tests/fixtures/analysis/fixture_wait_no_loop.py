@@ -1,0 +1,22 @@
+"""Seeded violation for the concurrency pass: ``Condition.wait`` under
+an ``if`` instead of a ``while`` predicate loop (and with no deadline —
+the in-loop deadline rule has its own seeded line below).
+"""
+
+import threading
+
+
+class Waiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            if not self._ready:
+                self._cv.wait()  # seeded-violation: no predicate loop
+
+    def wait_ready_forever(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()  # seeded-deadline: loop but no timeout

@@ -1,0 +1,318 @@
+"""Invariant analyzer suite (sparkrdma_tpu/analysis/): the tier-1 gate.
+
+Three claims, each load-bearing:
+
+1. the LIVE TREE is clean — every static pass (wire, concurrency,
+   drift) runs over the real codebase and reports zero findings, so a
+   drifted constant, an unguarded shared write, or a typo'd trace name
+   fails the build here;
+2. the analyzers actually DETECT — each seeded-violation fixture under
+   tests/fixtures/analysis/ is caught by its pass with the right
+   file:line (an analyzer that silently stopped seeing violations
+   would otherwise "pass" forever);
+3. the lockgraph shim records real acquisition orderings — a synthetic
+   inversion is reported as a cycle, and a genuine multi-threaded
+   shuffle (writers spilling, readers fetching over sockets) runs
+   ACYCLIC under the shim with Condition semantics intact.
+
+The sanitizer harness (pass 4) is exercised when RUN_SANITIZERS=1
+(scripts/run_analysis.sh --sanitize); building instrumented .so's is
+out of tier-1's budget.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import sparkrdma_tpu.analysis as analysis
+from sparkrdma_tpu.analysis import concurrency, core, drift, lockgraph, wire
+
+ROOT = core.repo_root()
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "analysis")
+
+
+def _load_fixture(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(FIXTURES, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # inspect needs it to resolve source files
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _marker_line(path, marker="seeded-violation"):
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            if marker in line:
+                return i
+    raise AssertionError(f"no '{marker}' marker in {path}")
+
+
+# ---------------------------------------------------------- the live gate
+
+def test_live_tree_zero_findings():
+    """THE gate: wire + concurrency + drift over the real tree."""
+    findings = analysis.run_all()
+    assert not findings, "\n" + core.format_report(findings)
+
+
+def test_wire_registry_is_dense_and_unique():
+    findings = wire.check_registry(wire.live_pairs())
+    assert not findings, "\n" + core.format_report(findings)
+    ids = [t for t, _ in wire.live_pairs()]
+    assert len(ids) == len(set(ids))
+    assert set(ids) | set(wire.rpc_msg.RESERVED_WIRE_IDS) == set(
+        range(1, max(ids) + 1))
+
+
+def test_wire_doc_table_matches_registry():
+    assert not wire.check_doc_table()
+
+
+def test_legacy_truncation_matrix():
+    assert not wire.check_truncation()
+
+
+def test_native_constant_lockstep():
+    assert not wire.check_native_constants()
+
+
+# ------------------------------------------------------- fixture detection
+
+def test_fixture_duplicate_msg_id():
+    mod = _load_fixture("fixture_dup_msg_id")
+    findings = wire.check_registry(mod.FIXTURE_PAIRS,
+                                   wire_ids=mod.FIXTURE_WIRE_IDS,
+                                   reserved={})
+    dups = [f for f in findings if "duplicate wire id 1" in f.message]
+    assert dups, core.format_report(findings)
+    path = os.path.join(FIXTURES, "fixture_dup_msg_id.py")
+    assert dups[0].path.endswith("fixture_dup_msg_id.py")
+    assert dups[0].line == _marker_line(path)
+
+
+def test_fixture_asymmetric_roundtrip():
+    mod = _load_fixture("fixture_asymmetric")
+    findings = wire.fuzz_roundtrip(mod.FIXTURE_PAIRS)
+    asym = [f for f in findings if "asymmetry" in f.message]
+    assert asym, core.format_report(findings)
+    path = os.path.join(FIXTURES, "fixture_asymmetric.py")
+    assert asym[0].path.endswith("fixture_asymmetric.py")
+    assert asym[0].line == _marker_line(path)
+
+
+def test_fixture_unguarded_write():
+    path = os.path.join(FIXTURES, "fixture_unguarded_write.py")
+    with open(path) as f:
+        findings = concurrency.scan_source(f.read(), path)
+    hits = [f for f in findings if "_count" in f.message
+            and "outside any 'with <lock>'" in f.message]
+    assert hits, core.format_report(findings)
+    assert hits[0].line == _marker_line(path)
+
+
+def test_fixture_wait_without_loop_and_deadline():
+    path = os.path.join(FIXTURES, "fixture_wait_no_loop.py")
+    with open(path) as f:
+        findings = concurrency.scan_source(f.read(), path)
+    no_loop = [f for f in findings if "outside a 'while'" in f.message]
+    no_deadline = [f for f in findings if "without a deadline" in f.message]
+    assert no_loop and no_loop[0].line == _marker_line(path)
+    assert no_deadline and no_deadline[0].line == _marker_line(
+        path, "seeded-deadline")
+
+
+def test_fixture_undocumented_and_ghost_key():
+    py = os.path.join(FIXTURES, "fixture_undocumented_key.py")
+    md = os.path.join(FIXTURES, "fixture_undocumented_key.md")
+    with open(md) as f:
+        doc_text = f.read()
+    findings = drift.check_config_docs(
+        drift._config_key_lines(py), py, doc_text, md)
+    missing = [f for f in findings if "mystery_key" in f.message]
+    stale = [f for f in findings if "ghost_key" in f.message]
+    assert missing and missing[0].path == py
+    assert missing[0].line == _marker_line(py)
+    assert stale and stale[0].path == md
+    assert stale[0].line == _marker_line(md)
+    assert len(findings) == 2  # documented_key drifts neither way
+
+
+# ----------------------------------------------------------- pragma rules
+
+def test_bare_pragma_is_a_finding():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._x = 0\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self._x = 1\n"
+           "    def b(self):\n"
+           "        self._x = 2  # analysis: unguarded-ok\n")
+    findings = concurrency.scan_source(src, "<mem>")
+    assert any(f.pass_name == "pragma" for f in findings)
+
+
+def test_reasoned_pragma_suppresses():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._x = 0\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self._x = 1\n"
+           "    def b(self):\n"
+           "        self._x = 2  # analysis: unguarded-ok(single-owner)\n")
+    assert not concurrency.scan_source(src, "<mem>")
+
+
+def test_locked_suffix_convention():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._x = 0\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self._x = 1\n"
+           "    def bump_locked(self):\n"
+           "        self._x += 1\n")
+    assert not concurrency.scan_source(src, "<mem>")
+
+
+# -------------------------------------------------------------- lockgraph
+
+def test_lockgraph_unit_cycle_detection():
+    g = lockgraph.LockGraph()
+    g._push("A", 1)
+    g._note_acquire("B", 2)
+    g._push("B", 2)
+    g._pop("B", 2)
+    g._pop("A", 1)
+    g._push("B", 2)
+    g._note_acquire("A", 1)  # inversion
+    g._push("A", 1)
+    cycles = g.cycles()
+    assert len(cycles) == 1 and set(cycles[0]) == {"A", "B"}
+    assert "A -> B" in g.format_cycles()
+
+
+def test_lockgraph_same_site_pairs_excluded():
+    g = lockgraph.LockGraph()
+    g._push("A", 1)
+    g._note_acquire("A", 2)  # second instance of the same role
+    g._push("A", 2)
+    assert not g.cycles() and not g.edges()
+
+
+def test_lockgraph_reentrant_rlock_no_edge():
+    g = lockgraph.LockGraph()
+    g._push("A", 1)
+    g._note_acquire("A", 1)  # reentrant re-acquire
+    assert not g.edges()
+
+
+def test_shuffle_e2e_under_lockgraph_is_acyclic(tmp_path):
+    """The acceptance run: a real 2-executor shuffle — streaming
+    writers with background spill, socket fetch, driver publishes —
+    recorded by the shim, then checked for lock-order cycles. Also
+    proves patched Condition/RLock semantics hold end to end (the
+    shuffle byte-verifies its output)."""
+    from sparkrdma_tpu.config import TpuShuffleConf
+    from sparkrdma_tpu.shuffle.manager import (PartitionerSpec,
+                                               TpuShuffleManager)
+
+    owned = lockgraph.current() is None
+    graph = lockgraph.install()
+    pre = {tuple(c) for c in graph.cycles()}  # session shim may own graph
+    try:
+        conf = TpuShuffleConf(connect_timeout_ms=5000,
+                              shuffle_read_block_size="4k",
+                              spill_threshold_bytes=4096)
+        driver = TpuShuffleManager(conf, is_driver=True)
+        execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                                   executor_id=str(i),
+                                   spill_dir=str(tmp_path / f"e{i}"))
+                 for i in range(2)]
+        try:
+            for ex in execs:
+                ex.executor.wait_for_members(2)
+            handle = driver.register_shuffle(
+                91, 4, 6, PartitionerSpec("modulo"), row_payload_bytes=8)
+            rng = np.random.default_rng(3)
+            total_rows = 0
+            for m in range(4):
+                keys = rng.integers(0, 5000, size=800).astype(np.uint64)
+                payload = rng.integers(0, 255, size=(800, 8)).astype(np.uint8)
+                w = execs[m % 2].get_writer(handle, m)
+                w.write_batch(keys, payload)
+                w.close()
+                total_rows += 800
+            got = 0
+            for i, ex in enumerate(execs):
+                reader = ex.get_reader(handle, i * 3, (i + 1) * 3)
+                k, _ = reader.read_all()
+                got += len(k)
+            assert got == total_rows
+        finally:
+            for ex in execs:
+                ex.stop()
+            driver.stop()
+    finally:
+        if owned:
+            lockgraph.uninstall()
+    assert graph.edges(), "shim recorded nothing — install() broken?"
+    new = [c for c in graph.cycles() if tuple(c) not in pre]
+    assert not new, graph.format_cycles()
+
+
+# ------------------------------------------------------------ CLI + gated
+
+def test_cli_exit_code_plumbing(monkeypatch, capsys):
+    """The CLI's exit-code/format contract, in-process — the full
+    passes already ran once this session in
+    test_live_tree_zero_findings; re-running them in a subprocess
+    would only re-pay the fuzz + AST walks."""
+    from sparkrdma_tpu.analysis import __main__ as cli
+
+    monkeypatch.setattr(cli, "run_all", lambda: [])
+    assert cli.main([]) == 0
+    assert "clean (0 findings)" in capsys.readouterr().out
+    boom = core.Finding("wire", "x.py", 3, "boom")
+    monkeypatch.setattr(cli, "run_all", lambda: [boom])
+    assert cli.main([]) == 1
+    assert "x.py:3: [wire] boom" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("RUN_SANITIZERS") != "1",
+                    reason="RUN_SANITIZERS=1 builds + runs the "
+                           "ASan/UBSan native harness")
+def test_native_sanitizer_harness():
+    subprocess.run(["make", "-C", os.path.join(ROOT, "csrc"),
+                    "asan", "ubsan"], check=True, timeout=600)
+    asan_so = os.path.join(ROOT, "sparkrdma_tpu", "runtime",
+                           "libtpushuffle_asan.so")
+    ubsan_so = os.path.join(ROOT, "sparkrdma_tpu", "runtime",
+                            "libtpushuffle_ubsan.so")
+    libasan = subprocess.run(
+        [os.environ.get("CXX", "g++"), "-print-file-name=libasan.so"],
+        capture_output=True, text=True, check=True).stdout.strip()
+    for so, extra_env in ((asan_so, {"LD_PRELOAD": libasan,
+                                     "ASAN_OPTIONS": "detect_leaks=0"}),
+                          (ubsan_so, {})):
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "sparkrdma_tpu.analysis.native_harness", so],
+            cwd=ROOT, capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **extra_env})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "all exercises passed" in proc.stdout

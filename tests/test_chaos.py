@@ -57,6 +57,30 @@ WARM = os.environ.get("CHAOS_WARM", "1") not in ("0", "false")
 # see every injected fault; run_chaos.sh sweeps both. The mid-stage
 # re-plan scenario below forces it on regardless.
 SKEW = os.environ.get("CHAOS_SKEW", "0") not in ("0", "false")
+# CHAOS_LOCKGRAPH=1: run every scenario under the lock-order shim
+# (sparkrdma_tpu/analysis/lockgraph.py) so the chaos matrix doubles as
+# race detection — faults drive the rare teardown/retry/suspect paths
+# where lock-order inversions hide. Any cycle fails the module.
+LOCKGRAPH = os.environ.get("CHAOS_LOCKGRAPH", "0") not in ("0", "false")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _chaos_lockgraph():
+    if not LOCKGRAPH:
+        yield
+        return
+    from sparkrdma_tpu.analysis import lockgraph
+
+    owned = lockgraph.current() is None  # ANALYSIS_LOCKGRAPH may own it
+    graph = lockgraph.install()
+    # under a session-wide shim the graph is shared: blame only cycles
+    # that appear DURING this module (pre-existing ones fail elsewhere)
+    pre = {tuple(c) for c in graph.cycles()}
+    yield
+    if owned:
+        lockgraph.uninstall()
+    new = [c for c in graph.cycles() if tuple(c) not in pre]
+    assert not new, graph.format_cycles()
 
 
 def _conf(**kw):
