@@ -460,6 +460,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("iterative warm done")
     _bench_skew(detail)
     _progress("skew plan done")
+    _bench_fused_exchange(detail)
+    _progress("fused exchange done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -602,6 +604,43 @@ def _bench_skew(detail: dict) -> None:
             detail[f"{prefix}_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
+def _bench_fused_exchange(detail: dict) -> None:
+    """The fused device dataplane's win over the host-staged reduce,
+    measured without hardware: the same shuffle reduced once through
+    per-partition remote fetches (delay shim standing in for wire RTT,
+    the fetch_bench precedent) and once through the fused
+    partition+exchange+local-sort collective — same process, so the
+    ratio cancels host noise like dense_exchange_guard; byte-identical
+    output is the gate. See shuffle/device_bench.py."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.device_bench import run_device_microbench
+
+        with tempfile.TemporaryDirectory(prefix="devbench_") as td:
+            res = run_device_microbench(td)
+        if not res["identical"]:
+            detail["fused_exchange_error"] = \
+                "host and fused dataplanes reduced different bytes"
+            return
+        detail["fused_exchange_speedup"] = res["speedup"]
+        detail["fused_exchange_wall_s"] = res["wall_s"]
+    except Exception as e:  # noqa: BLE001
+        detail["fused_exchange_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _round_provenance(detail: dict) -> dict:
+    """Host-contention provenance EVERY bench round must carry: the
+    load average (a uniform slowdown across workloads under high load
+    here is noise, not a regression — the BENCH_r05 lesson) and the
+    capture timestamp. The tier-1 round-JSON test asserts these keys
+    are recorded alongside dense_exchange_guard."""
+    detail["host_load_avg"] = [round(x, 2) for x in os.getloadavg()]
+    detail["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())
+    return detail
+
+
 def _bench_dense_guard(detail: dict, mesh, impl: str, small_cfg,
                        small_rows) -> None:
     """Dense-exchange regression guard: time the SAME small terasort
@@ -719,6 +758,7 @@ def main() -> None:
         _progress(f"cpu baseline done ({cpu_dt:.1f}s, cached={was_cached})")
         if os.environ.get("BENCH_SKIP_SECONDARY") != "1":
             _secondary_workloads(detail, mesh, n, on_tpu)
+        _round_provenance(detail)
         print(json.dumps({"metric": "terasort_secondary", "value": 0,
                           "unit": "", "detail": detail}))
         return
@@ -853,13 +893,12 @@ def main() -> None:
                     else "host numpy + device_put",
         # what actually ran, not the request: "auto" resolves per mesh
         "exchange_impl": _resolved_impl(mesh, impl),
-        # host contention provenance: a uniform slowdown across every
-        # workload with high load here is noise, not a regression (the
-        # BENCH_r05 lesson — its fresh numbers ran under an active
-        # recovery watcher while the cached baseline stayed frozen)
-        "host_load_avg": [round(x, 2) for x in os.getloadavg()],
-        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    # host contention provenance: a uniform slowdown across every
+    # workload with high load here is noise, not a regression (the
+    # BENCH_r05 lesson — its fresh numbers ran under an active
+    # recovery watcher while the cached baseline stayed frozen)
+    _round_provenance(detail)
     if _resolved_impl(mesh, impl) == "dense":
         # dense-exchange step time tracked per round, noise-cancelled
         # against gather on the same host in the same process

@@ -333,6 +333,28 @@ _KEYS = [
              "the even share) so locality can't recreate the straggler "
              "it exists to remove. Off = tasks carry no placement "
              "preference (round-robin execution)."),
+    # --- device exchange dataplane (TPU-only: parallel/device_plane.py,
+    # docs/CONFIG.md "Device exchange")
+    _Key("device_plane", "auto", "str",
+         doc="Which dataplane carries on-mesh stages: 'auto' asks the "
+             "cost model (stage residency, estimated bytes vs the "
+             "device_hbm_budget round sizing, topology support from "
+             "resolve_impl), 'device' forces the fused ICI "
+             "partition+exchange+sort plane, 'host' forces the "
+             "writer->resolver->fetcher dataplane (the regression "
+             "escape hatch). Regardless of selection, a stage whose "
+             "exchange overflows its skew headroom or loses an "
+             "executor mid-stage degrades itself to the host plane."),
+    _Key("device_hbm_budget", "64m", "bytes", 1 << 16, 1 << 40,
+         doc="Per-device HBM byte budget for one fused exchange round: "
+             "rounds auto-size to rows_per_round = budget / "
+             "(row_bytes * (2 + 2*out_factor)) — input + grouped copy "
+             "+ receive + sorted copy — replacing the static "
+             "mesh_rows_per_round knob (still honored when set, "
+             "deprecated). Stages whose bytes fit one round run as a "
+             "single fused step; larger stages stream double-buffered "
+             "rounds (round k+1's collective dispatches while round "
+             "k's on-device sort runs)."),
     _Key("request_deadline_ms", 0, "int", 0, 3600_000,
          doc="Per-request completion deadline on the control plane "
              "(request/AsyncFetch waits); 0 = fall back to "

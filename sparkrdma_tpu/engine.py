@@ -100,6 +100,11 @@ class _JobTornDownError(Exception):
     instead of dying on a missing handle."""
 
 
+# cached per-shuffle marker: the cost model (or a mid-stage degrade)
+# routed this stage to the host dataplane — readers use getReader
+_HOST_PLANE = object()
+
+
 class _MeshCell:
     """Once-cell for one shuffle's mesh-reduce results (per-shuffle lock:
     independent shuffles reduce concurrently)."""
@@ -135,7 +140,12 @@ class TaskContext:
         if handle is None:
             raise _JobTornDownError(parent.stage_id)
         if self._engine.mesh is not None:
-            return self._engine._mesh_read(handle, self.task_id)
+            reader = self._engine._mesh_read(handle, self.task_id)
+            if reader is not None:
+                return reader
+            # the cost model picked (or a degrade forced) the HOST
+            # dataplane for this stage: same records through the
+            # fetcher path with all its retry/CRC machinery
         return self.manager.getReader(handle, self.task_id, self.task_id + 1)
 
 
@@ -188,22 +198,37 @@ class DAGEngine:
                  speculation_multiplier: float = 1.5,
                  mesh=None, mesh_axis: str = "shuffle",
                  mesh_impl: str = "auto", mesh_rows_per_round: int = 0,
+                 dataplane: str = "auto",
+                 device_hbm_budget: int = 0,
                  dist_mesh_axis: Optional[str] = None,
                  dist_rows_per_round: int = 0,
                  dist_fail_grace_s: float = 5.0):
         self.driver = driver
         self.executors = list(executors)
         self.max_stage_retries = max_stage_retries
-        # ICI data plane: with a jax.sharding.Mesh here, reduce-side reads
-        # are served by ONE collective mesh reduce per parent shuffle
-        # (shuffle/mesh_service.py) instead of per-task TCP fetches — the
+        # ICI data plane: with a jax.sharding.Mesh here, on-mesh stages'
+        # reduce reads are served by the FUSED device dataplane (one
+        # shard_map partition+exchange+sort per round,
+        # parallel/device_plane.py + shuffle/mesh_service.py) — the
         # engine SPI and the accelerated path become the same code path,
-        # as in the reference. mesh_rows_per_round > 0 streams the reduce
-        # in bounded rounds (datasets beyond one exchange's budget).
+        # as in the reference. Which plane carries each stage is decided
+        # by the COST MODEL (device_plane.select_dataplane: stage
+        # residency, estimated bytes vs the HBM budget, topology support)
+        # rather than a flag; `dataplane` overrides it ("device"/"host"),
+        # and a stage whose exchange overflows or loses an executor
+        # mid-stage degrades to the host dataplane by itself.
+        # mesh_rows_per_round > 0 pins the round size (DEPRECATED: rounds
+        # are auto-sized from device_hbm_budget / the device_hbm_budget
+        # conf key — see docs/CONFIG.md "Device exchange").
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.mesh_impl = mesh_impl
         self.mesh_rows_per_round = mesh_rows_per_round
+        self.dataplane = dataplane
+        self.device_hbm_budget = device_hbm_budget
+        # stages forced onto the host dataplane mid-job (overflow or
+        # mid-stage executor loss): shuffle_id -> reason
+        self._mesh_degraded: Dict[int, str] = {}
         if mesh is not None and any(self._is_remote(ex) for ex in executors):
             raise ValueError(
                 "mesh data plane needs in-process executors (their "
@@ -364,6 +389,7 @@ class DAGEngine:
                                if k[0] != handle.shuffle_id}
         with self._mesh_lock:
             self._mesh_cache.pop(handle.shuffle_id, None)
+        self._mesh_degraded.pop(handle.shuffle_id, None)
         self._dist_owner.pop(handle.shuffle_id, None)
         self.driver.unregisterShuffle(handle.shuffle_id)
         # executor-side too: drops the resolver's spill data and the
@@ -972,19 +998,26 @@ class DAGEngine:
                       timeout=self.dist_fail_grace_s)
         return failure, hard
 
-    def _mesh_read(self, handle, partition: int) -> CompatReader:
-        """A reader over ``partition`` served from the collective reduce."""
+    def _mesh_read(self, handle, partition: int) -> Optional[CompatReader]:
+        """A reader over ``partition`` served from the collective reduce,
+        or None when the stage rides the host dataplane (cost-model
+        choice or a mid-stage degrade) — the caller falls back to the
+        ordinary ``getReader`` fetch path."""
         from sparkrdma_tpu.shuffle.mesh_service import CachedPartitionReader
 
         per_part = self._mesh_partitions(handle)
+        if per_part is _HOST_PLANE:
+            return None
         return CompatReader(CachedPartitionReader(
             per_part, partition, partition + 1, handle.row_payload_bytes))
 
-    def _mesh_partitions(self, handle) -> list:
-        """The parent shuffle's per-partition results, computing the ONE
-        mesh reduce on first use. Raises FetchFailedError (feeding the
-        ordinary stage-retry machinery) when a map output is on no live
-        executor — the mesh-mode analogue of a failed remote fetch.
+    def _mesh_partitions(self, handle):
+        """The parent shuffle's per-partition results (or the
+        ``_HOST_PLANE`` marker when the stage rides the host dataplane),
+        computing the ONE mesh reduce on first use. Raises
+        FetchFailedError (feeding the ordinary stage-retry machinery)
+        when a map output is on no live executor — the mesh-mode
+        analogue of a failed remote fetch.
 
         Per-shuffle compute cells: ``_mesh_lock`` guards only the cache
         dict, so independent shuffles reduce concurrently and cache hits
@@ -1008,49 +1041,105 @@ class DAGEngine:
                     raise
             return cell.value
 
-    def _compute_mesh_partitions(self, handle) -> list:
+    def _compute_mesh_partitions(self, handle):
         from sparkrdma_tpu.shuffle.mesh_service import (
-            run_mesh_reduce,
-            run_mesh_reduce_streamed,
+            run_mesh_reduce_fused,
             split_by_partition,
         )
 
+        sid = handle.shuffle_id
+        if sid in self._mesh_degraded:
+            self.tracer.instant("exchange.select", "exchange",
+                                shuffle=sid, plane="host",
+                                reason=self._mesh_degraded[sid])
+            return _HOST_PLANE
         mgrs = [ex.native for ex in self._live()]
         present: set = set()
+        sizes: Dict[int, int] = {}
         for mgr in mgrs:
             if mgr.resolver is not None:
-                present.update(mgr.resolver.map_ids(handle.shuffle_id))
+                for m, b in mgr.resolver.local_output_bytes(sid).items():
+                    present.add(m)
+                    sizes.setdefault(m, b)  # dedupe speculative copies
         missing = sorted(set(range(handle.num_maps)) - present)
         if missing:
             stage_id = next(
-                (sid for sid, h in self._handles.items()
-                 if h.shuffle_id == handle.shuffle_id), None)
+                (s for s, h in self._handles.items()
+                 if h.shuffle_id == sid), None)
             if stage_id is None:
-                raise _JobTornDownError(handle.shuffle_id)
+                raise _JobTornDownError(sid)
             slot = self._owners.get(stage_id, {}).get(missing[0], -1)
+            self._mesh_degraded[sid] = "mid-stage executor loss"
+            self.tracer.instant("exchange.degrade", "exchange",
+                                shuffle=sid, reason="executor_loss",
+                                map=missing[0])
             raise FetchFailedError(
-                handle.shuffle_id, missing[0], slot,
+                sid, missing[0], slot,
                 "map output on no live executor (mesh staging)")
         # receive headroom: with P partitions on D devices only min(P, D)
         # devices receive at all, so a receiver's fair share is
         # ceil(D/min(P,D)) x the per-device send capacity — double that
-        # for key skew (the caller-visible knob stays OverflowError)
+        # for key skew (the caller-visible knob stays the host degrade)
         n_dev = self.mesh.shape[self.mesh_axis]
         fan_in = -(-n_dev // max(1, min(handle.num_partitions, n_dev)))
         out_factor = 2 * fan_in
-        if self.mesh_rows_per_round > 0:
-            results = run_mesh_reduce_streamed(
+        plan = self._select_plan(handle, sum(sizes.values()), out_factor)
+        self.tracer.instant("exchange.select", "exchange", shuffle=sid,
+                            plane=plan.plane, impl=plan.impl,
+                            rows_per_round=plan.rows_per_round,
+                            reason=plan.reason)
+        if plan.plane != "device":
+            return _HOST_PLANE
+        # deprecated escape hatch: an explicit mesh_rows_per_round pins
+        # the round size over the budget-derived auto-sizing
+        rows_per_round = self.mesh_rows_per_round or plan.rows_per_round
+        try:
+            results = run_mesh_reduce_fused(
                 mgrs, handle, self.mesh, axis_name=self.mesh_axis,
-                impl=self.mesh_impl, out_factor=out_factor,
-                rows_per_round=self.mesh_rows_per_round,
-                expect_maps=handle.num_maps)
-        else:
-            results = run_mesh_reduce(
-                mgrs, handle, self.mesh, axis_name=self.mesh_axis,
-                impl=self.mesh_impl, out_factor=out_factor,
-                expect_maps=handle.num_maps)
+                impl=plan.impl, rows_per_round=rows_per_round,
+                out_factor=out_factor, expect_maps=handle.num_maps,
+                tracer=self.tracer)
+        except OverflowError as e:
+            # skew beat the headroom for this stage: degrade exactly
+            # this stage to the host dataplane instead of failing
+            self._mesh_degraded[sid] = "receive overflow"
+            self.tracer.instant("exchange.degrade", "exchange",
+                                shuffle=sid, reason="overflow")
+            log.warning("mesh shuffle %d: %s; serving the stage from "
+                        "the host dataplane", sid, e)
+            return _HOST_PLANE
+        except FetchFailedError:
+            # an output vanished between the completeness check and the
+            # staging read (executor dying mid-stage): after recovery,
+            # the retry serves this stage from the host dataplane
+            self._mesh_degraded[sid] = "mid-stage executor loss"
+            self.tracer.instant("exchange.degrade", "exchange",
+                                shuffle=sid, reason="executor_loss")
+            raise
         return split_by_partition(results, handle.num_partitions,
                                   handle.row_payload_bytes)
+
+    def _select_plan(self, handle, est_bytes: int, out_factor: int):
+        """Ask the cost model which plane carries this stage; engine
+        ctor args override conf keys override "auto"."""
+        from sparkrdma_tpu.parallel.device_plane import (
+            StageProfile,
+            select_dataplane,
+        )
+        from sparkrdma_tpu.shuffle.mesh_service import device_row_words
+
+        conf = getattr(self.driver.native, "conf", None)
+        override = self.dataplane
+        if override == "auto" and conf is not None:
+            override = conf.device_plane
+        budget = self.device_hbm_budget or (
+            conf.device_hbm_budget if conf is not None else 64 << 20)
+        row_bytes = 4 * device_row_words(handle.row_payload_bytes)
+        profile = StageProfile(est_bytes=est_bytes, row_bytes=row_bytes,
+                               resident=True, out_factor=out_factor)
+        return select_dataplane(self.mesh, self.mesh_axis, profile,
+                                impl=self.mesh_impl, hbm_budget=budget,
+                                override=override)
 
     # -- recovery (scala/RdmaShuffleFetcherIterator.scala:376-381) -------
 

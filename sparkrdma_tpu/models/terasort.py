@@ -24,20 +24,13 @@ config #1).
 
 from __future__ import annotations
 
-import functools
 import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from sparkrdma_tpu.utils.compat import shard_map
-
-from sparkrdma_tpu.ops.partition import uniform_splitters
-from sparkrdma_tpu.parallel.exchange import ragged_exchange_shard, resolve_impl
 
 
 @dataclass(frozen=True)
@@ -83,86 +76,19 @@ def make_terasort_step(mesh: Mesh, axis_name: str, cfg: TeraSortConfig,
     (key=0xFFFFFFFF) at the end. ``overflowed[d]`` flags that device d's
     receive buffer was too small for the skew (results there are truncated
     and must not be trusted — raise ``out_factor`` or chunk the round).
+
+    The step IS the device plane's fused op (``parallel.device_plane.
+    make_fused_step``) in its range-partition mode: TeraSort's uniform
+    u32 key-range split makes ONE key sort double as the destination
+    grouping; the generic op adds the caller-computed-destination mode
+    the mesh shuffle service rides.
     """
-    n = mesh.shape[axis_name]
-    impl = resolve_impl(mesh, impl, axis_name)
-    if cfg.sort_mode not in ("gather", "multisort", "colsort"):
-        # a typo must not silently measure (and mislabel) the gather path
-        raise ValueError(f"unknown sort_mode {cfg.sort_mode!r} "
-                         "(expected 'gather', 'multisort' or 'colsort')")
-    splitters = uniform_splitters(n, jnp.uint32)
-    spec = P(axis_name)
+    from sparkrdma_tpu.parallel.device_plane import make_fused_step
 
-    def sort_rows_by_key(rows, keys):
-        """One local sort of full rows by key; exactly one per exchange
-        side (see TeraSortConfig.sort_mode for the two strategies)."""
-        if cfg.sort_mode == "multisort":
-            cols = tuple(rows[:, j] for j in range(rows.shape[1]))
-            # is_stable: all three modes must order duplicate keys
-            # identically (gather is stable via its iota tiebreak)
-            out = jax.lax.sort((keys,) + cols, num_keys=1, is_stable=True)
-            sorted_keys = out[0]
-            sorted_rows = jnp.stack(out[1:], axis=1)
-        elif cfg.sort_mode == "colsort":
-            # identical keys in every lane + a STABLE sort => every column
-            # receives the same permutation, so rows stay intact without a
-            # gather and without per-column operands
-            keys_b = jnp.broadcast_to(keys[:, None], rows.shape)
-            sorted_kb, sorted_rows = jax.lax.sort(
-                (keys_b, rows), dimension=0, num_keys=1, is_stable=True)
-            sorted_keys = sorted_kb[:, 0]
-        else:
-            iota = jnp.arange(rows.shape[0], dtype=jnp.int32)
-            # iota as a SECOND KEY makes the order total: duplicate keys
-            # order by original position with no reliance on sort
-            # stability (a value-operand iota under an unstable sort
-            # could permute ties arbitrarily)
-            sorted_keys, order = jax.lax.sort((keys, iota), num_keys=2)
-            sorted_rows = jnp.take(rows, order, axis=0)
-        # the key column already equals sorted_keys for valid rows; only
-        # padding rows (sentinel keys) need the overwrite
-        return sorted_rows.at[:, 0].set(sorted_keys), sorted_keys
-
-    # pallas interpret-mode outputs confuse the vma checker when mixed
-    # with collectives; disable it ONLY for the ring transports (same
-    # rule as make_chunked_exchange / make_shuffle_exchange)
-    shard_kwargs = dict(mesh=mesh, in_specs=(spec,),
-                        out_specs=(spec, spec, spec))
-    if impl in ("ring", "ring_interpret"):
-        shard_kwargs["check_vma"] = False
-
-    @jax.jit
-    @functools.partial(shard_map, **shard_kwargs)
-    def step(rows):
-        keys = rows[:, 0]
-        if n == 1:
-            # single-device: no exchange, one sort+gather is the whole job
-            sorted_rows, _ = sort_rows_by_key(rows, keys)
-            counts = jnp.array([[rows.shape[0]]], dtype=jnp.int32)
-            return sorted_rows, counts, jnp.zeros((1,), bool)
-
-        # Local sort by KEY once: range partition is monotonic in key, so
-        # key-sorted rows are destination-grouped for free — this replaces
-        # the separate argsort-by-destination + gather entirely.
-        grouped, sorted_keys = sort_rows_by_key(rows, keys)
-        # per-destination counts: D-1 binary searches on the sorted keys
-        bounds = jnp.searchsorted(sorted_keys, splitters, side="left")
-        bounds = jnp.concatenate([jnp.zeros(1, bounds.dtype), bounds,
-                                  jnp.array([rows.shape[0]], bounds.dtype)])
-        counts = jnp.diff(bounds).astype(jnp.int32)
-
-        output = jnp.zeros((rows.shape[0] * cfg.out_factor, rows.shape[1]),
-                           dtype=rows.dtype)
-        received, recv_counts, _, overflowed = ragged_exchange_shard(
-            grouped, counts, axis_name, output=output, impl=impl)
-        total = recv_counts.sum()
-        valid = jnp.arange(received.shape[0], dtype=jnp.int32) < total
-        sentinel = jnp.uint32(0xFFFFFFFF)
-        sort_keys = jnp.where(valid, received[:, 0], sentinel)
-        sorted_rows, _ = sort_rows_by_key(received, sort_keys)
-        return sorted_rows, recv_counts[None], overflowed[None]
-
-    return step
+    return make_fused_step(mesh, axis_name, 1 + cfg.payload_words,
+                           out_factor=cfg.out_factor, impl=impl,
+                           sort_mode=cfg.sort_mode, key_words=1,
+                           partition="range")
 
 
 def generate_rows(cfg: TeraSortConfig, num_devices: int,

@@ -380,9 +380,30 @@ def resolve_impl(mesh: Mesh, impl: str = "auto",
     platform = next(iter(mesh.devices.flat)).platform
     if platform != "tpu":
         return "gather"
-    ok, reason = _native_compiles(mesh, axis_name or mesh.axis_names[-1])
+    axis = axis_name or mesh.axis_names[-1]
+    ok, reason = _native_compiles(mesh, axis)
     if ok:
         return "native"
+    _warn_topology_once(mesh, axis, reason)
+    return "dense"
+
+
+# (mesh, axis) pairs whose topology-rejection warning already fired:
+# only _native_compiles is cached, so without this memo EVERY
+# resolve_impl call re-logged the same rejection — iterative stages
+# (ALS supersteps, per-stage cost-model probes) flooded the log.
+_topology_warned: set = set()
+_TOPOLOGY_WARN_LOCK = threading.Lock()
+
+
+def _warn_topology_once(mesh: Mesh, axis_name: str, reason: str) -> None:
+    """Log the "topology rejects ragged-all-to-all" warning once per
+    (mesh, axis); later resolutions of the same pair stay silent."""
+    key = (mesh, axis_name)
+    with _TOPOLOGY_WARN_LOCK:
+        if key in _topology_warned:
+            return
+        _topology_warned.add(key)
     import logging
 
     logging.getLogger(__name__).warning(
@@ -390,12 +411,36 @@ def resolve_impl(mesh: Mesh, impl: str = "auto",
         "fixed-slot all-to-all transport (out_factor-bounded padding "
         "overhead; the chunked ring is the neighbor-traffic "
         "alternative). Compiler said: %s", reason[:300])
-    return "dense"
+
+
+def bucket_quota(quota: int) -> int:
+    """Round ``quota`` up to the next power of two — the memoization
+    bucket for the chunked-exchange builders. Iterative stages derive
+    per-round quotas from drifting byte budgets; memoizing per EXACT
+    quota recompiled every superstep, while pow2 bucketing caps the
+    compile count at log2(max quota) with identical results (quota only
+    bounds per-round chunking, never the data moved). Rounding UP means
+    a round may buffer up to 2x the requested quota — callers sizing
+    quota against a hard memory bound should pass the pow2 at or below
+    their budget."""
+    return 1 << max(0, int(quota) - 1).bit_length()
+
+
+def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
+                          impl: str = "auto"):
+    """Bounded-round ragged exchange for arbitrary skew; ``quota`` is
+    bucketed to the next power of two (``bucket_quota``) before the
+    memoized build, so drifting quotas share compiles. The returned
+    ``round_fn``'s shapes are sized by the BUCKETED quota — drive the
+    round loop with ``bucket_quota(quota)`` (``chunked_exchange`` does).
+    See ``_make_chunked_exchange``."""
+    return _make_chunked_exchange(mesh, axis_name, bucket_quota(quota),
+                                  impl)
 
 
 @functools.lru_cache(maxsize=128)
-def make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
-                          impl: str = "auto"):
+def _make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
+                           impl: str = "auto"):
     """Bounded-round ragged exchange for arbitrary skew. Memoized per
     (mesh, axis, quota, impl) so iterative callers (ALS) compile once.
 
@@ -486,9 +531,17 @@ def _chunked_round_shard(grouped, counts, round_idx, axis_name: str, n: int,
     return received, recv_counts
 
 
-@functools.lru_cache(maxsize=128)
 def make_chunked_exchange_acc(mesh: Mesh, axis_name: str, quota: int,
                               impl: str = "auto"):
+    """``make_chunked_exchange_acc`` with the same pow2 quota bucketing
+    as ``make_chunked_exchange`` (see ``bucket_quota``)."""
+    return _make_chunked_exchange_acc(mesh, axis_name,
+                                      bucket_quota(quota), impl)
+
+
+@functools.lru_cache(maxsize=128)
+def _make_chunked_exchange_acc(mesh: Mesh, axis_name: str, quota: int,
+                               impl: str = "auto"):
     """``make_chunked_exchange`` with a DEVICE-RESIDENT accumulator: each
     round scatters its received rows straight into a per-device output
     buffer at their final source-major position, so the host loop touches
@@ -551,6 +604,11 @@ def chunked_exchange(mesh: Mesh, axis_name: str, grouped: np.ndarray,
     ``ragged_exchange_shard``). ``grouped``/``counts`` are global arrays
     sharded on axis 0.
 
+    ``quota`` is bucketed UP to the next power of two (``bucket_quota``)
+    to share compiles across drifting quotas — a round may buffer up to
+    2x the requested per-pair bound, so callers sizing quota against a
+    hard memory budget should pass the pow2 at or below it.
+
     Host cost model: O(1) work per round (the loop index), one
     device->host transfer at the end. The previous per-round
     ``np.asarray`` + O(D^2) Python segment slicing made the HOST the
@@ -559,6 +617,7 @@ def chunked_exchange(mesh: Mesh, axis_name: str, grouped: np.ndarray,
     registered memory and stay there,
     scala/RdmaShuffleFetcherIterator.scala:240-276)."""
     n = mesh.shape[axis_name]
+    quota = bucket_quota(quota)  # match the builders' memoization bucket
     counts_host = np.asarray(counts).reshape(n, n)
     num_rounds = max(1, int(-(-counts_host.max() // quota)))
     recv_totals = counts_host.sum(axis=0)        # rows landing per device

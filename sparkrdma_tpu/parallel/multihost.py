@@ -116,7 +116,11 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
 
     from sparkrdma_tpu.parallel import exchange as exchange_mod
     from sparkrdma_tpu.parallel.exchange import make_shuffle_exchange
-    from sparkrdma_tpu.shuffle.mesh_service import _rows_to_u32, _u32_to_rows
+    from sparkrdma_tpu.shuffle.mesh_service import (
+        _rows_to_u32,
+        _u32_to_rows,
+        device_row_words,
+    )
     from sparkrdma_tpu.shuffle.writer import decode_rows
 
     n_global = mesh.devices.size
@@ -229,7 +233,7 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
             "mid-staging, or its managers not passed in) — raised on all "
             "processes; recompute and re-enter collectively")
 
-    width = 2 + (handle.row_payload_bytes + 3) // 4
+    width = device_row_words(handle.row_payload_bytes)
     sharding = NamedSharding(mesh, P(axis_name))
     # 3. the shared jitted exchange over the GLOBAL mesh — one compile
     # serves every round (shapes are identical by construction)

@@ -25,6 +25,14 @@ from sparkrdma_tpu.shuffle.fetcher import ReadMetrics
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
 
 
+def device_row_words(payload_bytes: int) -> int:
+    """u32 words per device row for a given payload width: key lo, key
+    hi, then the padded payload words — THE row-layout formula, shared
+    by the packers, the streamed reducers, and the engine's cost model
+    (a layout change must move them all together)."""
+    return 2 + (payload_bytes + 3) // 4
+
+
 def _rows_to_u32(keys: np.ndarray, payload: np.ndarray) -> np.ndarray:
     """Pack (u64 keys, u8 payload) into the device row format:
     ``u32[N, 2 + ceil(W/4)]`` = key lo, key hi, payload words."""
@@ -81,20 +89,7 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
     n_dev = mesh.shape[axis_name]
     partitioner = handle.partitioner.build(handle.num_partitions)
 
-    # 1. stage: stream every local spill sequentially (no host scatter),
-    # through the resolver's locked serving API (safe vs. concurrent
-    # re-commit/unregister disposal)
-    all_keys, all_payloads = [], []
-    delivered: set = set()
-    for k, p in _iter_committed_batches(managers, handle, delivered):
-        all_keys.append(k)
-        all_payloads.append(p)
-    _check_staging_complete(delivered, expect_maps, handle.shuffle_id)
-    keys = (np.concatenate(all_keys) if all_keys
-            else np.zeros(0, dtype=np.uint64))
-    payload = (np.concatenate(all_payloads) if all_payloads
-               else np.zeros((0, handle.row_payload_bytes), dtype=np.uint8))
-
+    keys, payload = _stage_all(managers, handle, expect_maps)
     rows = _rows_to_u32(keys, payload)
     dest_part = np.asarray(partitioner(keys), dtype=np.int32)
 
@@ -131,6 +126,113 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
             k, p, parts = k[order], p[order], parts[order]
         results.append((k, p, parts))
     return results
+
+
+def run_mesh_reduce_fused(managers: Sequence[TpuShuffleManager],
+                          handle: ShuffleHandle, mesh,
+                          axis_name: str = "shuffle", impl: str = "auto",
+                          rows_per_round: int = 0, out_factor: int = 2,
+                          expect_maps: Optional[int] = None,
+                          tracer=None,
+                          ) -> List[Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]]:
+    """``run_mesh_reduce`` on the FUSED device plane: one
+    ``shard_map``-fused partition+exchange+local-sort step per round
+    (``parallel.device_plane``), so between the one staging upload and
+    the one result download partitions never leave HBM — the reduce-side
+    sort that ``run_mesh_reduce``/``run_mesh_reduce_streamed`` ran
+    host-side per round happens on the receiving device, and rounds are
+    double-buffered (round k+1's collective dispatches while round k's
+    on-device sort runs; ``exchange.round``/``exchange.overlap`` trace
+    the overlap).
+
+    ``rows_per_round`` bounds each round's per-device rows (0 = one
+    shot) — the engine auto-sizes it from the HBM byte budget
+    (``device_plane.auto_rows_per_round``). With rounds bounded, host
+    staging is bounded too: spills stream straight into round blocks
+    (one round resident, plus the in-flight one), the discipline
+    ``run_mesh_reduce_streamed`` had. Raises ``OverflowError`` when
+    skew beats the ``out_factor`` headroom; the engine degrades exactly
+    this stage to the host dataplane. Same result contract as
+    ``run_mesh_reduce`` with ``sort_by_key=True``.
+    """
+    from sparkrdma_tpu.parallel.device_plane import (
+        run_fused_exchange,
+        run_fused_exchange_rounds,
+    )
+
+    n_dev = mesh.shape[axis_name]
+    partitioner = handle.partitioner.build(handle.num_partitions)
+    pw = device_row_words(handle.row_payload_bytes)
+
+    if rows_per_round > 0:
+        # bounded rounds: stream spills straight into round blocks
+        def round_blocks():
+            pending_r: List[np.ndarray] = []
+            pending_d: List[np.ndarray] = []
+            pending = 0
+            per_round = rows_per_round * n_dev
+            delivered: set = set()
+            for k, p in _iter_committed_batches(managers, handle,
+                                                delivered):
+                rows = _rows_to_u32(k, p)
+                dest = (np.asarray(partitioner(k), dtype=np.int32)
+                        % n_dev)
+                while len(rows):
+                    take = min(len(rows), per_round - pending)
+                    pending_r.append(rows[:take])
+                    pending_d.append(dest[:take])
+                    pending += take
+                    rows, dest = rows[take:], dest[take:]
+                    if pending == per_round:
+                        yield (np.concatenate(pending_r),
+                               np.concatenate(pending_d))
+                        pending_r, pending_d, pending = [], [], 0
+            _check_staging_complete(delivered, expect_maps,
+                                    handle.shuffle_id)
+            if pending:
+                yield np.concatenate(pending_r), np.concatenate(pending_d)
+
+        per_device, _rounds = run_fused_exchange_rounds(
+            mesh, axis_name, round_blocks(), pw, rows_per_round,
+            key_words=2, out_factor=out_factor, impl=impl, tracer=tracer)
+    else:
+        # one shot: the cost model only picks this when the stage fits
+        # the budget, so whole-stage staging is within contract
+        keys, payload = _stage_all(managers, handle, expect_maps)
+        rows = _rows_to_u32(keys, payload)
+        dest = (np.asarray(partitioner(keys), dtype=np.int32) % n_dev)
+        per_device, _rounds = run_fused_exchange(
+            mesh, axis_name, rows, dest, key_words=2,
+            out_factor=out_factor, impl=impl, tracer=tracer)
+
+    # unpack: rows arrive key-sorted per device already
+    results = []
+    for d in range(n_dev):
+        k, p = _u32_to_rows(per_device[d], handle.row_payload_bytes)
+        parts = np.asarray(partitioner(k), dtype=np.int64)
+        results.append((k, p, parts))
+    return results
+
+
+def _stage_all(managers, handle, expect_maps: Optional[int]
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stage every committed local spill into one (keys, payload) pair:
+    streamed sequentially (no host scatter) through the resolver's
+    locked serving API (safe vs. concurrent re-commit/unregister
+    disposal), with the completeness check. Shared by the one-shot
+    reduces; the bounded-round paths stream instead."""
+    all_keys, all_payloads = [], []
+    delivered: set = set()
+    for k, p in _iter_committed_batches(managers, handle, delivered):
+        all_keys.append(k)
+        all_payloads.append(p)
+    _check_staging_complete(delivered, expect_maps, handle.shuffle_id)
+    keys = (np.concatenate(all_keys) if all_keys
+            else np.zeros(0, dtype=np.uint64))
+    payload = (np.concatenate(all_payloads) if all_payloads
+               else np.zeros((0, handle.row_payload_bytes), dtype=np.uint8))
+    return keys, payload
 
 
 def _iter_committed_batches(managers, handle, delivered: Optional[set] = None):
@@ -220,7 +322,7 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
 
     n_dev = mesh.shape[axis_name]
     partitioner = handle.partitioner.build(handle.num_partitions)
-    pw = 2 + (handle.row_payload_bytes + 3) // 4
+    pw = device_row_words(handle.row_payload_bytes)
     cap = rows_per_round
     sharding = NamedSharding(mesh, P(axis_name))
     # the one shared jitted exchange, compiled once for the round shape

@@ -482,6 +482,16 @@ class TpuShuffleBlockResolver:
         with self._lock:
             return sorted(self._shuffles.get(shuffle_id, {}).keys())
 
+    def local_output_bytes(self, shuffle_id: int) -> Dict[int, int]:
+        """``map_id -> committed data bytes`` this resolver holds for the
+        shuffle (per-partition length sums from the in-memory index, no
+        file I/O) — the device-plane cost model's stage-size input.
+        Per-map so callers can dedupe the copies speculation/retry leave
+        on two executors."""
+        with self._lock:
+            return {m: int(s.partition_lengths.sum())
+                    for m, s in self._shuffles.get(shuffle_id, {}).items()}
+
     # -- lifecycle -------------------------------------------------------
 
     def _sweep_tmps(self, shuffle_prefix: Optional[str] = None) -> None:

@@ -22,6 +22,7 @@ SPANS = frozenset({
     "engine.dist_reduce",
     "engine.stage",
     "engine.task",
+    "exchange.round",
     "fetch.blocks",
     "fetch.complete",
     "fetch.driver_table",
@@ -39,6 +40,9 @@ SPANS = frozenset({
 # Point-in-time instants (fault/decision markers).
 INSTANTS = frozenset({
     "commit.fenced",
+    "exchange.degrade",
+    "exchange.overlap",
+    "exchange.select",
     "fetch.coalesce_fallback",
     "fetch.retry",
     "meta.epoch_bump",

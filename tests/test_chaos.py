@@ -532,6 +532,112 @@ def test_chaos_replan_mid_stage_after_executor_loss(tmp_path):
         _shutdown(driver, execs)
 
 
+def test_chaos_device_plane_loss_degrades_to_host(tmp_path, monkeypatch):
+    """Device-dataplane loss scenario: the cost model picks the fused
+    ICI plane for an on-mesh stage, an executor dies MID-STAGE (its
+    committed outputs vanish while staging is in flight), and the stage
+    degrades onto the host dataplane — recovery recomputes the lost
+    maps on survivors, the retry serves the stage through the fetcher,
+    and the output is byte-identical to a fault-free run."""
+    import jax
+    from jax.sharding import Mesh
+
+    from engine_helpers import make_cluster, u32_payload
+    from sparkrdma_tpu.engine import DAGEngine, MapStage, ResultStage
+    from sparkrdma_tpu.shuffle import fetcher as fetcher_mod
+    from sparkrdma_tpu.shuffle import mesh_service
+    from sparkrdma_tpu.shuffle.spark_compat import ShuffleDependency
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shuffle",))
+    P, maps, rows, key_space = 4, 6, 400, 3000
+
+    def map_fn(ctx, writer, task_id):
+        rng = np.random.default_rng(5000 + SEED * 100 + task_id)
+        keys = rng.integers(0, key_space, rows).astype(np.uint64)
+        writer.write((keys, u32_payload(
+            rng.integers(0, 1000, rows).astype(np.uint32))))
+
+    holder = {"engine": None, "degraded": {}}
+
+    def reduce_fn(ctx, task_id):
+        keys, payload = ctx.read(0)._r.read_all()
+        # observe the degrade while the stage is alive (teardown pops
+        # the memo when run() returns)
+        holder["degraded"].update(holder["engine"]._mesh_degraded)
+        rowsb = np.concatenate(
+            [keys.view(np.uint8).reshape(len(keys), 8),
+             np.ascontiguousarray(payload)], axis=1)
+        return rowsb[np.lexsort(rowsb.T[::-1])].tobytes()
+
+    fetchers = {"n": 0}
+    orig_init = fetcher_mod.ShuffleFetcher.__init__
+
+    def spy(self, *a, **kw):
+        fetchers["n"] += 1
+        return orig_init(self, *a, **kw)
+
+    monkeypatch.setattr(fetcher_mod.ShuffleFetcher, "__init__", spy)
+
+    def run(label, chaos):
+        driver, execs = make_cluster(tmp_path / label)
+        try:
+            # sequential tasks: the injection relies on the FIRST read
+            # triggering the one mesh staging pass
+            engine = holder["engine"] = DAGEngine(driver, execs,
+                                                  mesh=mesh,
+                                                  max_parallel_tasks=1)
+            holder["degraded"] = {}
+            state = {"fired": False}
+            if chaos:
+                orig_iter = mesh_service._iter_committed_batches
+
+                def chaos_iter(managers, handle, delivered=None):
+                    for batch in orig_iter(managers, handle, delivered):
+                        yield batch
+                        if not state["fired"]:
+                            # mid-staging: the victim dies and its
+                            # committed outputs die with it
+                            state["fired"] = True
+                            victim = execs[1].native
+                            mid = victim.executor.manager_id
+                            victim.executor.stop()
+                            driver.native.driver.remove_member(mid)
+                            victim.resolver.remove_shuffle(
+                                handle.shuffle_id)
+
+                monkeypatch.setattr(mesh_service,
+                                    "_iter_committed_batches", chaos_iter)
+            stage = MapStage(maps, ShuffleDependency(
+                P, PartitionerSpec("modulo"), row_payload_bytes=4),
+                map_fn)
+            out = engine.run(ResultStage(P, reduce_fn, parents=[stage]))
+            if chaos:
+                monkeypatch.setattr(mesh_service,
+                                    "_iter_committed_batches", orig_iter)
+            return out, engine, state
+        finally:
+            for ex in execs:
+                ex.stop()
+            driver.stop()
+
+    clean_out, clean_engine, _ = run("clean", chaos=False)
+    assert not holder["degraded"], f"seed={SEED}"
+    before_fetchers = fetchers["n"]
+
+    chaos_out, chaos_engine, state = run("kill", chaos=True)
+    assert state["fired"], f"seed={SEED}: injection never ran"
+    # the device plane was selected (staging ran), then the stage
+    # degraded onto the host dataplane...
+    assert list(holder["degraded"].values()) == \
+        ["mid-stage executor loss"], f"seed={SEED}"
+    assert not chaos_engine._mesh_degraded, \
+        f"seed={SEED}: teardown leaked the degrade memo"
+    assert fetchers["n"] > before_fetchers, \
+        f"seed={SEED}: degrade never reached the host dataplane"
+    # ...byte-identically
+    assert chaos_out == clean_out, f"seed={SEED}"
+
+
 # -- the wide sweep (chaos + slow; scripts/run_chaos.sh) -----------------
 
 
