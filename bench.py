@@ -458,6 +458,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("write path done")
     _bench_iterative(detail)
     _progress("iterative warm done")
+    _bench_merged_read(detail)
+    _progress("merged read done")
     _bench_skew(detail)
     _progress("skew plan done")
     _bench_fused_exchange(detail)
@@ -538,6 +540,37 @@ def _bench_fetch_pipeline(detail: dict) -> None:
         detail["fetch_rpc_requests"] = cres["requests"]
     except Exception as e:  # noqa: BLE001
         detail["fetch_rpc_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_merged_read(detail: dict) -> None:
+    """The push-merge dataplane's win, measured without hardware: a
+    many-small-maps shuffle drained by a late-joining reducer at equal
+    bytes, once over the scattered per-map fan-in (M x P served ranges)
+    and once merged-segment-first (P sequential wide reads, ~1 request
+    per partition), with a per-range seek-cost shim standing in for the
+    random IOPS a real disk charges scattered reads
+    (shuffle/merge_bench.py). Pure host path — identical on TPU and
+    CPU-fallback records."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.merge_bench import run_merge_microbench
+
+        with tempfile.TemporaryDirectory(prefix="mergebench_") as td:
+            res = run_merge_microbench(td)
+        if not res["identical"]:
+            detail["merged_read_error"] = \
+                "merged and scattered reads fetched different bytes"
+            return
+        if not res["coverage_complete"]:
+            detail["merged_read_error"] = "merged coverage never completed"
+            return
+        detail["merged_read_speedup"] = res["speedup"]
+        detail["merged_read_wall_s"] = res["wall_s"]
+        detail["merged_read_requests"] = res["requests"]
+        detail["merged_read_blocks_served"] = res["blocks_served"]
+    except Exception as e:  # noqa: BLE001
+        detail["merged_read_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _bench_iterative(detail: dict) -> None:

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Push-merge sweep: the push-merge dataplane's test matrix
+# (tests/test_push_merge.py — target assignment, ledger fencing,
+# directory round-trips, merged-vs-scattered byte parity, ENOSPC
+# overflow, corrupt-segment degrade) across a set of extra seeds, then
+# the merged-read microbench with its acceptance gates: >= 2x
+# per-partition fetch vs the scattered per-map fan-in under the
+# seek-cost shim, requests_per_reduce ~ 1 per partition, byte-identical
+# output. A red seed replays exactly:
+#
+#     MERGE_SEED=<seed> python -m pytest tests/test_push_merge.py
+#
+# Usage: scripts/run_merge_bench.sh [seed ...]
+#   MERGE_SEEDS="0 1 2"   alternative way to pass the seed list
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SEEDS=${*:-${MERGE_SEEDS:-"0 7 42"}}
+failed=()
+for seed in $SEEDS; do
+  echo "=== merge sweep: seed ${seed} ==="
+  if ! MERGE_SEED="${seed}" JAX_PLATFORMS=cpu \
+       python -m pytest tests/test_push_merge.py -q \
+         -p no:cacheprovider -p no:randomly; then
+    echo "!!! seed ${seed} FAILED — replay with:"
+    echo "    MERGE_SEED=${seed} python -m pytest tests/test_push_merge.py"
+    failed+=("${seed}")
+  fi
+done
+
+echo "=== merged-read microbench ==="
+if ! JAX_PLATFORMS=cpu python - <<'EOF'
+import json, sys, tempfile
+from sparkrdma_tpu.shuffle.merge_bench import run_merge_microbench
+
+with tempfile.TemporaryDirectory(prefix="mergebench_") as td:
+    res = run_merge_microbench(td)
+print(json.dumps(res))
+ok = (res["identical"] and res["coverage_complete"]
+      and res["speedup"] >= 2.0
+      and res["merged_reads"] == res["partitions"]
+      and res["requests"]["merged"] <= res["partitions"] + 2)
+sys.exit(0 if ok else 1)
+EOF
+then
+  failed+=("microbench")
+fi
+
+if [ "${#failed[@]}" -gt 0 ]; then
+  echo "merge sweep: FAILED: ${failed[*]}"
+  exit 1
+fi
+echo "merge sweep: all seeds green, microbench gates met"

@@ -159,11 +159,19 @@ def _gen_arg(name: str, rng: random.Random):
         return bytes(rng.randrange(256)
                      for _ in range(M.PublishMsg.ENTRY_BYTES
                                     * rng.randrange(6)))
-    if name in ("data", "plan_bytes", "entries", "payload"):
+    if name in ("data", "plan_bytes", "entries", "payload", "accepted",
+                "covered"):
         return bytes(rng.randrange(256) for _ in range(4 * rng.randrange(17)))
     if name == "blocks":
         return [(rng.randrange(1 << 32), rng.randrange(1 << 48),
                  rng.randrange(1 << 31)) for _ in range(rng.randrange(5))]
+    if name == "sizes":
+        # per-partition byte lengths of a push (u32 each, never None)
+        return [rng.randrange(1 << 31) for _ in range(rng.randrange(6))]
+    if name == "ranges":
+        # (offset: u64, length: u32) byte ranges of a merged segment
+        return [(rng.randrange(1 << 48), rng.randrange(1 << 31))
+                for _ in range(rng.randrange(4))]
     if name == "records":
         return [(rng.randrange(1 << 20), rng.randrange(6),
                  bytes(rng.randrange(256) for _ in range(16 * rng.randrange(4))))
@@ -201,6 +209,9 @@ _EXTRA_CASES: Dict[str, List[Callable[[], "rpc_msg.RpcMsg"]]] = {
     "EpochBumpMsg": [lambda: M.EpochBumpMsg(5, M.EPOCH_DEAD)],
     "FetchTableResp": [lambda: M.FetchTableResp(1, -1, b"", M.EPOCH_DEAD)],
     "FetchShardResp": [lambda: M.FetchShardResp(1, -1, M.EPOCH_DEAD, b"")],
+    "FetchMergedResp": [
+        lambda: M.FetchMergedResp(1, M.STATUS_UNKNOWN_SHUFFLE,
+                                  M.EPOCH_DEAD, b"")],
 }
 
 

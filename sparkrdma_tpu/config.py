@@ -333,6 +333,41 @@ _KEYS = [
              "the even share) so locality can't recreate the straggler "
              "it exists to remove. Off = tasks carry no placement "
              "preference (round-robin execution)."),
+    # --- push-merge shuffle dataplane (TPU-only: shuffle/push_merge.py,
+    # docs/CONFIG.md "Push-merge")
+    _Key("push_merge", False, "bool",
+         doc="Magnet-style background push-merge: committed map outputs "
+             "are pushed (fence attached) to merge_replicas peer "
+             "executors chosen by partition-range, each appending into a "
+             "per-(shuffle, partition) merged segment with a per-block "
+             "CRC+fence ledger. Segments finalize at map-stage "
+             "completion (driver broadcast) and publish into the "
+             "driver's merged directory; reducers resolve "
+             "merged-segment-first — ONE sequential vectored read per "
+             "partition instead of an M-way per-map fan-in — falling "
+             "back per-map for unmerged stragglers or CRC-bad segments, "
+             "and recovery re-points to a replica instead of "
+             "re-executing maps a live replica covers. Off by default: "
+             "pushes cost one extra copy of the shuffle's bytes on the "
+             "wire and K copies on peer disks."),
+    _Key("merge_replicas", 1, "int", 0, 16,
+         doc="Merge replicas per reduce partition (the K of push-merge): "
+             "each committed map's per-partition blocks are pushed to "
+             "this many peer executors chosen by partition-range "
+             "(pushers never target themselves, so a replica always "
+             "survives its producer). 0 disables pushing even with "
+             "push_merge on. K>=2 lets an executor loss re-point to a "
+             "surviving replica with ZERO map re-executions."),
+    _Key("push_deadline_ms", 10000, "int", 1, 3600_000,
+         doc="Push staleness bound: a queued push older than this is "
+             "dropped (the straggler map stays per-map-fetched, never "
+             "blocks the stage); also bounds how long a merge target's "
+             "finalize waits for the push channel to quiesce."),
+    _Key("merge_segment_max_bytes", "256m", "bytes", 1 << 16, 1 << 44,
+         doc="Cap on one per-(shuffle, partition) merged segment file: "
+             "pushed blocks that would grow a segment past this are "
+             "rejected (their maps stay per-map-fetched for that "
+             "partition), bounding merge-target disk per partition."),
     # --- device exchange dataplane (TPU-only: parallel/device_plane.py,
     # docs/CONFIG.md "Device exchange")
     _Key("device_plane", "auto", "str",

@@ -1198,6 +1198,27 @@ class DAGEngine:
         lost = [m for m, slot in owners.items() if slot == dead or slot < 0]
         if not lost and failure.map_id >= 0:
             lost = [failure.map_id]
+        # push-merge re-point: maps fully covered by merged replicas on
+        # surviving executors skip the recompute — reducers resolve them
+        # merged-segment-first after the epoch bump re-syncs their caches
+        drv = self.driver.native.driver
+        # same guard as recovery.recover_lost_maps: a plan with
+        # map-range-split tasks cannot consume merged segments, so a
+        # re-point would strand those readers on the dead owner
+        split_active = False
+        if hasattr(drv, "reduce_plan"):
+            plan = drv.reduce_plan(failure.shuffle_id)
+            # stage.num_tasks IS the map count (registerShuffle uses it)
+            split_active = plan is not None and any(
+                t.is_split(stage.num_tasks) for t in plan.tasks)
+        if lost and not split_active and hasattr(drv, "merged_covering"):
+            covered = drv.merged_covering(failure.shuffle_id, lost,
+                                          exclude_slot=dead)
+            if covered:
+                log.warning("recovering shuffle %d: re-pointing maps %s "
+                            "to merged replicas (no re-execution)",
+                            failure.shuffle_id, sorted(covered))
+                lost = [m for m in lost if m not in covered]
         live = [m for m in self._live()
                 if self._slot_of(m) not in (dead, -1)]
         if not live:

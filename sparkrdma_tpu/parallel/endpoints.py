@@ -154,6 +154,14 @@ class DriverEndpoint:
         self._plans: Dict[int, object] = {}
         self._num_partitions: Dict[int, int] = {}
         self.plan_replans = 0  # audit: mid-stage re-plans pushed
+        # push-merge (shuffle/push_merge.py): the driver's merged-segment
+        # directory per shuffle — fed one-sided by merge targets'
+        # MergedPublishMsg, served to reducers (FetchMergedReq), pruned
+        # on repair publishes (drop_map) and tombstones (drop_slot).
+        # Guarded by _tables_lock like every other per-shuffle table.
+        self._merged: Dict[int, object] = {}
+        self._finalize_sent: set = set()
+        self.merged_publishes = 0  # audit: directory entries applied
         self._clients = ConnectionCache(self.conf)
         # One broadcaster thread + a coalescing slot instead of a thread per
         # membership event: N executors joining produce O(N) sends of the
@@ -238,6 +246,8 @@ class DriverEndpoint:
             self._size_hists.pop(shuffle_id, None)
             self._plans.pop(shuffle_id, None)
             self._num_partitions.pop(shuffle_id, None)
+            self._merged.pop(shuffle_id, None)
+            self._finalize_sent.discard(shuffle_id)
         # unblock long-pollers: the shuffle is gone, answer "unknown"
         with self._waiters_lock:
             waiters = self._waiters.pop(shuffle_id, [])
@@ -375,6 +385,104 @@ class DriverEndpoint:
             msg.req_id,
             M.STATUS_ERROR if known else M.STATUS_UNKNOWN_SHUFFLE, b"")
 
+    # -- push-merge directory (shuffle/push_merge.py) --------------------
+
+    def _on_merged_publish(self, msg: "M.MergedPublishMsg") -> None:
+        """Apply one finalized merged segment into the directory —
+        one-sided like a location publish; problems log driver-side."""
+        from sparkrdma_tpu.shuffle.push_merge import (MergedDirectory,
+                                                      MergedEntry)
+        with self._tables_lock:
+            table = self._tables.get(msg.shuffle_id)
+            if table is None:
+                log.warning("driver: merged publish for unknown shuffle "
+                            "%d", msg.shuffle_id)
+                return
+            parts = self._num_partitions.get(msg.shuffle_id, 0)
+            if parts and not 0 <= msg.partition_id < parts:
+                log.warning("driver: merged publish with bad partition "
+                            "%d for shuffle %d", msg.partition_id,
+                            msg.shuffle_id)
+                return
+            directory = self._merged.get(msg.shuffle_id)
+            if directory is None:
+                directory = MergedDirectory()
+                self._merged[msg.shuffle_id] = directory
+            directory.apply(MergedEntry(
+                msg.partition_id, msg.exec_index, msg.token, msg.nbytes,
+                msg.crc32, msg.covered, msg.ranges))
+            self.merged_publishes += 1
+
+    def _on_fetch_merged(self, msg: "M.FetchMergedReq") -> RpcMsg:
+        with self._tables_lock:
+            known = msg.shuffle_id in self._tables
+            epoch = self._epochs.get(msg.shuffle_id, 0)
+            directory = self._merged.get(msg.shuffle_id)
+            data = directory.to_bytes() if directory is not None else b""
+        if not known:
+            return M.FetchMergedResp(msg.req_id, M.STATUS_UNKNOWN_SHUFFLE,
+                                     M.EPOCH_DEAD, b"")
+        return M.FetchMergedResp(msg.req_id, M.STATUS_OK, epoch, data)
+
+    def merged_directory(self, shuffle_id: int):
+        """Snapshot of the shuffle's merged directory (tests/benches
+        poll this for coverage; None = nothing published yet)."""
+        from sparkrdma_tpu.shuffle.push_merge import MergedDirectory
+        with self._tables_lock:
+            directory = self._merged.get(shuffle_id)
+            return (MergedDirectory.from_bytes(directory.to_bytes())
+                    if directory is not None else None)
+
+    def merged_covering(self, shuffle_id: int, maps, exclude_slot: int = -1
+                        ) -> set:
+        """Which of ``maps`` have EVERY reduce partition covered by the
+        merged entry a retrying reducer will actually SELECT — the
+        re-point set of recovery: these maps need no re-execution.
+
+        This mirrors the fetcher's resolution exactly (one entry per
+        partition: widest live coverage, slot tie-break — a segment's
+        bytes cannot be sliced per map, so a reducer consumes at most
+        ONE entry per partition and coverage must be judged against
+        that entry, not the union over replicas; a union answer could
+        re-point a map the chosen entry doesn't carry and strand the
+        retry on the dead owner)."""
+        from sparkrdma_tpu.shuffle.push_merge import MergedDirectory
+        with self._tables_lock:
+            live_dir = self._merged.get(shuffle_id)
+            parts = self._num_partitions.get(shuffle_id, 0)
+            # snapshot under the lock: late finalize publishes and
+            # tombstone pruning mutate the live directory concurrently
+            directory = (MergedDirectory.from_bytes(live_dir.to_bytes())
+                         if live_dir is not None else None)
+        if directory is None or parts <= 0:
+            return set()
+        with self._members_lock:
+            members = list(self._members)
+
+        def live(slot: int) -> bool:
+            return (slot != exclude_slot and slot < len(members)
+                    and members[slot] != TOMBSTONE)
+
+        chosen = []
+        for p in range(parts):
+            entries = [e for e in directory.entries(p) if live(e.slot)]
+            chosen.append(entries[0] if entries else None)
+        covered = set()
+        for m in maps:
+            if all(e is not None and e.covers(m) for e in chosen):
+                covered.add(m)
+        return covered
+
+    def finalize_merge(self, shuffle_id: int) -> None:
+        """Broadcast the finalize trigger for one shuffle's merge
+        targets (also queued automatically when the last map publishes;
+        targets finalize idempotently)."""
+        with self._tables_lock:
+            if shuffle_id in self._finalize_sent:
+                return
+            self._finalize_sent.add(shuffle_id)
+        self._queue_push(None, M.FinalizeSegmentsReq(0, shuffle_id))
+
     def map_entry(self, shuffle_id: int, map_id: int):
         """Current (token, exec_index) for one map, or None (unpublished
         OR unknown shuffle — use :meth:`has_shuffle` to tell apart). Lets
@@ -432,6 +540,11 @@ class DriverEndpoint:
                     if any((e := table.entry(m)) is not None
                            and e[1] == dead_slot
                            for m in range(table.num_maps))]
+            # merged segments hosted BY the dead slot are gone with it;
+            # entries on survivors stay — they are exactly what recovery
+            # re-points to instead of re-executing
+            for directory in self._merged.values():
+                directory.drop_slot(dead_slot)
         for sid in sids:
             self.bump_epoch(sid, reason="executor lost")
 
@@ -447,6 +560,11 @@ class DriverEndpoint:
             return self._on_fetch_table(conn, msg)
         if isinstance(msg, M.FetchPlanReq):
             return self._on_fetch_plan(msg)
+        if isinstance(msg, M.MergedPublishMsg):
+            self._on_merged_publish(msg)
+            return None
+        if isinstance(msg, M.FetchMergedReq):
+            return self._on_fetch_merged(msg)
         if isinstance(msg, M.GetBroadcastReq):
             with self._broadcasts_lock:
                 blob = self._broadcasts.get(msg.bcast_id)
@@ -632,8 +750,23 @@ class DriverEndpoint:
         # move no state reducers could have cached against.
         epoch = self.epoch_of(msg.shuffle_id) or 1
         if old is not None and old != (token, exec_index):
+            # merged segments holding the REPLACED attempt's bytes are
+            # conservative casualties: a corrupt-output repair may have
+            # rewritten content, so the directory drops every entry
+            # covering this map BEFORE the bump pushes the invalidation
+            with self._tables_lock:
+                directory = self._merged.get(msg.shuffle_id)
+                if directory is not None and directory.drop_map(msg.map_id):
+                    log.info("driver: merged entries covering shuffle %d "
+                             "map %d dropped (repair publish)",
+                             msg.shuffle_id, msg.map_id)
             epoch = self.bump_epoch(msg.shuffle_id,
                                     reason="repair publish") or epoch
+        # push-merge: the LAST publish completes the map stage — tell
+        # merge targets to quiesce, seal, and publish their segments
+        if (self.conf.push_merge
+                and table.num_published == table.num_maps):
+            self.finalize_merge(msg.shuffle_id)
         # sharded driver state: the fence CAS above is the driver's
         # authority — only surviving publishes are forwarded into the
         # owning shard host's replica (one directed positional write,
@@ -876,6 +1009,10 @@ class ExecutorEndpoint:
         # see sparkrdma_tpu/tasks.py)
         self._task_runner = None
         self._task_pool = None
+        # push-merge (shuffle/push_merge.py): the manager installs a
+        # MergeStore here when push_merge is on; pushes/finalizes run on
+        # the serve pool (disk appends must never block a reader thread)
+        self.merge_store = None
         # receiver-driven serving flow control: per-connection byte
         # windows + a serving pool so data responses build/park OFF the
         # reader thread (a parked reader could never receive the very
@@ -1212,6 +1349,20 @@ class ExecutorEndpoint:
                 return self._on_fetch_blocks(msg)
             self._serve_blocks_async(conn, msg)
             return None
+        if isinstance(msg, M.PushBlocksReq):
+            self._serve_async(self._on_push_blocks, conn, msg)
+            return None
+        if isinstance(msg, M.FinalizeSegmentsReq):
+            # NOT the serve pool: the quiesce wait can hold a worker for
+            # up to push_deadline_ms, and the pool is shared with
+            # foreground block serving — finalize is once per (shuffle,
+            # target), a dedicated short-lived thread is cheap
+            threading.Thread(
+                target=self._on_finalize_segments, args=(conn, msg),
+                daemon=True,
+                name=f"finalize-{self.manager_id.executor_id.executor}"
+            ).start()
+            return None
         if isinstance(msg, M.CreditReport):
             self._credits_of(conn).release(msg.consumed)
             return None
@@ -1226,7 +1377,8 @@ class ExecutorEndpoint:
             return None  # pong landed after its ping's deadline: stale
         if isinstance(msg, (M.FetchOutputResp, M.FetchOutputsResp,
                             M.FetchTableResp, M.FetchShardResp,
-                            M.FetchPlanResp)):
+                            M.FetchPlanResp, M.PushBlocksResp,
+                            M.FinalizeSegmentsResp, M.FetchMergedResp)):
             # orphan of a cancelled/timed-out request (the fetcher
             # cancels whole read-ahead windows on failure); unlike block
             # responses these carry no credits, so dropping is complete
@@ -1286,6 +1438,9 @@ class ExecutorEndpoint:
         if msg.epoch == M.EPOCH_DEAD:
             self.shard_store.drop(msg.shuffle_id)
             self._expire_shard_waiters(msg.shuffle_id)
+            if self.merge_store is not None:
+                # merged segments + overflow blobs die with the shuffle
+                self.merge_store.drop_shuffle(msg.shuffle_id)
         from sparkrdma_tpu.shuffle import dist_cache
         dist_cache.on_epoch(msg.shuffle_id, msg.epoch)
         if invalidated:
@@ -1541,8 +1696,7 @@ class ExecutorEndpoint:
             "credit_timeouts": self._credit_timeouts,
         }
 
-    def _serve_blocks_async(self, conn: Connection,
-                            msg: M.FetchBlocksReq) -> None:
+    def _ensure_serve_pool(self):
         if self._serve_pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -1552,7 +1706,24 @@ class ExecutorEndpoint:
                         max_workers=self.conf.serve_threads,
                         thread_name_prefix=(
                             f"serve-{self.manager_id.executor_id.executor}"))
-        self._serve_pool.submit(self._serve_blocks, conn, msg)
+        return self._serve_pool
+
+    def _serve_async(self, handler, conn: Connection, msg: RpcMsg) -> None:
+        """Run one disk-touching handler on the serve pool (push-merge
+        appends/finalizes share the block-serving workers — a reader
+        thread must never block on disk)."""
+
+        def work():
+            try:
+                handler(conn, msg)
+            except Exception:  # noqa: BLE001 — serving thread must not die
+                log.exception("%s handler failed", type(msg).__name__)
+
+        self._ensure_serve_pool().submit(work)
+
+    def _serve_blocks_async(self, conn: Connection,
+                            msg: M.FetchBlocksReq) -> None:
+        self._ensure_serve_pool().submit(self._serve_blocks, conn, msg)
 
     def _serve_blocks(self, conn: Connection, msg: M.FetchBlocksReq) -> None:
         """One data response under the connection's credit window: reserve
@@ -1694,6 +1865,123 @@ class ExecutorEndpoint:
             payload = self._codec.wrap(payload, self._codec_key,
                                        _codec_aad(msg, flags))
         return M.FetchBlocksResp(msg.req_id, M.STATUS_OK, payload, flags)
+
+    # -- push-merge serving + client calls (shuffle/push_merge.py) -------
+
+    def _on_push_blocks(self, conn: Connection,
+                        msg: "M.PushBlocksReq") -> None:
+        store = self.merge_store
+        if store is None:
+            resp = M.PushBlocksResp(msg.req_id, M.STATUS_ERROR, 0, b"")
+        elif msg.kind == M.PUSH_KIND_OVERFLOW:
+            status, token = store.push_overflow(
+                msg.shuffle_id, msg.map_id, msg.fence, msg.data)
+            resp = M.PushBlocksResp(msg.req_id, status, token, b"")
+        else:
+            status, accepted = store.push(
+                msg.shuffle_id, msg.map_id, msg.fence,
+                msg.start_partition, msg.sizes, msg.data)
+            resp = M.PushBlocksResp(msg.req_id, status, 0, accepted)
+        try:
+            conn.send(resp)
+        except TransportError as e:
+            log.debug("push response lost: %s", e)
+
+    def _on_finalize_segments(self, conn: Connection,
+                              msg: "M.FinalizeSegmentsReq") -> None:
+        """Seal one shuffle's segments. The broadcast form (req_id=0) is
+        one-sided; an explicit request gets the finalized count back.
+        A short idle-grace wait lets in-flight pushes land first — the
+        finalize broadcast races the LAST map's pushes by construction
+        (pushes are queued at commit, the broadcast at its publish)."""
+        store = self.merge_store
+        if store is None:
+            if msg.req_id:
+                try:
+                    conn.send(M.FinalizeSegmentsResp(msg.req_id,
+                                                     M.STATUS_ERROR, 0))
+                except TransportError:
+                    pass
+            return
+        grace = min(0.25, self.conf.push_deadline_ms / 1000)
+        deadline = time.monotonic() + self.conf.push_deadline_ms / 1000
+        # a target whose FIRST push is still in flight has no state yet
+        # (idle_for = inf): give it the same grace before sealing, or
+        # the broadcast racing the pusher's queue would tombstone the
+        # shuffle with zero segments
+        first_wait = time.monotonic() + grace
+        while (store.idle_for(msg.shuffle_id) == float("inf")
+               and time.monotonic() < first_wait):
+            time.sleep(0.02)
+        while (store.idle_for(msg.shuffle_id) < grace
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        try:
+            count = store.finalize(
+                msg.shuffle_id,
+                self.exec_index(
+                    timeout=self.conf.connect_timeout_ms / 1000),
+                publish=lambda m: self.driver_conn().send(m),
+                tracer=self.tracer)
+        except Exception:  # noqa: BLE001 — dedicated thread, must not
+            # die silently; the shuffle just stays unfinalized here
+            log.exception("merge finalize of shuffle %d failed",
+                          msg.shuffle_id)
+            count = 0
+        if msg.req_id:
+            try:
+                conn.send(M.FinalizeSegmentsResp(msg.req_id, M.STATUS_OK,
+                                                 count))
+            except TransportError:
+                pass
+
+    def push_blocks(self, peer: ShuffleManagerId, shuffle_id: int,
+                    map_id: int, fence: int, kind: int,
+                    start_partition: int, sizes, data: bytes
+                    ) -> "M.PushBlocksResp":
+        """Client half of the push protocol (SegmentPusher/MergeClient)."""
+        conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        resp = conn.request(
+            M.PushBlocksReq(conn.next_req_id(), shuffle_id, map_id, fence,
+                            kind, start_partition, list(sizes), data),
+            timeout=self.conf.resolved_request_deadline_s())
+        assert isinstance(resp, M.PushBlocksResp)
+        return resp
+
+    def get_merged_directory(self, shuffle_id: int, metrics=None):
+        """The shuffle's merged-segment directory, cache-first: the
+        location plane's epoch-validated copy when current, else ONE
+        pull from the driver (cached under the response epoch when
+        non-empty — an empty directory re-pulls next stage, since
+        finalize may land any moment). Returns a
+        :class:`~sparkrdma_tpu.shuffle.push_merge.MergedDirectory` or
+        None (driver unreachable / shuffle unknown / feature off)."""
+        if not self.conf.push_merge:
+            return None
+        cached = self.location_plane.merged(shuffle_id)
+        if cached is not None:
+            return cached
+        from sparkrdma_tpu.shuffle.push_merge import MergedDirectory
+        try:
+            conn = self.driver_conn()
+            if metrics is not None:
+                metrics.record_metadata_rpc()
+                metrics.record_request()
+            resp = conn.request(
+                M.FetchMergedReq(conn.next_req_id(), shuffle_id),
+                timeout=self.conf.resolved_request_deadline_s())
+        except (TransportError, TimeoutError) as e:
+            log.debug("merged-directory fetch for shuffle %d failed: %s",
+                      shuffle_id, e)
+            return None
+        assert isinstance(resp, M.FetchMergedResp)
+        if resp.status != M.STATUS_OK:
+            return None
+        directory = MergedDirectory.from_bytes(resp.data)
+        if len(directory):
+            self.location_plane.put_merged(shuffle_id, directory,
+                                           resp.epoch)
+        return directory
 
     # -- client-side fetch calls (used by the fetcher iterator) ----------
 

@@ -716,6 +716,228 @@ class FetchPlanResp(RpcMsg):
         return cls(req_id, status, payload[_QI.size:])
 
 
+# -- push-merge dataplane (shuffle/push_merge.py) -------------------------
+#
+# Magnet-style background merge: committed map outputs are PUSHED to K
+# peer executors chosen by partition-range, each appending into a
+# per-(shuffle, partition) segment file with a per-block CRC+fence
+# ledger; finalized segments publish one-sided into the driver's merged
+# directory and are served by the EXISTING block server (one vectored
+# read per partition, no extra server CPU in the read path — the
+# one-sided discipline of "RPC Considered Harmful"), with pushes riding
+# the same line-rate framing as every other data frame (Tiara,
+# PAPERS.md). Reducers resolve merged-segment-first and fall back
+# per-map; recovery re-points to a replica instead of re-executing.
+
+PUSH_KIND_MERGE = 0     # per-partition blocks into merged segments
+PUSH_KIND_OVERFLOW = 1  # tiered-spill overflow blob (fetched back at merge)
+
+
+@register()
+class PushBlocksReq(RpcMsg):
+    """Executor -> merge target: one committed map's per-partition blocks
+    for a contiguous partition range (``kind=PUSH_KIND_MERGE``), or one
+    opaque spill-overflow blob (``kind=PUSH_KIND_OVERFLOW`` — tiered
+    spill overflowing to a peer on ENOSPC; ``sizes`` then carries the
+    blob's per-partition layout so the writer can fetch ranges back).
+    ``fence`` is the committing attempt's fencing token: the target's
+    ledger rejects a push whose fence is older than one already applied
+    for the same map, and a newer fence supersedes the stale blocks
+    (excluded from the finalized ranges). ``data`` is the concatenation
+    of the ``sizes`` segments in partition order."""
+
+    def __init__(self, req_id: int, shuffle_id: int, map_id: int,
+                 fence: int, kind: int, start_partition: int,
+                 sizes: List[int], data: bytes):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.fence = fence
+        self.kind = kind
+        self.start_partition = start_partition
+        self.sizes = list(sizes)
+        self.data = data
+
+    def payload(self) -> bytes:
+        head = (struct.pack("<qiiq", self.req_id, self.shuffle_id,
+                            self.map_id, self.fence)
+                + struct.pack("<iiI", self.kind, self.start_partition,
+                              len(self.sizes))
+                + struct.pack(f"<{len(self.sizes)}I", *self.sizes))
+        return head + self.data
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "PushBlocksReq":
+        req_id, shuffle_id, map_id, fence = struct.unpack_from("<qiiq",
+                                                               payload, 0)
+        kind, start, n = struct.unpack_from("<iiI", payload, 24)
+        sizes = list(struct.unpack_from(f"<{n}I", payload, 36))
+        return cls(req_id, shuffle_id, map_id, fence, kind, start, sizes,
+                   payload[36 + 4 * n:])
+
+
+@register()
+class PushBlocksResp(RpcMsg):
+    """Merge target's verdict: ``accepted`` is one byte per pushed
+    partition (1 = appended into the segment ledger, 0 = rejected —
+    stale fence, finalized shuffle, or a segment at
+    ``merge_segment_max_bytes``). For overflow pushes ``token`` names
+    the stored blob in the target's serving token space so the writer
+    fetches it back over the ordinary data plane."""
+
+    def __init__(self, req_id: int, status: int, token: int,
+                 accepted: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.token = token
+        self.accepted = accepted
+
+    def payload(self) -> bytes:
+        return (struct.pack("<qiq", self.req_id, self.status, self.token)
+                + self.accepted)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "PushBlocksResp":
+        req_id, status, token = struct.unpack_from("<qiq", payload, 0)
+        return cls(req_id, status, token, payload[20:])
+
+
+@register()
+class FinalizeSegmentsReq(RpcMsg):
+    """Driver -> executors (broadcast on the announce channel at
+    map-stage completion, ``req_id=0`` — one-sided, no reply) or an
+    explicit request (``req_id>0``): stop accepting pushes for the
+    shuffle once the push channel quiesces, seal every per-partition
+    segment, and publish the results into the driver's merged
+    directory."""
+
+    def __init__(self, req_id: int, shuffle_id: int):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.shuffle_id)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FinalizeSegmentsReq":
+        req_id, shuffle_id = _QI.unpack_from(payload, 0)
+        return cls(req_id, shuffle_id)
+
+
+@register()
+class FinalizeSegmentsResp(RpcMsg):
+    """``finalized`` counts the segments this target sealed+published."""
+
+    def __init__(self, req_id: int, status: int, finalized: int):
+        self.req_id = req_id
+        self.status = status
+        self.finalized = finalized
+
+    def payload(self) -> bytes:
+        return struct.pack("<qii", self.req_id, self.status,
+                           self.finalized)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FinalizeSegmentsResp":
+        req_id, status, finalized = struct.unpack_from("<qii", payload, 0)
+        return cls(req_id, status, finalized)
+
+
+@register()
+class MergedPublishMsg(RpcMsg):
+    """Merge target -> driver: one finalized merged segment, one-sided
+    like ``PublishMsg`` (no ack — the driver's directory is repaired by
+    later finalize rounds, and a lost publish only costs coverage).
+    ``covered`` is a bitmap over the shuffle's map space (bit m set =
+    the segment holds map m's bytes for this partition, under the
+    newest fence the ledger saw); ``ranges`` the byte ranges of the
+    segment file that survived fence supersession (usually one
+    ``[0, nbytes)`` range); ``crc32`` the CRC32 of those ranges
+    concatenated, verified REDUCER-side after the fetch so at-rest rot
+    on the replica degrades to per-map fetch, never to wrong bytes."""
+
+    def __init__(self, shuffle_id: int, partition_id: int,
+                 exec_index: int, token: int, nbytes: int, crc32: int,
+                 covered: bytes, ranges: List[Tuple[int, int]]):
+        self.shuffle_id = shuffle_id
+        self.partition_id = partition_id
+        self.exec_index = exec_index
+        self.token = token
+        self.nbytes = nbytes
+        self.crc32 = crc32
+        self.covered = covered
+        self.ranges = [(int(o), int(ln)) for o, ln in ranges]
+
+    def payload(self) -> bytes:
+        head = (struct.pack("<iii", self.shuffle_id, self.partition_id,
+                            self.exec_index)
+                + struct.pack("<qqI", self.token, self.nbytes, self.crc32)
+                + struct.pack("<II", len(self.covered), len(self.ranges)))
+        body = self.covered + b"".join(
+            struct.pack("<QI", o, ln) for o, ln in self.ranges)
+        return head + body
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "MergedPublishMsg":
+        shuffle_id, partition_id, exec_index = struct.unpack_from(
+            "<iii", payload, 0)
+        token, nbytes, crc = struct.unpack_from("<qqI", payload, 12)
+        ncov, nranges = struct.unpack_from("<II", payload, 32)
+        off = 40
+        covered = payload[off:off + ncov]
+        off += ncov
+        ranges = []
+        for _ in range(nranges):
+            o, ln = struct.unpack_from("<QI", payload, off)
+            ranges.append((o, ln))
+            off += 12
+        return cls(shuffle_id, partition_id, exec_index, token, nbytes,
+                   crc, covered, ranges)
+
+
+@register()
+class FetchMergedReq(RpcMsg):
+    """Reducer -> driver: pull one shuffle's merged-segment directory
+    (cache-first in the location plane under the location epoch; this
+    is the cold path / lost-coverage backstop)."""
+
+    def __init__(self, req_id: int, shuffle_id: int):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.shuffle_id)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchMergedReq":
+        req_id, shuffle_id = _QI.unpack_from(payload, 0)
+        return cls(req_id, shuffle_id)
+
+
+@register()
+class FetchMergedResp(RpcMsg):
+    """``data`` is ``MergedDirectory.to_bytes()`` (possibly empty —
+    nothing finalized yet); ``epoch`` stamps it with the shuffle's
+    location-state version so the plane's cache validity rule applies
+    unchanged. ``STATUS_UNKNOWN_SHUFFLE`` when unregistered."""
+
+    def __init__(self, req_id: int, status: int, epoch: int, data: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.epoch = epoch
+        self.data = data
+
+    def payload(self) -> bytes:
+        return (_QI.pack(self.req_id, self.status) + _Q.pack(self.epoch)
+                + self.data)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchMergedResp":
+        req_id, status = _QI.unpack_from(payload, 0)
+        (epoch,) = _Q.unpack_from(payload, _QI.size)
+        return cls(req_id, status, epoch, payload[_QI.size + _Q.size:])
+
+
 # Status codes shared by responses.
 STATUS_OK = 0
 STATUS_UNKNOWN_SHUFFLE = 1
@@ -726,6 +948,10 @@ STATUS_ERROR = 4
 # the wire (the retry envelope escalates it to FetchFailed with a
 # corrupt_output verdict, and recovery re-executes the producing map)
 STATUS_CORRUPT = 5
+# push-merge: the shuffle's segments are sealed on this target — the
+# pusher stops pushing it (authoritative, not retryable; the map simply
+# stays per-map-fetched)
+STATUS_FINALIZED = 6
 
 # RunTaskResp statuses.
 TASK_OK = 0

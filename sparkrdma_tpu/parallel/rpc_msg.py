@@ -61,6 +61,13 @@ WIRE_IDS: Dict[str, int] = {
     "ReducePlanMsg": 25,
     "FetchPlanReq": 26,
     "FetchPlanResp": 27,
+    "PushBlocksReq": 28,
+    "PushBlocksResp": 29,
+    "FinalizeSegmentsReq": 30,
+    "FinalizeSegmentsResp": 31,
+    "MergedPublishMsg": 32,
+    "FetchMergedReq": 33,
+    "FetchMergedResp": 34,
 }
 
 # Ids deliberately absent from the dense 1..max range, with the reason

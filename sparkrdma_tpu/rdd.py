@@ -826,11 +826,19 @@ def _chain(node, memo: dict, ctx: "EngineContext"):
         # partition of a parent shuffle, so fan-in compiles to one
         # identity-routed exchange instead. Memoized on the node: a
         # coalesced RDD consumed twice in one job must compile ONE
-        # exchange stage (the _shuffle_stage memo keys on node identity)
+        # exchange stage (the _shuffle_stage memo keys on node identity).
+        # Routing is the EXACT inverse of the narrow path's
+        # [i*P//n, (i+1)*P//n) ranges — bisect over those boundaries —
+        # so the two paths agree on which output partition holds which
+        # parent even when P % n != 0 (t*n//P drifts there: P=5, n=2
+        # sends parent 2 to output 0, the narrow ranges put it in 1)
         sh = getattr(node, "_shuffled", None)
         if sh is None:
+            import bisect
+            bounds = tuple(i * P // n for i in range(1, n))
             sh = _Shuffled(node.parent, n,
-                           route_task=(lambda t, _P=P, _n=n: t * _n // _P))
+                           route_task=(lambda t, _b=bounds:
+                                       bisect.bisect_right(_b, t)))
             node._shuffled = sh
         return _chain(sh, memo, ctx)
 

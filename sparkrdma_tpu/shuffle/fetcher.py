@@ -157,6 +157,13 @@ class ReadMetrics:
     # warm read-range hits (warm_read_cache): whole partition ranges
     # served from dist_cache without starting a fetch at all
     warm_range_hits: int = 0
+    # push-merge dataplane: partitions served by ONE merged-segment read
+    # instead of the M-way per-map fan-in, the bytes they carried, and
+    # partitions that DEGRADED back to per-map (replica unreachable or
+    # its segment failed the entry CRC)
+    merged_reads: int = 0
+    merged_bytes: int = 0
+    merged_fallbacks: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_remote(self, nbytes: int, latency_s: float) -> None:
@@ -181,6 +188,15 @@ class ReadMetrics:
         with self._lock:
             self.local_bytes += nbytes
             self.local_fetches += 1
+
+    def record_merged(self, nbytes: int) -> None:
+        with self._lock:
+            self.merged_reads += 1
+            self.merged_bytes += nbytes
+
+    def record_merged_fallback(self) -> None:
+        with self._lock:
+            self.merged_fallbacks += 1
 
     def record_retry(self) -> None:
         with self._lock:
@@ -276,6 +292,12 @@ class ShuffleFetcher:
         self.epoch = 0
         self._started = False
         self._reducer_bytes_recorded = False
+        # push-merge: partitions satisfied by merged-segment reads, per
+        # map — the per-map paths (grouping, local short-circuit) skip
+        # them so every (map, partition) is served EXACTLY once; the
+        # driver table is kept for the merged threads' per-map fallback
+        self._skip: Dict[int, set] = {}
+        self._table = None
 
     # -- setup: plan + launch (initialize/startAsyncRemoteFetches) -------
 
@@ -286,9 +308,17 @@ class ShuffleFetcher:
             table, self.epoch = self.endpoint.get_driver_table_v(
                 self.shuffle_id, self.num_maps, metrics=self.metrics)
         my_index = self._my_index()
+        self._table = table
+        # push-merge: resolve merged-segment coverage FIRST — partitions
+        # a live replica covers become one sequential vectored read each,
+        # and the per-map machinery below only plans what is left
+        merged_by_slot = self._resolve_merged(my_index)
+        all_parts = set(range(self.start_partition, self.end_partition))
         local_maps: List[int] = []
         by_peer: Dict[int, List[int]] = {}
         for m in range(self.map_start, self.map_end):
+            if self._skip.get(m, set()) >= all_parts:
+                continue  # every partition rides a merged segment
             entry = table.entry(m)
             if entry is None:
                 raise FetchFailedError(self.shuffle_id, m, -1,
@@ -299,51 +329,24 @@ class ShuffleFetcher:
             else:
                 by_peer.setdefault(exec_idx, []).append(m)
 
-        # Local short-circuit (:327-337): serve directly, count separately.
-        from sparkrdma_tpu.utils.integrity import CorruptOutputError
+        # Local short-circuit (:327-337): serve directly, count
+        # separately — per uncovered contiguous run when merged segments
+        # satisfy part of the range.
         for m in local_maps:
-            attempts = 1 + max(0, self.conf.fetch_retry_budget)
-            for attempt in range(attempts):
-                try:
-                    data = self.resolver.local_blocks(
-                        self.shuffle_id, m, self.start_partition,
-                        self.end_partition)
-                    break
-                except CorruptOutputError as e:
-                    # our OWN committed output rotted: same demotion as
-                    # the remote case — re-execute the map (a reread
-                    # cannot heal persistent rot), don't fail the job
-                    raise FetchFailedError(
-                        self.shuffle_id, m, my_index,
-                        f"local map output corrupt at rest: {e}",
-                        verdict="corrupt_output") from e
-                except OSError as e:
-                    # transient local disk error: same bounded retry the
-                    # remote path gets (a remote serve answers the
-                    # retryable STATUS_ERROR for this) — escalating on
-                    # the first EIO would recompute every local map
-                    # elsewhere over a hiccup
-                    if attempt + 1 >= attempts:
-                        raise FetchFailedError(
-                            self.shuffle_id, m, my_index,
-                            f"local map output unreadable after "
-                            f"{attempts} attempt(s): {e}") from e
-                    self.metrics.record_retry()
-                    # abort-aware like every other retry wait in this
-                    # file: a concurrent teardown must not sit out the
-                    # full backoff schedule
-                    if self._aborted.wait(self._backoff.delay(attempt)):
-                        raise FetchFailedError(
-                            self.shuffle_id, m, my_index,
-                            "fetch aborted during local read retry") from e
-            if data is None:
-                raise FetchFailedError(self.shuffle_id, m, my_index,
-                                       "local map output missing")
-            self.metrics.record_local(len(data))
-            self._expected_results += 1
-            self._results.put(FetchResult(m, self.start_partition,
-                                          self.end_partition, data,
-                                          is_local=True))
+            skip = self._skip.get(m, set())
+            run_lo = None
+            for p in range(self.start_partition, self.end_partition + 1):
+                if p < self.end_partition and p not in skip:
+                    if run_lo is None:
+                        run_lo = p
+                    continue
+                if run_lo is not None:
+                    data = self._local_read(m, run_lo, p, my_index)
+                    self.metrics.record_local(len(data))
+                    self._expected_results += 1
+                    self._results.put(FetchResult(m, run_lo, p, data,
+                                                  is_local=True))
+                    run_lo = None
 
         # A freshly-joined reducer can hold driver-table entries referencing
         # executor slots its membership list hasn't caught up to yet (the
@@ -371,21 +374,302 @@ class ShuffleFetcher:
                                  daemon=True,
                                  name=f"fetch-s{self.shuffle_id}-e{exec_idx}")
             self._threads.append(t)
+        # Merged-segment threads: one per replica slot, sequential wide
+        # reads (already one request per partition — a window buys
+        # nothing over the per-slot thread parallelism).
+        for slot, entries in sorted(merged_by_slot.items()):
+            t = threading.Thread(
+                target=self._fetch_merged_from_slot,
+                args=(slot, entries, my_index, count_lock),
+                daemon=True,
+                name=f"fetch-merged-s{self.shuffle_id}-e{slot}")
+            self._threads.append(t)
         # Expected-result accounting: each peer thread registers its request
         # count before its first enqueue; the sentinel goes in when all
         # threads have finished (tracked by _peer_threads_left).
-        self._peer_threads_left = len(peers)
-        if not peers:
+        self._peer_threads_left = len(peers) + len(merged_by_slot)
+        if self._peer_threads_left == 0:
             self._results.put(FetchResult(is_sentinel=True))
         for t in self._threads:
             t.start()
         return self
+
+    def _local_read(self, m: int, lo: int, hi: int,
+                    my_index: int) -> bytes:
+        """One local short-circuit read under the bounded retry policy
+        (transient EIO retries; at-rest rot escalates with a
+        corrupt_output verdict so ONLY this map re-executes)."""
+        from sparkrdma_tpu.utils.integrity import CorruptOutputError
+        attempts = 1 + max(0, self.conf.fetch_retry_budget)
+        for attempt in range(attempts):
+            try:
+                data = self.resolver.local_blocks(self.shuffle_id, m,
+                                                  lo, hi)
+                break
+            except CorruptOutputError as e:
+                # our OWN committed output rotted: same demotion as the
+                # remote case — re-execute the map (a reread cannot heal
+                # persistent rot), don't fail the job
+                raise FetchFailedError(
+                    self.shuffle_id, m, my_index,
+                    f"local map output corrupt at rest: {e}",
+                    verdict="corrupt_output") from e
+            except OSError as e:
+                # transient local disk error: same bounded retry the
+                # remote path gets (a remote serve answers the retryable
+                # STATUS_ERROR for this) — escalating on the first EIO
+                # would recompute every local map elsewhere over a hiccup
+                if attempt + 1 >= attempts:
+                    raise FetchFailedError(
+                        self.shuffle_id, m, my_index,
+                        f"local map output unreadable after "
+                        f"{attempts} attempt(s): {e}") from e
+                self.metrics.record_retry()
+                # abort-aware like every other retry wait in this file: a
+                # concurrent teardown must not sit out the full backoff
+                if self._aborted.wait(self._backoff.delay(attempt)):
+                    raise FetchFailedError(
+                        self.shuffle_id, m, my_index,
+                        "fetch aborted during local read retry") from e
+        if data is None:
+            raise FetchFailedError(self.shuffle_id, m, my_index,
+                                   "local map output missing")
+        return data
 
     def _my_index(self) -> int:
         try:
             return self.endpoint.exec_index()
         except KeyError:
             return -1
+
+    # -- merged-segment-first resolution (push-merge dataplane) ----------
+
+    def _resolve_merged(self, my_index: int) -> Dict[int, list]:
+        """Pick ONE live merged entry per partition (widest coverage
+        first) and build the per-map skip sets. Returns entries grouped
+        by hosting slot. Empty when push-merge is off, this is a
+        map-range-SPLIT task (a merged segment holds every covered map's
+        rows — it cannot be sliced to a map subset), or nothing has
+        finalized yet."""
+        if not self.conf.push_merge:
+            return {}
+        if (self.map_start, self.map_end) != (0, self.num_maps):
+            return {}
+        directory = self.endpoint.get_merged_directory(
+            self.shuffle_id, metrics=self.metrics)
+        if directory is None:
+            return {}
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        members = self.endpoint.members()
+        by_slot: Dict[int, list] = {}
+        for p in range(self.start_partition, self.end_partition):
+            for entry in directory.entries(p):
+                s = entry.slot
+                if (s != my_index
+                        and (s >= len(members) or members[s] == TOMBSTONE
+                             or self.endpoint.peer_suspect(s))):
+                    continue
+                covered = entry.covered_maps(self.num_maps)
+                if not covered:
+                    continue
+                by_slot.setdefault(s, []).append(entry)
+                for m in covered:
+                    self._skip.setdefault(m, set()).add(p)
+                break
+        return by_slot
+
+    def _fetch_merged_from_slot(self, slot: int, entries: list,
+                                my_index: int,
+                                count_lock: threading.Lock) -> None:
+        """Drain one replica slot's merged segments: ONE sequential
+        vectored read per partition (local when this executor hosts the
+        replica), entry-CRC verified; a failed or CRC-bad segment
+        DEGRADES to the per-map dataplane for exactly that partition."""
+        try:
+            peer = None
+            if slot != my_index:
+                peer = self.endpoint.member_at(slot)
+                self.endpoint.watch_peer(slot, peer)
+            try:
+                for entry in entries:
+                    if self._aborted.is_set():
+                        raise _Aborted()
+                    data = self._merged_segment_data(peer, slot, entry,
+                                                     my_index)
+                    if data is None:
+                        self.metrics.record_merged_fallback()
+                        self.tracer.instant(
+                            "fetch.merged_fallback", "fetch", peer=slot,
+                            partition=entry.partition_id)
+                        self._merged_fallback(entry, my_index, count_lock)
+                        continue
+                    self.metrics.record_merged(len(data))
+                    p = entry.partition_id
+                    if peer is None:
+                        self.metrics.record_local(len(data))
+                        with count_lock:
+                            self._expected_results += 1
+                        self._results.put(FetchResult(-2, p, p + 1, data,
+                                                      is_local=True))
+                    else:
+                        with count_lock:
+                            self._expected_results += 1
+                        self._results.put(FetchResult(-2, p, p + 1, data))
+            finally:
+                if peer is not None:
+                    self.endpoint.unwatch_peer(slot)
+        except _Aborted:
+            pass
+        except Exception as e:  # noqa: BLE001 — same containment contract
+            # as _fetch_from_peer: any thread failure must surface as a
+            # FetchFailedError result, never a silent dead thread
+            failure = (e if isinstance(e, FetchFailedError) else
+                       FetchFailedError(self.shuffle_id, -2, slot,
+                                        f"{type(e).__name__}: {e}"))
+            self._results.put(FetchResult(failure=failure))
+        finally:
+            with count_lock:
+                self._peer_threads_left -= 1
+                last = self._peer_threads_left == 0
+                if last:
+                    self._results.put(FetchResult(is_sentinel=True))
+            if last and self._aborted.is_set():
+                self._drain_unconsumed()
+
+    def _merged_segment_data(self, peer, slot: int, entry,
+                             my_index: int) -> Optional[bytes]:
+        """One merged segment's bytes, or None -> per-map fallback.
+        Remote reads get the bounded transient-retry treatment but never
+        ESCALATE from here — a dead replica degrades, it does not blame
+        the hosting slot's map outputs (it owns none of them); at-rest
+        rot (entry-CRC mismatch) degrades immediately (a refetch re-reads
+        the same rotted file)."""
+        import zlib
+        blocks = [(entry.token, off, ln) for off, ln in entry.ranges]
+
+        def crc_ok(data: bytes) -> bool:
+            if zlib.crc32(data) == entry.crc32:
+                return True
+            self.metrics.record_checksum_failure()
+            log.warning("merged segment for shuffle %d partition %d on "
+                        "slot %d failed its entry CRC; degrading to "
+                        "per-map fetch", self.shuffle_id,
+                        entry.partition_id, slot)
+            return False
+
+        if peer is None:
+            parts = []
+            for token, off, ln in blocks:
+                seg = (self.resolver.read_block(self.shuffle_id, token,
+                                                off, ln)
+                       if self.resolver is not None else None)
+                if seg is None:
+                    return None
+                parts.append(seg)
+            data = b"".join(parts)
+            return data if crc_ok(data) else None
+        attempts = 1 + max(0, self.conf.fetch_retry_budget)
+        total = sum(ln for _, _, ln in blocks)
+        # the in-flight byte gate covers merged reads like every other
+        # remote fetch; the consumer's dequeue releases on success, every
+        # other exit releases here
+        self._acquire_in_flight(total)
+        delivered = False
+        try:
+            data = None
+            for attempt in range(attempts):
+                if self._aborted.is_set():
+                    raise _Aborted()
+                if self.endpoint.peer_suspect(slot):
+                    return None
+                try:
+                    self.metrics.record_request()
+                    t0 = time.monotonic()
+                    with self.tracer.span("fetch.merged", "fetch",
+                                          peer=slot,
+                                          partition=entry.partition_id,
+                                          bytes=total):
+                        data = self.endpoint.fetch_blocks(
+                            peer, self.shuffle_id, blocks)
+                    dt = time.monotonic() - t0
+                    self.metrics.record_remote(len(data), dt)
+                    if self.reader_stats is not None:
+                        self.reader_stats.update(slot, dt,
+                                                 nbytes=len(data))
+                    break
+                except (TransportError, TimeoutError) as e:
+                    self._note_transient(e, "merged", slot,
+                                         -2, attempt + 1 < attempts,
+                                         attempt + 1)
+                    if attempt + 1 >= attempts:
+                        return None
+                    if self._aborted.wait(self._backoff.delay(attempt)):
+                        raise _Aborted()
+            if data is None or not crc_ok(data):
+                return None
+            delivered = True
+            return data
+        finally:
+            if not delivered:
+                self._release_in_flight(total)
+
+    def _merged_fallback(self, entry, my_index: int,
+                         count_lock: threading.Lock) -> None:
+        """Per-map fetch of ONE partition whose merged segment degraded:
+        each covered map's bytes come from its table owner under the
+        ordinary retry envelope, so blame and recovery semantics are
+        exactly the per-map dataplane's (a dead owner escalates into
+        FetchFailed -> recovery, which may re-point to ANOTHER replica)."""
+        p = entry.partition_id
+        for m in entry.covered_maps(self.num_maps):
+            if not self.map_start <= m < self.map_end:
+                continue
+            e = self._table.entry(m)
+            if e is None:
+                raise FetchFailedError(self.shuffle_id, m, -1,
+                                       "map output never published")
+            owner = e[1]
+            if owner == my_index:
+                data = self._local_read(m, p, p + 1, my_index)
+                self.metrics.record_local(len(data))
+                with count_lock:
+                    self._expected_results += 1
+                self._results.put(FetchResult(m, p, p + 1, data,
+                                              is_local=True))
+                continue
+            try:
+                owner_peer = self.endpoint.member_at(owner)
+            except DeadExecutorError as exc:
+                raise FetchFailedError(
+                    self.shuffle_id, m, owner,
+                    f"merged replica degraded and owner tombstoned: "
+                    f"{exc}") from exc
+
+            def read_locs(m=m, owner_peer=owner_peer):
+                self.metrics.record_request()
+                self.metrics.record_metadata_rpc()
+                return self.endpoint.fetch_output_range(
+                    owner_peer, self.shuffle_id, m, p, p + 1)
+
+            locs = self._with_retries("locations", owner, m, read_locs)
+            blocks = [(loc.buf, loc.offset, loc.length) for loc in locs]
+            nbytes = sum(b[2] for b in blocks)
+            self._acquire_in_flight(nbytes)
+
+            def read_blocks(m=m, owner_peer=owner_peer, blocks=blocks):
+                self.metrics.record_request()
+                return self.endpoint.fetch_blocks(
+                    owner_peer, self.shuffle_id, blocks)
+
+            try:
+                data = self._with_retries("blocks", owner, m, read_blocks)
+            except BaseException:
+                self._release_in_flight(nbytes)
+                raise
+            self.metrics.record_remote(len(data), 0.0)
+            with count_lock:
+                self._expected_results += 1
+            self._results.put(FetchResult(m, p, p + 1, data))
 
     # -- per-peer fetch pipeline ----------------------------------------
 
@@ -452,8 +736,19 @@ class ShuffleFetcher:
         group_bytes = 0
         limit = self.conf.shuffle_read_block_size
         max_blocks = self.conf.resolved_max_fetch_blocks()
+        # push-merge: partitions a merged segment already serves are
+        # skipped (groups seal at the hole so ranges stay contiguous).
+        # getattr: unit tests build bare fetchers around this method
+        skip = getattr(self, "_skip", {}).get(m, ())
         for i, loc in enumerate(locs):
             p = self.start_partition + i
+            if p in skip:
+                if group:
+                    pending.append(_PendingFetch(
+                        exec_idx, m, group_start, p, group, group_bytes))
+                    group, group_bytes = [], 0
+                group_start = p + 1
+                continue
             if group and (group_bytes + loc.length > limit
                           or len(group) >= max_blocks):
                 pending.append(_PendingFetch(

@@ -232,6 +232,12 @@ class LocationPlane:
         # repair moves bytes, not the carve-up of reduce work. Newest
         # plan_epoch wins; EPOCH_DEAD drops the plan with the rest.
         self._plans: Dict[int, object] = {}
+        # merged-segment directories (shuffle/push_merge.py): cached
+        # under the LOCATION epoch like tables — a repair/tombstone bump
+        # invalidates, so a re-pointed reducer re-pulls a directory the
+        # driver has already pruned. Only non-empty directories are
+        # cached (endpoint policy), so pre-finalize stages keep pulling.
+        self._merged: Dict[int, Tuple[object, int]] = {}
         self._max_ranges = max_ranges
         # audit counters (surfaced via snapshot(); the warm-path test and
         # the iterative bench read these)
@@ -256,6 +262,7 @@ class LocationPlane:
                 self._epochs.pop(shuffle_id, None)
                 self._shard_maps.pop(shuffle_id, None)
                 self._plans.pop(shuffle_id, None)
+                self._merged.pop(shuffle_id, None)
                 dropped = self._drop_locations_locked(shuffle_id)
                 if had or dropped:
                     self.invalidations += 1
@@ -268,6 +275,10 @@ class LocationPlane:
             cached = self._tables.get(shuffle_id)
             if cached is not None and cached[1] != epoch:
                 del self._tables[shuffle_id]
+                stale = True
+            merged = self._merged.get(shuffle_id)
+            if merged is not None and merged[1] != epoch:
+                del self._merged[shuffle_id]
                 stale = True
             for key in [k for k in self._locations if k[0] == shuffle_id]:
                 if self._locations[key][1] != epoch:
@@ -384,6 +395,40 @@ class LocationPlane:
         with self._lock:
             return self._plans.get(shuffle_id)
 
+    # -- merged-segment directory (push-merge) ----------------------------
+
+    def put_merged(self, shuffle_id: int, directory, epoch: int) -> None:
+        """Cache one shuffle's merged directory under its epoch (same
+        staleness rule as tables: a response predating a pushed
+        invalidation is dropped, never served)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            prev = self._epochs.get(shuffle_id)
+            if prev is not None and epoch < prev:
+                self.stale_drops += 1
+                return
+            self._epochs[shuffle_id] = max(prev or 0, epoch)
+            self._merged[shuffle_id] = (directory, epoch)
+
+    def merged(self, shuffle_id: int):
+        """The cached merged directory iff epoch-current, else None."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            cached = self._merged.get(shuffle_id)
+            if cached is None:
+                self.misses += 1
+                return None
+            known = self._epochs.get(shuffle_id)
+            if known is not None and cached[1] != known:
+                del self._merged[shuffle_id]
+                self.stale_drops += 1
+                self.misses += 1
+                return None
+            self.hits += 1
+            return cached[0]
+
     # -- invalidation -----------------------------------------------------
 
     def _drop_locations_locked(self, shuffle_id: int) -> bool:
@@ -402,6 +447,7 @@ class LocationPlane:
             dropped = (self._tables.pop(shuffle_id, None) is not None)
             dropped |= self._drop_locations_locked(shuffle_id)
             self._shard_maps.pop(shuffle_id, None)
+            self._merged.pop(shuffle_id, None)
             # the plan drops too: invalidate() is also the unregister
             # backstop, and engine shuffle ids are reused — a re-read
             # refetches the plan from the driver for the price of one RPC
@@ -416,6 +462,7 @@ class LocationPlane:
                 "ranges": len(self._locations),
                 "shard_maps": len(self._shard_maps),
                 "plans": len(self._plans),
+                "merged": len(self._merged),
                 "hits": self.hits,
                 "misses": self.misses,
                 "invalidations": self.invalidations,

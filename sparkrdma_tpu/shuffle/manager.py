@@ -126,6 +126,8 @@ class TpuShuffleManager:
         self.driver_addr = driver_addr
 
         self.block_server = None
+        self.pusher = None
+        self.merge_client = None
         if executor_id != "driver":
             from sparkrdma_tpu.runtime.blockserver import maybe_create
             self.block_server = maybe_create(self.conf, host=host)
@@ -137,6 +139,19 @@ class TpuShuffleManager:
                 conf=self.conf,
                 block_port=self.block_server.port if self.block_server else 0,
                 tracer=self.tracer)
+            if self.conf.push_merge:
+                # push-merge dataplane (shuffle/push_merge.py): this
+                # executor is a merge TARGET (store served through the
+                # endpoint), a PUSHER of its own committed maps, and an
+                # overflow client for the writer's ENOSPC ladder
+                from sparkrdma_tpu.shuffle.push_merge import (
+                    MergeClient, MergeStore, SegmentPusher)
+                self.executor.merge_store = MergeStore(self.resolver,
+                                                       self.conf)
+                self.pusher = SegmentPusher(self.executor, self.resolver,
+                                            self.conf, pool=self.pool,
+                                            tracer=self.tracer)
+                self.merge_client = MergeClient(self.executor, self.conf)
             self.executor.start()
             if num_executors_hint:
                 self.executor.wait_for_members(num_executors_hint)
@@ -167,13 +182,17 @@ class TpuShuffleManager:
         ``(keys_sorted, payload_sorted) -> (keys', payload')``)."""
         if self.executor is None or self.resolver is None:
             raise RuntimeError("get_writer is an executor-role call")
+        overflow = (self.merge_client.overflow_spill
+                    if self.merge_client is not None else None)
         inner = TpuShuffleWriter(
             self.resolver, handle.shuffle_id, map_id, handle.num_partitions,
             handle.partitioner.build(handle.num_partitions),
             handle.row_payload_bytes,
             combiner=combiner if combiner is not None else handle.combiner,
-            conf=self.conf, pool=self.pool, tracer=self.tracer)
-        return _PublishingWriter(inner, self.executor, tracer=self.tracer)
+            conf=self.conf, pool=self.pool, tracer=self.tracer,
+            overflow_spill=overflow)
+        return _PublishingWriter(inner, self.executor, tracer=self.tracer,
+                                 pusher=self.pusher)
 
     def get_reader(self, handle: ShuffleHandle, start_partition: int,
                    end_partition: int, map_range=None) -> TpuShuffleReader:
@@ -229,6 +248,8 @@ class TpuShuffleManager:
             self.driver.unregister_shuffle(shuffle_id)
         if self.executor is not None:
             self.executor.invalidate_shuffle(shuffle_id)
+            if self.executor.merge_store is not None:
+                self.executor.merge_store.drop_shuffle(shuffle_id)
         if self.resolver is not None:
             self.resolver.remove_shuffle(shuffle_id)
         with self._lock:
@@ -248,6 +269,12 @@ class TpuShuffleManager:
             log.info("wrote %d trace events to %s", n, path)
         # quiesce traffic sources before destroying the pool: outstanding
         # readers hold views into pool memory
+        if self.pusher is not None:
+            self.pusher.stop()
+        if self.executor is not None and self.executor.merge_store is not None:
+            log.info("merge store at stop: %s",
+                     self.executor.merge_store.snapshot())
+            self.executor.merge_store.stop()
         if self.executor is not None:
             if self.executor.suspect_events or self.executor.checksum_failures:
                 log.warning("peer health at stop: %s (checksum failures: %d)",
@@ -272,10 +299,11 @@ class _PublishingWriter:
     (RdmaWrapperShuffleWriter.scala:104-122)."""
 
     def __init__(self, inner: TpuShuffleWriter, endpoint: ExecutorEndpoint,
-                 tracer=None):
+                 tracer=None, pusher=None):
         self._inner = inner
         self._endpoint = endpoint
         self._tracer = tracer or trace_mod.NULL
+        self._pusher = pusher  # SegmentPusher | None (push-merge)
 
     def write_batch(self, keys, payload=None) -> None:
         self._inner.write_batch(keys, payload)
@@ -288,6 +316,14 @@ class _PublishingWriter:
         if result is None:
             return None
         token, partition_lengths = result
+        if self._pusher is not None:
+            # push-merge: queue the committed output's background push
+            # BEFORE the publish can complete the map stage at the
+            # driver — the finalize broadcast then provably trails this
+            # submit, so targets' idle-grace wait sees the push coming
+            self._pusher.submit(self._inner.shuffle_id,
+                                self._inner.map_id, self._inner.fence,
+                                partition_lengths)
         with self._tracer.span("writer.publish", "write",
                                shuffle=self._inner.shuffle_id,
                                map=self._inner.map_id):

@@ -197,6 +197,46 @@ def recover_lost_maps(executors: Sequence[TpuShuffleManager],
                 lost_maps.append(m)
         if not lost_maps and failure.map_id >= 0:
             lost_maps = [failure.map_id]
+        # push-merge RE-POINT: a lost map whose EVERY reduce partition
+        # is held by a merged replica on a surviving executor needs no
+        # re-execution — the reducers' merged-segment-first resolution
+        # serves it from the replica, so recovery just drops it from
+        # the recompute set (the location-table flip: the tombstone
+        # already pruned the dead slot's entries from the directory,
+        # and the epoch bump makes every reducer re-sync it)
+        drv_ep = getattr(driver, "driver", driver)
+        # re-point only when the retrying readers can actually consume
+        # merged segments: a plan with map-range-SPLIT tasks cannot (a
+        # segment holds every covered map's rows — it cannot be sliced
+        # to a map subset, so the fetcher bypasses merged resolution
+        # for split tasks and a re-point would leave them refetching
+        # the tombstoned owner forever)
+        split_active = False
+        if drv_ep is not None and hasattr(drv_ep, "reduce_plan"):
+            plan = drv_ep.reduce_plan(handle.shuffle_id)
+            split_active = plan is not None and any(
+                t.is_split(handle.num_maps) for t in plan.tasks)
+        if (lost_maps and not split_active and drv_ep is not None
+                and hasattr(drv_ep, "merged_covering")):
+            covered = drv_ep.merged_covering(handle.shuffle_id,
+                                             lost_maps,
+                                             exclude_slot=dead_slot)
+            if covered:
+                endpoint.tracer.instant(
+                    "recovery.repoint", "fault",
+                    shuffle=handle.shuffle_id, count=len(covered),
+                    dead_slot=dead_slot)
+                log.warning("stage retry %d: re-pointing maps %s of "
+                            "shuffle %d to merged replicas (no "
+                            "re-execution)", attempt, sorted(covered),
+                            handle.shuffle_id)
+                lost_maps = [m for m in lost_maps if m not in covered]
+        if not lost_maps:
+            # the whole loss re-points: invalidate so the retry
+            # re-syncs table + merged directory, and return — there
+            # are no repair publishes to wait for
+            endpoint.invalidate_shuffle(handle.shuffle_id)
+            return dead_slot
         log.warning("stage retry %d: recomputing maps %s lost with "
                     "executor slot %d", attempt, lost_maps,
                     dead_slot)

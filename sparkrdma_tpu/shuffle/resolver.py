@@ -114,6 +114,11 @@ class TpuShuffleBlockResolver:
         os.makedirs(spill_dir, exist_ok=True)
         self._shuffles: Dict[int, Dict[int, SpillFile]] = {}
         self._by_token: Dict[int, SpillFile] = {}
+        # externally-owned served files (push-merge segments, spill
+        # overflow blobs): token-addressable for the block dataplane but
+        # NOT map outputs — no location-table entry, no at-rest spot
+        # checks (merged integrity is entry-CRC-verified reducer-side)
+        self._external: Dict[int, List[SpillFile]] = {}
         self._lock = threading.Lock()
         self._tokens = itertools.count(1)
         # attempt/fence allocator: a plain guarded int (not
@@ -492,6 +497,35 @@ class TpuShuffleBlockResolver:
             return {m: int(s.partition_lengths.sum())
                     for m, s in self._shuffles.get(shuffle_id, {}).items()}
 
+    # -- externally-owned served files (push-merge) ----------------------
+
+    def register_external(self, shuffle_id: int, path: str,
+                          length: int) -> int:
+        """Make one externally-owned file (a finalized merged segment or
+        an overflow blob, shuffle/push_merge.py) token-addressable on
+        BOTH serving dataplanes — the Python ``read_block`` path and the
+        native block server — without entering the map-output tables.
+        The caller owns the file's content; :meth:`release_externals`
+        (or ``remove_shuffle``) unregisters and deletes it."""
+        token = next(self._tokens)
+        spill = SpillFile(path, [length], file_token=token)
+        if self.block_server is not None:
+            self.block_server.register_file(token, path)
+        with self._lock:
+            self._by_token[token] = spill
+            self._external.setdefault(shuffle_id, []).append(spill)
+        return token
+
+    def release_externals(self, shuffle_id: int) -> None:
+        with self._lock:
+            spills = self._external.pop(shuffle_id, [])
+            for spill in spills:
+                self._by_token.pop(spill.file_token, None)
+        for spill in spills:
+            if self.block_server is not None:
+                self.block_server.unregister_file(spill.file_token)
+            spill.dispose()
+
     # -- lifecycle -------------------------------------------------------
 
     def _sweep_tmps(self, shuffle_prefix: Optional[str] = None) -> None:
@@ -530,6 +564,9 @@ class TpuShuffleBlockResolver:
         # reap this shuffle's uncommitted attempts (writer tmp + spill
         # files from crashed/aborted tasks) — in every spill dir
         self._sweep_tmps(f"shuffle_{shuffle_id}_")
+        # externally-owned served files (merged segments, overflow
+        # blobs) die with the shuffle too
+        self.release_externals(shuffle_id)
 
     def recover(self) -> Dict[int, list]:
         """Rebuild state from committed (data, index) pairs on disk.
@@ -649,6 +686,6 @@ class TpuShuffleBlockResolver:
 
     def stop(self) -> None:
         with self._lock:
-            shuffle_ids = list(self._shuffles.keys())
-        for sid in shuffle_ids:
+            shuffle_ids = set(self._shuffles) | set(self._external)
+        for sid in sorted(shuffle_ids):
             self.remove_shuffle(sid)

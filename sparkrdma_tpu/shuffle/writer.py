@@ -149,6 +149,48 @@ class _Spill:
         self.part_offsets = offs
 
 
+class _RemoteSpill:
+    """A spill parked on a merge peer (push-merge's tiered-spill
+    overflow: every local spill directory was exhausted, so the rendered
+    partition-contiguous bytes went to a peer's merge store instead of
+    failing the attempt). Same read surface as :class:`_Spill`, served
+    from memory after :meth:`materialize` fetches the blob back over the
+    ordinary block dataplane at merge time — by which point local disk
+    only needs room for the final data file, not the spills."""
+
+    __slots__ = ("handle", "part_lengths", "part_offsets", "part_crcs",
+                 "blob_crc", "_data")
+
+    def __init__(self, handle, part_lengths: np.ndarray,
+                 blob_crc: int, part_crcs: Optional[List[int]] = None):
+        self.handle = handle  # push_merge.RemoteSpillHandle
+        self.part_lengths = part_lengths
+        self.part_crcs = part_crcs
+        self.blob_crc = blob_crc  # render-time CRC32 of the whole blob
+        offs = np.zeros(len(part_lengths), dtype=np.int64)
+        if len(part_lengths) > 1:
+            np.cumsum(part_lengths[:-1], out=offs[1:])
+        self.part_offsets = offs
+        self._data: Optional[np.ndarray] = None
+
+    def materialize(self) -> None:
+        if self._data is not None:
+            return
+        data = self.handle.fetch()
+        # the wire trailer only proves TRANSPORT — at-rest rot on the
+        # overflow peer must be caught against the render-time CRC, or
+        # the merge would commit (and re-attest) corrupt bytes silently
+        if zlib.crc32(data) != self.blob_crc:
+            raise WriteFailedError(
+                "overflow spill fetched back corrupt (peer-side rot); "
+                "failing the attempt so the map re-places")
+        self._data = np.frombuffer(data, dtype=np.uint8)
+
+    def segment(self, p: int) -> np.ndarray:
+        off = int(self.part_offsets[p])
+        return self._data[off:off + int(self.part_lengths[p])]
+
+
 def _write_all(fd: int, view: np.ndarray) -> None:
     """write() until done — one os.write caps at ~2 GiB on Linux and may
     return short, and a partition segment can exceed that."""
@@ -185,7 +227,7 @@ class TpuShuffleWriter:
                  row_payload_bytes: int,
                  combiner: Optional[Callable] = None,
                  conf: Optional[TpuShuffleConf] = None,
-                 pool=None, tracer=None):
+                 pool=None, tracer=None, overflow_spill=None):
         self.resolver = resolver
         self.shuffle_id = shuffle_id
         self.map_id = map_id
@@ -222,6 +264,11 @@ class TpuShuffleWriter:
         self._crc_enabled = bool(getattr(self.resolver, "at_rest_checksum",
                                          self.conf.at_rest_checksum))
         self._spill_backoff = Backoff.from_conf(self.conf)
+        # push-merge tiered spill: ``overflow_spill(shuffle, map, fence,
+        # bytes) -> RemoteSpillHandle | None`` parks a spill on a merge
+        # peer when EVERY local directory is exhausted — the attempt
+        # survives ENOSPC instead of failing (None = feature off)
+        self._overflow_spill = overflow_spill
 
         self._runs: List[_Run] = []  # unspilled, arrival order
         self._buffered = 0  # bytes accumulated in self._runs
@@ -477,6 +524,9 @@ class TpuShuffleWriter:
                 return None
             candidates = self._spill_dir_candidates()
             if not candidates:
+                remote = self._try_overflow(seq, runs)
+                if remote is not None:
+                    return remote
                 raise WriteFailedError(
                     f"spill {seq}: every spill directory is quarantined "
                     f"({self.resolver.spill_dir_health()})")
@@ -511,6 +561,13 @@ class TpuShuffleWriter:
                         threshold=self.spill_threshold)
                 attempt += 1
                 if not _transient_disk_error(e) or attempt > budget:
+                    if _transient_disk_error(e):
+                        # budget exhausted on HEALABLE errors (ENOSPC,
+                        # EIO...): the tiered ladder's last rung is a
+                        # merge peer's disk, not a failed attempt
+                        remote = self._try_overflow(seq, runs)
+                        if remote is not None:
+                            return remote
                     raise WriteFailedError(
                         f"spill {seq} failed after {attempt} attempt(s) "
                         f"(last dir {d}): {e}") from e
@@ -537,38 +594,82 @@ class TpuShuffleWriter:
                           f"bytes landed)", path)
         f.write(memoryview(view))
 
+    def _emit_partitions(self, runs: List[_Run], write
+                         ) -> Tuple[np.ndarray, Optional[List[int]]]:
+        """Drive one spill's serialization — partition-contiguous over
+        the runs, combiner applied per partition first — calling
+        ``write(partition, view)`` per chunk. Shared by the on-disk
+        spill and the in-memory render the ENOSPC overflow sends to a
+        merge peer, so both are byte-identical by construction."""
+        part_lengths = np.zeros(self.num_partitions, dtype=np.int64)
+        part_crcs = [0] * self.num_partitions if self._crc_enabled else None
+        for p in range(self.num_partitions):
+            if self.combiner is None:
+                for run in runs:
+                    seg = run.segment(p)
+                    if len(seg):
+                        write(p, seg)
+                        part_lengths[p] += len(seg)
+                        if part_crcs is not None:
+                            part_crcs[p] = zlib.crc32(memoryview(seg),
+                                                      part_crcs[p])
+            else:
+                rows = self._partition_rows(p, [], runs)
+                if len(rows):
+                    combined = self._combine_rows(rows)
+                    flat = combined.reshape(-1)
+                    write(p, flat)
+                    part_lengths[p] = combined.nbytes
+                    if part_crcs is not None:
+                        part_crcs[p] = zlib.crc32(memoryview(flat))
+        return part_lengths, part_crcs
+
     def _write_spill(self, runs: List[_Run], path: str) -> _Spill:
         """One spill file: partition-contiguous over the runs it covers
         (combiner applied per partition first, shrinking spilled bytes).
         Partition CRCs stream with the writes when at-rest checksums are
         on; a success resets the directory's failure count."""
         fault_mod.storage_check("spill_write", path)
-        part_lengths = np.zeros(self.num_partitions, dtype=np.int64)
-        part_crcs = [0] * self.num_partitions if self._crc_enabled else None
         with open(path, "wb") as f:
-            for p in range(self.num_partitions):
-                if self.combiner is None:
-                    for run in runs:
-                        seg = run.segment(p)
-                        if len(seg):
-                            self._spill_write(f, seg, path)
-                            part_lengths[p] += len(seg)
-                            if part_crcs is not None:
-                                part_crcs[p] = zlib.crc32(memoryview(seg),
-                                                          part_crcs[p])
-                else:
-                    rows = self._partition_rows(p, [], runs)
-                    if len(rows):
-                        combined = self._combine_rows(rows)
-                        flat = combined.reshape(-1)
-                        self._spill_write(f, flat, path)
-                        part_lengths[p] = combined.nbytes
-                        if part_crcs is not None:
-                            part_crcs[p] = zlib.crc32(memoryview(flat))
+            part_lengths, part_crcs = self._emit_partitions(
+                runs, lambda p, seg: self._spill_write(f, seg, path))
         success = getattr(self.resolver, "record_spill_dir_success", None)
         if success is not None:
             success(os.path.dirname(path))
         return _Spill(path, part_lengths, part_crcs)
+
+    def _try_overflow(self, seq: int, runs: List[_Run]
+                      ) -> Optional[_RemoteSpill]:
+        """The tiered ladder's last rung: render the spill in memory and
+        park it on a merge peer (push-merge's overflow channel). None =
+        no hook installed or no peer could take it — the caller fails
+        the attempt as before."""
+        if self._overflow_spill is None:
+            return None
+        import io
+        buf = io.BytesIO()
+        part_lengths, part_crcs = self._emit_partitions(
+            runs, lambda p, seg: buf.write(memoryview(seg)))
+        blob = buf.getvalue()
+        blob_crc = zlib.crc32(blob)
+        try:
+            handle = self._overflow_spill(self.shuffle_id, self.map_id,
+                                          self.fence, blob)
+        except Exception as e:  # noqa: BLE001 — overflow is best-effort;
+            # its failure must not mask the original disk error
+            log.warning("spill %d overflow push failed: %s", seq, e)
+            return None
+        if handle is None:
+            return None
+        self.metrics.record_remote_spill()
+        self._tracer.instant("write.spill_remote", "fault",
+                             shuffle=self.shuffle_id, map=self.map_id,
+                             seq=seq, bytes=handle.size)
+        log.warning("spill %d of shuffle %d map %d overflowed to a merge "
+                    "peer (%d bytes): local spill dirs exhausted, the "
+                    "attempt continues", seq, self.shuffle_id,
+                    self.map_id, handle.size)
+        return _RemoteSpill(handle, part_lengths, blob_crc, part_crcs)
 
     # -- combine ---------------------------------------------------------
 
@@ -606,7 +707,10 @@ class TpuShuffleWriter:
         for i, spill in enumerate(spills):
             ln = int(spill.part_lengths[p])
             if ln:
-                if spill_fds is not None:
+                if isinstance(spill, _RemoteSpill):
+                    segs.append(spill.segment(p))
+                    continue
+                if spill_fds is not None and spill_fds[i] is not None:
                     data = os.pread(spill_fds[i], ln,
                                     int(spill.part_offsets[p]))
                 else:
@@ -694,25 +798,40 @@ class TpuShuffleWriter:
         tmp = self._tmp_base()
         fault_mod.storage_check("merge_write", tmp)
         spills = [self._spills[s] for s in sorted(self._spills)]
+        # ENOSPC-overflowed spills live on a merge peer: fetch each back
+        # whole before the partition loop (one bounded buffer per remote
+        # spill; by merge time local disk only needs the final file)
+        for s in spills:
+            if isinstance(s, _RemoteSpill):
+                s.materialize()
         runs = self._runs
         part_lengths = np.zeros(self.num_partitions, dtype=np.int64)
         part_crcs = [0] * self.num_partitions if self._crc_enabled else None
         out_fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
         spill_fds = []
         try:
-            spill_fds = [os.open(s.path, os.O_RDONLY) for s in spills]
+            spill_fds = [None if isinstance(s, _RemoteSpill)
+                         else os.open(s.path, os.O_RDONLY) for s in spills]
             for p in range(self.num_partitions):
                 if self.combiner is None:
                     total = 0
                     for s, fd in zip(spills, spill_fds):
                         ln = int(s.part_lengths[p])
-                        if ln:
+                        if not ln:
+                            continue
+                        if fd is None:
+                            seg = s.segment(p)
+                            self._merge_write(out_fd, seg, tmp)
+                            if part_crcs is not None:
+                                part_crcs[p] = zlib.crc32(
+                                    memoryview(seg), part_crcs[p])
+                        else:
                             _copy_from_file(out_fd, fd,
                                             int(s.part_offsets[p]), ln)
                             if part_crcs is not None:
                                 part_crcs[p] = integrity.crc32_combine(
                                     part_crcs[p], s.part_crcs[p], ln)
-                            total += ln
+                        total += ln
                     for run in runs:
                         seg = run.segment(p)
                         if len(seg):
@@ -733,7 +852,8 @@ class TpuShuffleWriter:
                         part_lengths[p] = combined.nbytes
         finally:
             for fd in spill_fds:
-                os.close(fd)
+                if fd is not None:
+                    os.close(fd)
             os.close(out_fd)
         return tmp, part_lengths, part_crcs
 
@@ -770,6 +890,9 @@ class TpuShuffleWriter:
             spills = list(self._spills.values())
             self._spills = {}
         for spill in spills:
+            if isinstance(spill, _RemoteSpill):
+                continue  # peer-held blob: reaped with the shuffle on
+                # the merge target (unregister -> MergeStore.drop_shuffle)
             self._reap(spill.path)
 
     def _stop_spill_workers(self) -> None:

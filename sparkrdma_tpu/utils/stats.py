@@ -218,6 +218,10 @@ class WriteMetrics:
         self.spill_dir_failures = 0
         self.spill_shrinks = 0
         self.cleanup_errors = 0
+        # push-merge tiered spill: spills that overflowed to a merge
+        # peer after every local directory was exhausted (the attempt
+        # survived ENOSPC instead of failing)
+        self.remote_spills = 0
 
     def record_scatter(self, ns: int) -> None:
         with self._lock:
@@ -259,6 +263,10 @@ class WriteMetrics:
         with self._lock:
             self.cleanup_errors += 1
 
+    def record_remote_spill(self) -> None:
+        with self._lock:
+            self.remote_spills += 1
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -275,6 +283,7 @@ class WriteMetrics:
                 "spill_dir_failures": self.spill_dir_failures,
                 "spill_shrinks": self.spill_shrinks,
                 "cleanup_errors": self.cleanup_errors,
+                "remote_spills": self.remote_spills,
             }
 
 

@@ -28,8 +28,10 @@ SPANS = frozenset({
     "fetch.driver_table",
     "fetch.issue",
     "fetch.locations",
+    "fetch.merged",
     "fetch.refetch_range",
     "fetch.vectored",
+    "push.map",
     "write.merge",
     "write.scatter",
     "write.spill",
@@ -44,14 +46,19 @@ INSTANTS = frozenset({
     "exchange.overlap",
     "exchange.select",
     "fetch.coalesce_fallback",
+    "fetch.merged_fallback",
     "fetch.retry",
+    "merge.finalize",
     "meta.epoch_bump",
     "peer.suspect",
+    "push.drop",
+    "recovery.repoint",
     "plan.coalesce",
     "plan.replan",
     "plan.split",
     "serve.corrupt",
     "write.cleanup_error",
+    "write.spill_remote",
     "write.spill_retry",
     "write.spill_shrink",
 })

@@ -57,6 +57,14 @@ WARM = os.environ.get("CHAOS_WARM", "1") not in ("0", "false")
 # see every injected fault; run_chaos.sh sweeps both. The mid-stage
 # re-plan scenario below forces it on regardless.
 SKEW = os.environ.get("CHAOS_SKEW", "0") not in ("0", "false")
+# push-merge dataplane under chaos: 1 runs the whole byte-identity
+# matrix with background pushes, merge targets, and merged-segment-first
+# reads active (partial finalize mid-reduce included) so every injected
+# fault also crosses the push/merge/serve path; run_chaos.sh sweeps
+# both. Scenarios asserting exact wire counts or recompute semantics pin
+# push_merge=False — the dedicated merge scenarios below own those
+# assertions with deterministic coverage.
+MERGE = os.environ.get("CHAOS_MERGE", "0") not in ("0", "false")
 # CHAOS_LOCKGRAPH=1: run every scenario under the lock-order shim
 # (sparkrdma_tpu/analysis/lockgraph.py) so the chaos matrix doubles as
 # race detection — faults drive the rare teardown/retry/suspect paths
@@ -91,6 +99,7 @@ def _conf(**kw):
                 coalesce_reads=COALESCE,
                 location_epoch_cache=WARM,
                 adaptive_plan=SKEW,
+                push_merge=MERGE,
                 collect_shuffle_reader_stats=True)
     base.update(kw)
     return TpuShuffleConf(**base)
@@ -139,7 +148,7 @@ def test_chaos_corruption_healed_by_refetch(tmp_path):
     """Bit-flipped fetch payloads are caught by the CRC32 trailer and
     refetched within the budget; the reduce is byte-identical and the
     failure counters show the retries that absorbed it."""
-    driver, execs = _cluster(tmp_path)
+    driver, execs = _cluster(tmp_path, push_merge=False)
     injector = FaultInjector(seed=SEED)
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
@@ -165,8 +174,11 @@ def test_chaos_corruption_healed_by_refetch(tmp_path):
 
 def test_chaos_connect_refusal_burst(tmp_path):
     """A refusal burst at fetch time is absorbed by connect retries with
-    backoff plus the fetch retry envelope — no stage retry needed."""
-    driver, execs = _cluster(tmp_path)
+    backoff plus the fetch retry envelope — no stage retry needed.
+    push_merge pinned off: merged resolution can satisfy the reduce
+    without any fresh dial, so the refusal count would depend on
+    finalize timing."""
+    driver, execs = _cluster(tmp_path, push_merge=False)
     injector = FaultInjector(seed=SEED)
     map_runs = []
     try:
@@ -232,7 +244,7 @@ def test_chaos_peer_kill_mid_fetch_recompute(tmp_path):
     survivors — never on the dead slot — and the reduce completes
     byte-identical."""
     driver, execs = _cluster(tmp_path, read_ahead_depth=4,
-                             fetch_retry_budget=1)
+                             fetch_retry_budget=1, push_merge=False)
     injector = FaultInjector(seed=SEED)
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
@@ -291,7 +303,8 @@ def test_chaos_blackhole_partition_heartbeat_escalates(tmp_path):
     interval_ms = 200
     driver, execs = _cluster(tmp_path, request_deadline_ms=10000,
                              heartbeat_interval_ms=interval_ms,
-                             heartbeat_misses=2, fetch_retry_budget=2)
+                             heartbeat_misses=2, fetch_retry_budget=2,
+                             push_merge=False)
     injector = FaultInjector(seed=SEED)
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
@@ -330,7 +343,7 @@ def test_chaos_vectored_corruption_refetches_only_affected_ranges(tmp_path):
     from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
     from sparkrdma_tpu.utils.trace import Tracer
 
-    driver, execs = _cluster(tmp_path, n=2)
+    driver, execs = _cluster(tmp_path, n=2, push_merge=False)
     injector = FaultInjector(seed=SEED)
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
@@ -378,6 +391,150 @@ def test_chaos_vectored_corruption_refetches_only_affected_ranges(tmp_path):
         _shutdown(driver, execs)
 
 
+def _wait_merge_ready(driver, execs, handle):
+    """Deterministic point past the asynchronous push+finalize pipeline:
+    every pusher drained, every (map, partition) covered at the driver."""
+    from sparkrdma_tpu.shuffle.push_merge import wait_for_coverage
+    for ex in execs:
+        assert ex.pusher is not None and ex.pusher.drain(15), \
+            f"seed={SEED}: pusher did not drain"
+    assert wait_for_coverage(driver.driver, handle.shuffle_id,
+                             handle.num_maps, handle.num_partitions,
+                             timeout=15), \
+        f"seed={SEED}: merged coverage never completed"
+
+
+def test_chaos_merge_repoint_zero_reexecutions(tmp_path):
+    """The push-merge recovery acceptance: an executor owning map
+    outputs dies MID-REDUCE with merge_replicas >= 1 and full replica
+    coverage on survivors — the stage completes with ZERO map
+    re-executions (a location-table flip to the replicas), the dead
+    slot is tombstoned, and the retry serves every lost map from merged
+    segments, byte-identical to the fault-free run."""
+    driver, execs = _cluster(tmp_path, fetch_retry_budget=1,
+                             push_merge=True, merge_replicas=2,
+                             push_deadline_ms=8000)
+    injector = FaultInjector(seed=SEED)
+    map_runs = []
+    merged_metrics = []
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        _wait_merge_ready(driver, execs, handle)
+        victim_slot = execs[2].executor.exec_index()
+        victim_addr = (execs[2].executor.manager_id.rpc_host,
+                       execs[2].executor.manager_id.rpc_port)
+        injector.install_endpoint(execs[0].executor)
+        # the victim dies between the reducer's location reads and its
+        # data reads (the peer_kill choreography): the first in-flight
+        # response disconnects, every re-dial bounces, and the REAL
+        # server dies so the tombstone probe agrees
+        injector.add(DISCONNECT, peer=victim_addr,
+                     msg_type=M.FetchBlocksResp)
+        injector.add(REFUSE_CONNECT, peer=victim_addr, after=1)
+        done = threading.Event()
+
+        def kill_on_disconnect():
+            while (injector.fired_count(DISCONNECT) == 0
+                   and not done.wait(0.005)):
+                pass
+            execs[2].executor.server.stop()
+
+        def counting_map_fn(writer, map_id):
+            map_runs.append(map_id)
+            _map_fn(writer, map_id)
+
+        def reduce_fn(mgr, h, state={"attempt": 0}):
+            # attempt 1 fetches per-map (a reducer that had not learned
+            # the merged directory yet) so the kill lands mid-reduce;
+            # the RETRY resolves merged-segment-first — the re-point
+            state["attempt"] += 1
+            if state["attempt"] == 1:
+                from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
+                reader = TpuShuffleReader(
+                    mgr.executor, mgr.resolver, _conf(push_merge=False),
+                    h.shuffle_id, h.num_maps, 0, h.num_partitions, 0)
+            else:
+                reader = mgr.get_reader(h, 0, h.num_partitions)
+            keys, _ = reader.read_all()
+            merged_metrics.append(reader.metrics)
+            return np.sort(keys)
+
+        killer = threading.Thread(target=kill_on_disconnect)
+        killer.start()
+        try:
+            got = run_reduce_with_retry(execs, handle, counting_map_fn,
+                                        reduce_fn, reducer_index=0,
+                                        driver=driver)
+        finally:
+            done.set()
+            killer.join()
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        # ZERO map re-executions: recovery re-pointed every lost map to
+        # a surviving merged replica instead of recomputing
+        assert map_runs == [], \
+            f"seed={SEED}: maps {map_runs} re-executed despite replicas"
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        assert driver.driver.members()[victim_slot] == TOMBSTONE, \
+            f"seed={SEED}"
+        # the dead slot's segments left the directory; survivors' stayed
+        d = driver.driver.merged_directory(1)
+        assert d is not None and all(
+            e.slot != victim_slot
+            for p in d.partitions() for e in d.entries(p)), f"seed={SEED}"
+        # the retry actually served merged segments
+        assert merged_metrics[-1].merged_reads >= 1, f"seed={SEED}"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def test_chaos_merge_corrupt_segment_degrades_per_map(tmp_path):
+    """At-rest rot on a merged segment: the reducer-side entry CRC
+    catches it and that partition DEGRADES to the per-map dataplane —
+    byte-identical output, merged_fallbacks counted, no stage retry."""
+    import glob
+
+    driver, execs = _cluster(tmp_path, push_merge=True, merge_replicas=1,
+                             push_deadline_ms=8000)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        _wait_merge_ready(driver, execs, handle)
+        # rot the segment the reducer WILL choose for partition 0 (the
+        # directory's widest-coverage entry — the fetcher's own policy),
+        # on disk on its hosting executor: the serve path carries the
+        # rotted bytes and the wire CRC trailer is computed over them,
+        # so only the published entry CRC can tell
+        d = driver.driver.merged_directory(1)
+        chosen = d.entries(0)[0]
+        slot_dirs = {execs[i].executor.exec_index():
+                     str(tmp_path / f"e{i}") for i in range(len(execs))}
+        seg = os.path.join(slot_dirs[chosen.slot], "merge", "seg_1_0.bin")
+        assert glob.glob(seg), f"seed={SEED}: {seg} missing"
+        with open(seg, "r+b") as f:
+            f.seek(0)
+            first = f.read(1)
+            f.seek(0)
+            f.write(bytes([first[0] ^ 0xFF]))
+
+        reader = execs[0].get_reader(handle, 0, handle.num_partitions)
+        keys, _ = reader.read_all()
+        np.testing.assert_array_equal(np.sort(keys), _expected(6),
+                                      err_msg=f"seed={SEED}")
+        m = reader.metrics
+        assert m.merged_fallbacks >= 1, f"seed={SEED}: {m}"
+        assert m.checksum_failures >= 1, f"seed={SEED}: {m}"
+        assert m.failed_fetches == 0, f"seed={SEED}: {m}"
+        assert m.merged_reads >= 1, \
+            f"seed={SEED}: clean partitions should still serve merged"
+    finally:
+        _shutdown(driver, execs)
+
+
 def test_chaos_stale_cache_never_serves_dead_peer(tmp_path):
     """Executor loss mid-iteration: the reducer's warm location cache
     points at the dead peer. The fetch fails, recovery tombstones +
@@ -386,7 +543,8 @@ def test_chaos_stale_cache_never_serves_dead_peer(tmp_path):
     location served after invalidation."""
     if not WARM:
         pytest.skip("cold sweep: no cache to go stale")
-    driver, execs = _cluster(tmp_path, fetch_retry_budget=1)
+    driver, execs = _cluster(tmp_path, fetch_retry_budget=1,
+                             push_merge=False)
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
                                          partitioner=PartitionerSpec("modulo"))
@@ -430,7 +588,8 @@ def test_chaos_corrupt_reexecution_bumps_epoch_mid_iteration(tmp_path):
     byte-identical."""
     if not WARM:
         pytest.skip("cold sweep: no cache to invalidate")
-    driver, execs = _cluster(tmp_path, at_rest_checksum=True)
+    driver, execs = _cluster(tmp_path, at_rest_checksum=True,
+                             push_merge=False)
     injector = StorageFaultInjector(seed=SEED)
     injector.install()
     try:
@@ -487,6 +646,7 @@ def test_chaos_replan_mid_stage_after_executor_loss(tmp_path):
     from sparkrdma_tpu.shuffle.recovery import run_planned_reduce
 
     driver, execs = _cluster(tmp_path, adaptive_plan=True,
+                             push_merge=False,
                              coalesce_target_bytes=2048,
                              split_threshold_bytes=4096)
     try:
@@ -787,11 +947,16 @@ def test_chaos_disk_matrix(tmp_path, scenario):
 def test_chaos_disk_total_failure_is_clean(tmp_path):
     """When every spill dir fails persistently, the job FAILS CLEANLY:
     WriteFailedError after re-placement on every live executor, no hang,
-    and not one ``.tmp`` left anywhere."""
+    and not one ``.tmp`` left anywhere. push_merge pinned off on
+    purpose: its overflow rung would RESCUE the attempt by parking the
+    spill on a peer (that behavior has its own test,
+    test_push_merge.py::test_overflow_spill_survives_total_enospc) —
+    this scenario exists to prove the failure is clean when nothing
+    can rescue."""
     from sparkrdma_tpu.shuffle.writer import WriteFailedError
 
     driver, execs = _cluster(tmp_path, spill_threshold_bytes="1k",
-                             spill_retry_budget=1)
+                             spill_retry_budget=1, push_merge=False)
     injector = StorageFaultInjector(seed=SEED)
     injector.install()
     try:

@@ -1,0 +1,414 @@
+"""Push-merge dataplane tests (shuffle/push_merge.py).
+
+Units (target assignment, ledger fencing, directory round-trips), the
+end-to-end merged-vs-scattered byte-parity matrix (full and PARTIAL
+coverage, split-task bypass, warm directory caching), the tiered-spill
+ENOSPC overflow, and the merged-read microbench acceptance gates.
+``MERGE_SEED`` varies the generated data for scripts/run_merge_bench.sh
+seed sweeps.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel.faults import ENOSPC, StorageFaultInjector
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+from sparkrdma_tpu.shuffle.push_merge import (
+    MergedDirectory,
+    MergedEntry,
+    MergeStore,
+    bitmap_get,
+    bitmap_members,
+    bitmap_new,
+    bitmap_set,
+    merge_targets,
+    wait_for_coverage,
+)
+from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
+from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
+
+SEED = int(os.environ.get("MERGE_SEED", "0"))
+
+
+# -- units ----------------------------------------------------------------
+
+
+def test_merge_targets_contiguous_deterministic_and_self_excluding():
+    targets = merge_targets(8, [0, 1, 2], my_slot=0, replicas=2)
+    assert targets == merge_targets(8, [0, 1, 2], 0, 2)  # deterministic
+    assert 0 not in targets  # never targets the pusher itself
+    # each replica index covers every partition exactly once
+    for r in range(2):
+        covered = []
+        for slot, ranges in targets.items():
+            for lo, hi in ranges:
+                covered.extend(range(lo, hi))
+        # both replicas together cover each partition exactly twice
+    counts = np.zeros(8, dtype=int)
+    for ranges in targets.values():
+        for lo, hi in ranges:
+            counts[lo:hi] += 1
+    assert (counts == 2).all(), counts
+    # ranges are contiguous and sorted per slot
+    for ranges in targets.values():
+        assert all(lo < hi for lo, hi in ranges)
+    # K clamps to the candidate count; replicas=0 disables
+    assert not merge_targets(8, [0, 1], 0, 0)
+    t1 = merge_targets(4, [0, 1], 0, 5)
+    assert set(t1) == {1}
+    # single-executor degenerate case still pushes somewhere
+    assert merge_targets(4, [0], 0, 1) == {0: [(0, 4)]}
+
+
+def test_bitmap_roundtrip():
+    b = bitmap_new(12)
+    for m in (0, 3, 11):
+        bitmap_set(b, m)
+    assert bitmap_members(bytes(b), 12) == [0, 3, 11]
+    assert bitmap_get(bytes(b), 3) and not bitmap_get(bytes(b), 4)
+    assert not bitmap_get(b"", 5)  # short bitmap reads as uncovered
+
+
+def test_merged_directory_roundtrip_and_pruning():
+    d = MergedDirectory()
+    cov_a = bitmap_new(6)
+    bitmap_set(cov_a, 1)
+    bitmap_set(cov_a, 2)
+    cov_b = bitmap_new(6)
+    bitmap_set(cov_b, 1)
+    d.apply(MergedEntry(0, 1, 10, 100, 0xAB, bytes(cov_a), [(0, 100)]))
+    d.apply(MergedEntry(0, 2, 11, 50, 0xCD, bytes(cov_b), [(0, 50)]))
+    d.apply(MergedEntry(3, 2, 12, 70, 0xEF, bytes(cov_a), [(0, 40),
+                                                           (50, 30)]))
+    # widest coverage first, slot tie-break
+    assert [e.slot for e in d.entries(0)] == [1, 2]
+    # wire round trip
+    d2 = MergedDirectory.from_bytes(d.to_bytes())
+    assert len(d2) == 3
+    e = d2.entries(3)[0]
+    assert (e.slot, e.token, e.nbytes, e.crc32) == (2, 12, 70, 0xEF)
+    assert e.ranges == ((0, 40), (50, 30))
+    assert e.covered_maps(6) == [1, 2]
+    # repair publish for map 2 drops every entry covering it
+    assert d.drop_map(2) == 2
+    assert [e.slot for e in d.entries(0)] == [2]
+    # tombstone drops the slot's entries
+    assert d.drop_slot(2) == 1
+    assert d.entries(0) == [] and d.partitions() == []
+    assert MergedDirectory.from_bytes(b"").partitions() == []
+
+
+def test_merge_store_ledger_fencing_and_finalize(tmp_path):
+    conf = TpuShuffleConf(use_cpp_runtime=False)
+    resolver = TpuShuffleBlockResolver(str(tmp_path / "s"), conf=conf)
+    store = MergeStore(resolver, conf)
+    try:
+        status, acc = store.push(1, 0, fence=5, start_partition=0,
+                                 sizes=[3, 2], data=b"abcde")
+        assert (status, acc) == (0, b"\x01\x01")
+        # duplicate / stale-fence pushes are rejected per partition
+        status, acc = store.push(1, 0, fence=4, start_partition=0,
+                                 sizes=[3, 2], data=b"XXXYY")
+        assert acc == b"\x00\x00"
+        # a NEWER fence supersedes: old bytes excluded from the final
+        # ranges, the newest attempt's bytes serve
+        status, acc = store.push(1, 0, fence=7, start_partition=0,
+                                 sizes=[3, 2], data=b"ABCDE")
+        assert acc == b"\x01\x01"
+        # second map rides partition 1 only
+        status, acc = store.push(1, 1, fence=2, start_partition=1,
+                                 sizes=[4], data=b"wxyz")
+        assert acc == b"\x01"
+        published = []
+        count = store.finalize(1, exec_index=2, publish=published.append)
+        assert count == 2 and len(published) == 2
+        by_part = {m.partition_id: m for m in published}
+        p0 = by_part[0]
+        assert p0.exec_index == 2
+        assert bitmap_members(p0.covered, 6) == [0]
+        # ledger file holds "abc" + "ABC"; only the fence-7 range serves
+        assert p0.ranges == [(3, 3)] and p0.nbytes == 3
+        import zlib
+        assert p0.crc32 == zlib.crc32(b"ABC")
+        assert resolver.read_block(1, p0.token, 3, 3) == b"ABC"
+        p1 = by_part[1]
+        assert sorted(bitmap_members(p1.covered, 6)) == [0, 1]
+        # partition 1 ledger: "de" (fence 5, superseded) + "DE" (fence
+        # 7) + "wxyz" — the adjacent surviving rows coalesce into ONE
+        # range and the superseded prefix is excluded
+        assert p1.ranges == [(2, 6)] and p1.nbytes == 6
+        assert resolver.read_block(1, p1.token, 2, 6) == b"DEwxyz"
+        # finalize is idempotent; later pushes answer FINALIZED
+        assert store.finalize(1, 2, published.append) == 0
+        from sparkrdma_tpu.parallel import messages as M
+        status, acc = store.push(1, 3, fence=1, start_partition=0,
+                                 sizes=[1], data=b"z")
+        assert status == M.STATUS_FINALIZED and acc == b"\x00"
+        # segment cap: a push that would grow a PER-PARTITION segment
+        # past the cap is rejected for exactly that partition
+        store.max_segment = 4
+        status, acc = store.push(2, 0, fence=1, start_partition=0,
+                                 sizes=[3, 3], data=b"aaabbb")
+        assert acc == b"\x01\x01"  # both segments fit 3 <= 4
+        status, acc = store.push(2, 1, fence=1, start_partition=0,
+                                 sizes=[3, 1], data=b"cccd")
+        assert acc == b"\x00\x01"  # p0 would hit 6 > 4; p1 fits 4 <= 4
+        store.drop_shuffle(1)
+        store.drop_shuffle(2)
+        assert not list((tmp_path / "s" / "merge").glob("seg_*"))
+    finally:
+        store.stop()
+        resolver.stop()
+
+
+# -- e2e cluster matrix ---------------------------------------------------
+
+
+def _cluster(tmp_path, n=3, **kw):
+    base = dict(connect_timeout_ms=10000, use_cpp_runtime=False,
+                retry_backoff_base_ms=10, retry_backoff_cap_ms=80,
+                push_merge=True, merge_replicas=1, push_deadline_ms=8000)
+    base.update(kw)
+    conf = TpuShuffleConf(**base)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(n)]
+    for ex in execs:
+        ex.executor.wait_for_members(n)
+    return driver, execs, conf
+
+
+def _shutdown(driver, execs):
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def _write_maps(driver, execs, num_maps=6, num_partitions=4, rows=400,
+                payload_w=0, shuffle_id=1):
+    handle = driver.register_shuffle(
+        shuffle_id, num_maps, num_partitions, PartitionerSpec("modulo"),
+        row_payload_bytes=payload_w)
+    for m in range(num_maps):
+        w = execs[m % len(execs)].get_writer(handle, m)
+        rng = np.random.default_rng(SEED * 1000 + m)
+        keys = rng.integers(0, 5000, rows).astype(np.uint64)
+        payload = (rng.integers(0, 255, (rows, payload_w), dtype=np.uint64)
+                   .astype(np.uint8) if payload_w else None)
+        w.write_batch(keys, payload)
+        w.close()
+    return handle
+
+
+def _ready(driver, execs, handle, timeout=15):
+    for ex in execs:
+        assert ex.pusher.drain(timeout)
+    assert wait_for_coverage(driver.driver, handle.shuffle_id,
+                             handle.num_maps, handle.num_partitions,
+                             timeout=timeout)
+
+
+def _sorted_keys(reader):
+    keys, _ = reader.read_all()
+    return np.sort(keys)
+
+
+def test_e2e_merged_read_byte_parity_and_accounting(tmp_path):
+    driver, execs, conf = _cluster(tmp_path, merge_replicas=2)
+    try:
+        handle = _write_maps(driver, execs)
+        _ready(driver, execs, handle)
+        # merged-first read
+        merged_reader = execs[0].get_reader(handle, 0, 4)
+        merged = _sorted_keys(merged_reader)
+        m = merged_reader.metrics
+        assert m.merged_reads == 4, m  # ONE wide read per partition
+        assert m.merged_fallbacks == 0 and m.failed_fetches == 0, m
+        # scattered (per-map) read of the same shuffle, same executor
+        scat_reader = TpuShuffleReader(
+            execs[0].executor, execs[0].resolver,
+            TpuShuffleConf(**dict(conf.to_dict(), push_merge=False)),
+            handle.shuffle_id, handle.num_maps, 0, 4, 0)
+        scattered = _sorted_keys(scat_reader)
+        np.testing.assert_array_equal(merged, scattered,
+                                      err_msg=f"seed={SEED}")
+        assert scat_reader.metrics.merged_reads == 0
+        # every (map, partition) served exactly once: the byte totals
+        # agree (merged bytes ALSO count as local/remote per hosting
+        # slot, so the comparable total is local + remote)
+        assert (m.remote_bytes + m.local_bytes
+                == scat_reader.metrics.remote_bytes
+                + scat_reader.metrics.local_bytes)
+    finally:
+        _shutdown(driver, execs)
+
+
+@pytest.mark.parametrize("coalesce", [True, False])
+def test_e2e_partial_coverage_mixes_merged_and_per_map(tmp_path, coalesce):
+    """A tiny merge_segment_max_bytes rejects part of the push stream:
+    partitions end up PARTIALLY covered and the reducer mixes merged
+    reads with per-map fetches of the stragglers (skip-set sealing on
+    both dataplanes) — byte-identical either way."""
+    driver, execs, conf = _cluster(
+        tmp_path, merge_replicas=1, coalesce_reads=coalesce,
+        merge_segment_max_bytes=1 << 16)
+    try:
+        # 64B rows, 500 rows/map over 4 partitions = ~8000B per (map,
+        # partition); 16 maps want ~128 KiB per partition — only ~half
+        # fit the 64 KiB segment cap, the rest are rejected
+        handle = _write_maps(driver, execs, num_maps=16, rows=500,
+                             payload_w=56)
+        for ex in execs:
+            assert ex.pusher.drain(20)
+        driver.driver.finalize_merge(handle.shuffle_id)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            d = driver.driver.merged_directory(handle.shuffle_id)
+            if d is not None and len(d.partitions()) == 4:
+                break
+            time.sleep(0.02)
+        store_snaps = [ex.executor.merge_store.snapshot() for ex in execs]
+        assert any(s["pushes_rejected"] for s in store_snaps), store_snaps
+        reader = execs[0].get_reader(handle, 0, 4)
+        merged = _sorted_keys(reader)
+        m = reader.metrics
+        assert m.merged_reads >= 1, m
+        # stragglers went per-map (remote or local short-circuit runs)
+        assert m.remote_fetches + m.local_fetches >= 1, m
+        scat = TpuShuffleReader(
+            execs[0].executor, execs[0].resolver,
+            TpuShuffleConf(**dict(conf.to_dict(), push_merge=False)),
+            handle.shuffle_id, handle.num_maps, 0, 4, 56)
+        np.testing.assert_array_equal(merged, _sorted_keys(scat),
+                                      err_msg=f"seed={SEED}")
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_e2e_split_map_range_bypasses_merged(tmp_path):
+    """A map-range-SPLIT reader (adaptive planner's split tasks) cannot
+    slice a merged segment to its map subset — it bypasses merged
+    resolution entirely and stays byte-correct."""
+    driver, execs, _conf = _cluster(tmp_path)
+    try:
+        handle = _write_maps(driver, execs)
+        _ready(driver, execs, handle)
+        lo, hi = 1, 4
+        reader = execs[0].get_reader(handle, 0, 4, map_range=(lo, hi))
+        keys = _sorted_keys(reader)
+        assert reader.metrics.merged_reads == 0
+        expected = np.sort(np.concatenate(
+            [np.random.default_rng(SEED * 1000 + m).integers(0, 5000, 400)
+             for m in range(lo, hi)]).astype(np.uint64))
+        np.testing.assert_array_equal(keys, expected,
+                                      err_msg=f"seed={SEED}")
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_e2e_warm_directory_serves_second_read_with_zero_metadata_rpcs(
+        tmp_path):
+    driver, execs, _conf = _cluster(tmp_path)
+    try:
+        handle = _write_maps(driver, execs)
+        _ready(driver, execs, handle)
+        r1 = execs[0].get_reader(handle, 0, 4)
+        first = _sorted_keys(r1)
+        assert r1.metrics.metadata_rpcs_per_stage >= 1
+        r2 = execs[0].get_reader(handle, 0, 4)
+        second = _sorted_keys(r2)
+        np.testing.assert_array_equal(first, second)
+        # table AND merged directory served from the epoch-validated
+        # cache: the warm stage touches the wire only for data
+        assert r2.metrics.metadata_rpcs_per_stage == 0, r2.metrics
+        assert r2.metrics.merged_reads == 4
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_e2e_epoch_bump_invalidates_cached_directory(tmp_path):
+    from sparkrdma_tpu.parallel import messages as M
+
+    driver, execs, _conf = _cluster(tmp_path)
+    try:
+        handle = _write_maps(driver, execs)
+        _ready(driver, execs, handle)
+        r1 = execs[0].get_reader(handle, 0, 4)
+        _sorted_keys(r1)
+        plane = execs[0].executor.location_plane
+        assert plane.snapshot()["merged"] == 1
+        epoch = driver.driver.epoch_of(handle.shuffle_id)
+        plane.note_epoch(handle.shuffle_id, epoch + 1)
+        assert plane.merged(handle.shuffle_id) is None
+        plane.note_epoch(handle.shuffle_id, M.EPOCH_DEAD)
+        assert plane.snapshot()["merged"] == 0
+    finally:
+        _shutdown(driver, execs)
+
+
+# -- tiered-spill ENOSPC overflow -----------------------------------------
+
+
+def test_overflow_spill_survives_total_enospc(tmp_path):
+    """Every local spill write fails with ENOSPC past the retry budget:
+    the spill overflows to a merge peer, the attempt COMMITS (merge
+    fetches the blob back), and the output is byte-identical to a
+    fault-free run — the failure that used to cost a WriteFailedError
+    now costs a round trip."""
+    driver, execs, _conf = _cluster(
+        tmp_path, n=2, spill_threshold_bytes=0, spill_retry_budget=1,
+        merge_replicas=1)
+    injector = StorageFaultInjector(seed=SEED)
+    injector.install()
+    try:
+        handle = driver.register_shuffle(5, 1, 4,
+                                         PartitionerSpec("modulo"))
+        injector.add(ENOSPC, op="spill_write",
+                     path_substr=str(tmp_path / "e0") + "/")
+        w = execs[0].get_writer(handle, 0)
+        rng = np.random.default_rng(SEED)
+        keys = rng.integers(0, 5000, 600).astype(np.uint64)
+        w.write_batch(keys[:300])
+        w.write_batch(keys[300:])
+        result = w.close()  # would raise WriteFailedError without overflow
+        assert result is not None
+        assert injector.fired_count(ENOSPC) >= 2
+        wm = w.write_metrics.snapshot()
+        assert wm["remote_spills"] >= 1, wm
+        assert execs[0].merge_client.overflow_spills >= 1
+        reader = execs[1].get_reader(handle, 0, 4)
+        got = _sorted_keys(reader)
+        np.testing.assert_array_equal(got, np.sort(keys),
+                                      err_msg=f"seed={SEED}")
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+# -- microbench acceptance (the merged_read_speedup secondary's gates) ----
+
+
+def test_merged_read_microbench_acceptance(tmp_path):
+    """The ISSUE's acceptance gate: merged-vs-scattered same-process A/B
+    on a many-small-maps shuffle under the per-range seek shim — >= 2x
+    per-partition fetch, requests_per_reduce ~ 1 per partition,
+    byte-identical output."""
+    from sparkrdma_tpu.shuffle.merge_bench import run_merge_microbench
+
+    res = run_merge_microbench(str(tmp_path), num_maps=24,
+                               num_partitions=8, seek_delay_s=0.002)
+    assert res["coverage_complete"], res
+    assert res["identical"], res
+    assert res["speedup"] >= 2.0, res
+    assert res["merged_reads"] == res["partitions"], res
+    assert res["requests"]["merged"] <= res["partitions"] + 2, res
+    # the seek-shape win itself: served ranges collapse M x P -> P
+    assert res["blocks_served"]["merged"] == res["partitions"], res
+    assert (res["blocks_served"]["scattered"]
+            >= res["maps"] * res["partitions"]), res

@@ -596,6 +596,28 @@ def test_coalesce_below_shuffle_boundary(ctx):
     assert sorted(counts.collect()) == [(k, 10) for k in range(6)]
 
 
+def test_coalesce_shuffle_fallback_layout_matches_narrow_ranges(ctx):
+    """When P is not a multiple of n, the shuffle fallback's routing
+    must be the EXACT inverse of the narrow path's [i*P//n, (i+1)*P//n)
+    ranges (bisect over those boundaries): parent partition 2 of P=5,
+    n=2 belongs to output partition 1 on BOTH paths (the old t*n//P
+    routing put it in 0)."""
+    P, n = 5, 2
+    parent = (ctx.parallelize([(i % P, 1) for i in range(50)], 4)
+              .reduce_by_key(lambda a, b: a + b, P))
+    parent_parts = parent.glom().collect()
+    parts = [sorted(p) for p in parent.coalesce(n).glom().collect()]
+    # narrow-path contract: output i covers parents [i*P//n, (i+1)*P//n)
+    expect = [sorted(kv for j in range(i * P // n, (i + 1) * P // n)
+                     for kv in parent_parts[j])
+              for i in range(n)]
+    assert parts == expect, parts
+    # narrow path on the same shape agrees (the documented contiguity)
+    narrow = ctx.parallelize(range(P), P).coalesce(n)
+    assert [sorted(p) for p in narrow.glom().collect()] == \
+        [list(range(i * P // n, (i + 1) * P // n)) for i in range(n)]
+
+
 def test_aggregate_by_key_mutable_zero(ctx):
     """aggregateByKey with a mutable zero ([]): each key must get its
     own accumulator (deep-copied), and value/combiner types differ."""
