@@ -464,6 +464,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("skew plan done")
     _bench_fused_exchange(detail)
     _progress("fused exchange done")
+    _bench_serve_path(detail)
+    _progress("serve path done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -660,6 +662,47 @@ def _bench_fused_exchange(detail: dict) -> None:
         detail["fused_exchange_wall_s"] = res["wall_s"]
     except Exception as e:  # noqa: BLE001
         detail["fused_exchange_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_serve_path(detail: dict) -> None:
+    """The zero-copy serve path's win, measured the way the ROADMAP asks:
+    serve-side CPU per GB served (getrusage of the serving process, the
+    client isolated in a subprocess) alongside throughput, A/B'd against
+    the old copy-and-recompute path on the same file at equal bytes —
+    byte-identical responses gated, CRC reuse measured in the checksum
+    submode (shuffle/serve_bench.py). CPU ratios count cycles, not wall
+    time, so this secondary is host-contention-robust. Pure host path —
+    identical on TPU and CPU-fallback records."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.serve_bench import run_serve_microbench
+
+        cpu, thr = {}, {}
+        for checksum, tag in ((False, "plain"), (True, "crc")):
+            with tempfile.TemporaryDirectory(prefix="servebench_") as td:
+                res = run_serve_microbench(td, checksum=checksum)
+            if not res["identical"]:
+                detail["serve_path_error"] = \
+                    f"{tag}: modes served different bytes"
+                return
+            if not res["trailer_ok"]:
+                detail["serve_path_error"] = f"{tag}: CRC trailer mismatch"
+                return
+            cpu[tag] = res["cpu_s_per_gb"]
+            thr[tag] = res["throughput_gb_s"]
+            if checksum:
+                detail["serve_crc_reused"] = res["crc_reused"]
+        detail["serve_cpu_per_gb"] = cpu
+        detail["serve_throughput"] = thr
+        detail["serve_cpu_speedup"] = (
+            round(cpu["plain"]["memcpy"] / cpu["plain"]["zero_copy"], 2)
+            if cpu["plain"]["zero_copy"] else 0.0)
+        detail["serve_cpu_speedup_crc"] = (
+            round(cpu["crc"]["memcpy"] / cpu["crc"]["zero_copy"], 2)
+            if cpu["crc"]["zero_copy"] else 0.0)
+    except Exception as e:  # noqa: BLE001
+        detail["serve_path_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _round_provenance(detail: dict) -> dict:

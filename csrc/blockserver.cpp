@@ -5,12 +5,27 @@
 // scala/RdmaShuffleFetcherIterator.scala:171-180 against mmap'd files
 // registered in java/RdmaMappedFile.java). On the DCN fallback path this
 // framework serves blocks over TCP; this server removes Python from that
-// path: connections are sharded round-robin across N epoll worker threads
-// (the reference round-robins channels across its cpuList and pins the
-// completion thread, java/RdmaNode.java:222-279 + java/RdmaThread.java:46-48)
-// serving FetchBlocks requests straight out of mmap'd spill files
-// (page cache -> socket), with the Python control plane only registering
-// (token -> file) mappings.
+// path AND keeps the per-request CPU constant-time in the bytes served
+// (the Tiara property): connections are sharded round-robin across N epoll
+// worker threads (the reference round-robins channels across its cpuList,
+// java/RdmaNode.java:222-279 + java/RdmaThread.java:46-48), and the serve
+// fast path never copies payload bytes — a response is framed as a small
+// owned header plus iovec windows straight into the registered mapping,
+// flushed with sendmsg() (writev with MSG_NOSIGNAL). The out-buffer copy
+// survives only as the CRC-trailer fallback for ranges no precomputed CRC
+// attests.
+//
+// Registered regions are a LEASE-ACCOUNTED POOL, not an eager mmap set
+// (the NP-RDMA registration-on-demand argument): bs_register_file records
+// (token -> fd, size), RETAINING the validation open's fd so the token
+// stays bound to the registered inode (a speculative re-commit renames
+// over the same path before unregistering the old token); the mapping
+// happens on first serve, LRU-unmaps under bs_set_region_budget pressure,
+// and remaps on demand from the retained fd (counted — the Python control
+// plane traces these as serve.remap). Every in-flight serve holds a refcount PIN on its
+// regions, so bs_unregister_file never unmaps under a live gather: the
+// token disappears immediately (new requests answer kStatusUnknown), the
+// munmap defers to the last unpin.
 //
 // Wire protocol: byte-compatible with sparkrdma_tpu.parallel.rpc_msg /
 // messages — frames of [total:4][type:4][payload], request type 9
@@ -22,16 +37,23 @@
 // CRC32 trailer as the Python server (FLAG_CRC32=4, one little-endian u32
 // per requested block appended after the data) so a client can isolate a
 // corrupt sub-range to one block — and therefore one map — instead of
-// refetching the whole vectored response; otherwise flags=0.
+// refetching the whole vectored response; otherwise flags=0. Trailer CRCs
+// come from the per-file table bs_set_file_crcs installs (the at-rest
+// sidecar / merge-ledger CRCs, combined with the zlib crc32_combine
+// matrix math when a request spans several attested ranges) whenever the
+// requested range aligns with attested ranges end-to-end; only unaligned
+// ranges pay the copy-and-recompute fallback.
 //
 // Exposed as a C ABI for ctypes.
 
 #include <atomic>
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -47,6 +69,7 @@
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 namespace {
@@ -56,6 +79,10 @@ constexpr uint32_t kRespType = 10;
 constexpr int32_t kStatusOk = 0;
 constexpr int32_t kStatusUnknown = 1;
 constexpr int32_t kStatusBadRange = 3;
+// Transient serve failure (messages.STATUS_ERROR): the registered file
+// could not be (re)mapped at serve time — the client's retry envelope
+// owns it, exactly like a Python-path serve-time disk error.
+constexpr int32_t kStatusError = 4;
 // Request frames on this port are tiny ([16 fixed + 16/block]); anything
 // larger than 1 MiB (~65k blocks) is a protocol violation, and capping the
 // inbound frame well below kInHighWater guarantees a parked connection can
@@ -70,14 +97,14 @@ constexpr uint64_t kMaxRespPayload = 256ull << 20;
 // Backpressure high-water marks: while the unwritten response backlog (or
 // unparsed input) exceeds these, the connection stops parsing AND stops
 // recv()ing (EPOLLIN interest is dropped), bounding per-connection memory
-// under pipelined clients instead of buffering toward kMaxFrame.
+// under pipelined clients instead of buffering toward kMaxFrame. Zero-copy
+// region windows count at their logical size: they hold region pins, and
+// fairness across connections is byte-denominated either way.
 constexpr size_t kOutHighWater = 256u << 20;
 constexpr size_t kInHighWater = 4u << 20;
-
-struct MappedFile {
-  void* base;
-  uint64_t size;
-};
+// iovec batch per sendmsg() flush: plenty for a coalesced response's
+// header + data windows + trailer, comfortably under IOV_MAX.
+constexpr int kMaxIov = 64;
 
 // CRC-32 (IEEE 802.3, the zlib polynomial) — table-driven, computed inline
 // so the shared library needs no zlib link. Must match Python's
@@ -100,13 +127,102 @@ uint32_t crc32_ieee(const uint8_t* p, size_t n) {
   return c ^ 0xFFFFFFFFu;
 }
 
+// crc32(A || B) from crc32(A), crc32(B), len(B) — zlib's crc32_combine
+// (GF(2) operator matrices for appending len(B) zero bytes). What lets a
+// request spanning several attested ranges reuse their CRCs without
+// touching a byte: O(log len) 32x32 bit-matrix ops per range, constant in
+// the bytes served. Parity with Python's utils/integrity.crc32_combine
+// (and therefore zlib) is sanitizer-harness-tested.
+uint32_t gf2_times(const uint32_t* mat, uint32_t vec) {
+  uint32_t sum = 0;
+  int i = 0;
+  while (vec) {
+    if (vec & 1) sum ^= mat[i];
+    vec >>= 1;
+    ++i;
+  }
+  return sum;
+}
+
+void gf2_square(uint32_t* square, const uint32_t* mat) {
+  for (int n = 0; n < 32; ++n) square[n] = gf2_times(mat, mat[n]);
+}
+
+uint32_t crc32_combine(uint32_t crc1, uint32_t crc2, uint64_t len2) {
+  if (len2 == 0) return crc1 ^ crc2;
+  uint32_t even[32], odd[32];
+  odd[0] = 0xEDB88320u;  // one zero BIT operator
+  uint32_t row = 1;
+  for (int n = 1; n < 32; ++n) {
+    odd[n] = row;
+    row <<= 1;
+  }
+  gf2_square(even, odd);  // two zero bits
+  gf2_square(odd, even);  // four zero bits
+  do {
+    gf2_square(even, odd);  // eight, thirty-two, ... zero bits
+    if (len2 & 1) crc1 = gf2_times(even, crc1);
+    len2 >>= 1;
+    if (!len2) break;
+    gf2_square(odd, even);
+    if (len2 & 1) crc1 = gf2_times(odd, crc1);
+    len2 >>= 1;
+  } while (len2);
+  return crc1 ^ crc2;
+}
+
 constexpr uint32_t kFlagCrc32 = 4;  // messages.FLAG_CRC32
+
+// One attested byte range of a registered file (at-rest sidecar partition
+// or merge-ledger row), sorted by offset, zero-length ranges dropped.
+struct CrcRange {
+  uint64_t off;
+  uint32_t len;
+  uint32_t crc;
+};
+
+// One registered file. Lifetime is refcounted under Server::files_mu:
+// `refs` counts the registration itself (1) plus every in-flight pin —
+// a request validating against the region, or a zero-copy out-segment
+// whose bytes are still draining to a socket. The mapping exists only
+// while serving demands it (registration-on-demand) and is torn down by
+// the LAST unpin after an unregister, never underneath a serve.
+struct Region {
+  std::string path;
+  uint64_t size = 0;
+  // The registration-time fd pins the INODE for the region's lifetime:
+  // a re-commit os.replace()s the same path before unregistering the old
+  // token (resolver.commit relies on snapshot-at-registration), so an
+  // evicted or never-mapped region must NOT reopen by path — it would
+  // serve the new attempt's bytes under the old token's offsets and CRC
+  // table. One fd per registered file, the same resource profile as the
+  // old eager per-file mmap.
+  int fd = -1;
+  void* base = nullptr;  // nullptr = registered but not currently mapped
+  int refs = 1;          // registration + in-flight pins (files_mu)
+  bool evicted = false;  // unmapped by LRU pressure; next map is a remap
+  uint64_t last_use = 0; // LRU tick of the last serve touching it
+  std::vector<CrcRange> crcs;  // sorted, disjoint; empty = no attestation
+};
+
+// One pending out-segment: either owned bytes (header, trailer, copied
+// payload) or a zero-copy window into a pinned region's mapping.
+struct OutSeg {
+  std::vector<uint8_t> buf;      // owned bytes (region == nullptr)
+  Region* region = nullptr;      // zero-copy: pinned source region
+  const uint8_t* ptr = nullptr;  // window base within the mapping
+  size_t len = 0;                // window length (owned segs: buf.size())
+  size_t off = 0;                // bytes of this segment already sent
+
+  size_t total() const { return region ? len : buf.size(); }
+  const uint8_t* data() const { return region ? ptr : buf.data(); }
+};
 
 struct Conn {
   int fd;
-  std::vector<uint8_t> in;   // accumulated unparsed bytes
-  std::vector<uint8_t> out;  // pending unwritten response bytes
-  size_t out_off = 0;
+  std::vector<uint8_t> in;  // accumulated unparsed bytes
+  std::deque<OutSeg> out;   // pending response segments, in send order
+  size_t out_bytes = 0;     // total unsent bytes across `out`
 };
 
 struct Server;
@@ -132,38 +248,369 @@ struct Server {
   std::deque<Worker> workers;
   std::atomic<uint32_t> next_worker{0};
   std::atomic<bool> stop{false};
-  std::atomic<bool> checksum{false};  // append per-block CRC32 trailers
+  std::atomic<bool> checksum{false};   // append per-block CRC32 trailers
+  std::atomic<bool> zero_copy{true};   // serve from the mapping when legal
+  // files_mu guards ONLY token lookup + region refcount/mapping/LRU
+  // bookkeeping — O(blocks) pointer work per request. No payload byte is
+  // ever touched under it, so a 256 MiB response can't serialize the
+  // other workers or block register/unregister.
   std::mutex files_mu;
-  std::unordered_map<uint32_t, MappedFile> files;
+  std::unordered_map<uint32_t, Region*> files;
+  uint64_t region_budget = 0;  // mapped-bytes budget; 0 = unbounded
+  uint64_t mapped_bytes = 0;
+  uint64_t peak_mapped_bytes = 0;
+  uint64_t lru_tick = 0;
   std::atomic<uint64_t> bytes_served{0};
   std::atomic<uint64_t> requests_served{0};
+  std::atomic<uint64_t> remaps{0};            // evicted-then-mapped again
+  std::atomic<uint64_t> zero_copy_blocks{0};  // blocks sent without a copy
+  std::atomic<uint64_t> crc_reused{0};        // trailer CRCs from the table
+  std::atomic<uint64_t> pin_events{0};        // request-level region pins
 };
 
 void set_nonblock(int fd) {
   fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
 }
 
+// -- region refcounting (all under files_mu) -------------------------------
+
+void region_unmap_locked(Server* s, Region* r) {
+  if (r->base) {
+    munmap(r->base, (size_t)r->size);
+    r->base = nullptr;
+    s->mapped_bytes -= r->size;
+  }
+}
+
+void region_unpin_locked(Server* s, Region* r) {
+  if (--r->refs == 0) {
+    region_unmap_locked(s, r);
+    if (r->fd >= 0) close(r->fd);
+    delete r;
+  }
+}
+
+void enforce_budget_locked(Server* s);
+
+// Unpin from the flush path (zero-copy windows fully drained or their
+// connection died) — the whole batch under ONE files_mu hold, so a wide
+// vectored response doesn't take the lock once per drained window. Pins
+// blocked eviction while the serve was in flight, so their release is a
+// budget edge: trim here, not only at map time, or a burst of wide
+// vectored serves would leave the pool over budget until the NEXT serve
+// happens to map something.
+void region_unpin_batch(Server* s, std::vector<Region*>& regions) {
+  if (regions.empty()) return;
+  std::lock_guard<std::mutex> lk(s->files_mu);
+  for (Region* r : regions) region_unpin_locked(s, r);
+  enforce_budget_locked(s);
+  regions.clear();
+}
+
+// LRU-unmap unpinned regions until mapped bytes fit the budget, one pass:
+// collect the unpinned mapped regions, oldest-serve first, and unmap down
+// the list until the pool fits. Pinned regions (refs > 1) are in-flight
+// and never evicted; an empty candidate set simply leaves the pool over
+// budget until pins drain.
+void enforce_budget_locked(Server* s) {
+  if (!s->region_budget || s->mapped_bytes <= s->region_budget) return;
+  std::vector<Region*> victims;
+  for (auto& [tok, r] : s->files) {
+    (void)tok;
+    if (r->base && r->refs == 1) victims.push_back(r);
+  }
+  std::sort(victims.begin(), victims.end(),
+            [](const Region* a, const Region* b) {
+              return a->last_use < b->last_use;
+            });
+  for (Region* r : victims) {
+    if (s->mapped_bytes <= s->region_budget) break;
+    r->evicted = true;
+    region_unmap_locked(s, r);
+  }
+}
+
+// Map a pinned region WITHOUT the lock: mmap can touch a slow or
+// degraded disk, and a stall under files_mu would serialize every worker
+// and all register/unregister calls — the exact disease this serve path
+// exists to cure. Maps from the registration-time fd (never by path: the
+// path may have been renamed over by a re-commit; the fd pins the
+// registered inode). The caller's pin keeps the region alive and
+// un-evictable while unlocked; installation (under the lock) resolves
+// the race of two serves mapping the same region concurrently, the
+// loser's mapping discarded. Returns MAP_FAILED on any error.
+void* map_region_file(const Region* r) {
+  if (r->fd < 0) return MAP_FAILED;
+  return mmap(nullptr, (size_t)r->size, PROT_READ, MAP_PRIVATE, r->fd, 0);
+}
+
+// CRC of [off, off+len) from the region's attested ranges, when they tile
+// the request exactly (both endpoints aligned, no holes). Zero-length
+// blocks are always 0 (zlib.crc32(b"")).
+bool crc_from_table(const Region* r, uint64_t off, uint32_t len,
+                    uint32_t* out) {
+  if (len == 0) {
+    *out = 0;
+    return true;
+  }
+  const auto& v = r->crcs;
+  if (v.empty()) return false;
+  auto it = std::lower_bound(
+      v.begin(), v.end(), off,
+      [](const CrcRange& a, uint64_t o) { return a.off < o; });
+  if (it == v.end() || it->off != off) return false;
+  uint64_t end = off + len;
+  uint64_t cur = off;
+  uint32_t crc = 0;
+  for (; it != v.end() && it->off == cur && cur + it->len <= end; ++it) {
+    crc = cur == off ? it->crc : crc32_combine(crc, it->crc, it->len);
+    cur += it->len;
+    if (cur == end) {
+      *out = crc;
+      return true;
+    }
+  }
+  return false;
+}
+
+// -- response assembly -----------------------------------------------------
+
+// Bytes to write into the connection's owned out-stream: extend the last
+// owned segment when it is at the tail (a partially-sent tail is fine —
+// `off` tracks the sent prefix), else start a new one.
+uint8_t* extend_owned(Conn* c, size_t n) {
+  if (c->out.empty() || c->out.back().region != nullptr)
+    c->out.emplace_back();
+  OutSeg& seg = c->out.back();
+  size_t base = seg.buf.size();
+  seg.buf.resize(base + n);
+  c->out_bytes += n;
+  return seg.buf.data() + base;
+}
+
+// A zero-copy window into `region`'s mapping. The segment owns one pin,
+// released when its bytes fully drain (or the connection dies).
+void append_window(Conn* c, Region* region, const uint8_t* ptr, size_t len) {
+  c->out.emplace_back();
+  OutSeg& seg = c->out.back();
+  seg.region = region;
+  seg.ptr = ptr;
+  seg.len = len;
+  c->out_bytes += len;
+}
+
 void close_conn(Worker* w, Conn* c) {
   epoll_ctl(w->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
   w->conns.erase(c->fd);
+  // release the pins of undelivered zero-copy windows (one lock hold)
+  std::vector<Region*> drained;
+  for (OutSeg& seg : c->out)
+    if (seg.region) drained.push_back(seg.region);
+  region_unpin_batch(w->server, drained);
   delete c;
 }
 
 void arm(Worker* w, Conn* c) {
-  size_t backlog = c->out.size() - c->out_off;
-  bool want_in = c->in.size() < kInHighWater && backlog < kOutHighWater;
+  bool want_in = c->in.size() < kInHighWater && c->out_bytes < kOutHighWater;
   epoll_event ev{};
-  ev.events = (want_in ? EPOLLIN : 0u) | (backlog ? EPOLLOUT : 0u);
+  ev.events = (want_in ? EPOLLIN : 0u) | (c->out_bytes ? EPOLLOUT : 0u);
   ev.data.ptr = c;
   epoll_ctl(w->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// Serve ONE validated request. `blocks` points at `count` 16-byte
+// (token, offset, length) ranges. Appends the response to c->out.
+void serve_request(Server* s, Conn* c, int64_t req_id, const uint8_t* blocks,
+                   uint32_t count, size_t plen) {
+  int32_t status = kStatusOk;
+  uint64_t resp_len = 0;
+  if (plen != 16 + (size_t)count * 16) {
+    status = kStatusBadRange;
+    count = 0;
+  }
+  // Pin + validate under ONE files_mu hold: token lookup, range checks
+  // against the registered size, LRU accounting (mapping, when needed,
+  // happens after — its disk syscalls never run under the lock).
+  // O(count) pointer work — payload bytes are copied (when at all)
+  // OUTSIDE the lock, so concurrent workers and register/unregister never
+  // serialize behind a large response. Each block's resolved Region* is
+  // recorded here: a concurrent unregister/re-register of the token
+  // cannot redirect the build phase to a different file mid-request.
+  std::vector<Region*> pinned;  // unique regions, one request-level pin each
+  std::vector<Region*> block_regions(count, nullptr);
+  std::vector<Region*> to_map;  // pinned, but unmapped at validate time
+  pinned.reserve(8);
+  // attested-CRC lookups resolve in the validate phase too: the per-file
+  // table is replaced wholesale by bs_set_file_crcs under files_mu, so
+  // reading it outside the lock would race the install. O(log ranges)
+  // pointer work per block — still no payload byte under the lock.
+  bool crc_mode = s->checksum.load(std::memory_order_relaxed);
+  std::vector<uint32_t> table_crcs(crc_mode ? count : 0, 0);
+  std::vector<uint8_t> crc_hit(crc_mode ? count : 0, 0);
+  {
+    std::lock_guard<std::mutex> lk(s->files_mu);
+    uint64_t tick = ++s->lru_tick;
+    for (uint32_t i = 0; i < count && status == kStatusOk; ++i) {
+      uint32_t token, length;
+      uint64_t offset;
+      memcpy(&token, blocks + i * 16, 4);
+      memcpy(&offset, blocks + i * 16 + 4, 8);
+      memcpy(&length, blocks + i * 16 + 12, 4);
+      auto it = s->files.find(token);
+      if (it == s->files.end()) {
+        status = kStatusUnknown;
+      } else if (offset > it->second->size ||
+                 length > it->second->size - offset) {
+        status = kStatusBadRange;
+      } else {
+        resp_len += length;
+        Region* r = it->second;
+        block_regions[i] = r;
+        if (crc_mode)
+          crc_hit[i] = crc_from_table(r, offset, length, &table_crcs[i]);
+        if (r->last_use != tick) {  // first touch by this request
+          r->last_use = tick;
+          ++r->refs;
+          pinned.push_back(r);
+          s->pin_events.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+    }
+    if (resp_len > kMaxRespPayload && status == kStatusOk)
+      status = kStatusBadRange;
+    if (status != kStatusOk) {
+      for (Region* r : pinned) region_unpin_locked(s, r);
+      pinned.clear();
+      resp_len = 0;
+    }
+    if (status == kStatusOk) {
+      for (Region* r : pinned)
+        if (!r->base && r->size) to_map.push_back(r);
+    }
+  }
+  // Registration-on-demand: (re)map pinned regions whose mapping was
+  // evicted or never materialized — syscalls OUTSIDE the lock (see
+  // map_region_file; only the immutable path/size are touched unlocked),
+  // installation under it.
+  if (status == kStatusOk) {
+    std::vector<std::pair<Region*, void*>> fresh;
+    bool map_failed = false;
+    for (Region* r : to_map) {
+      void* base = map_region_file(r);
+      if (base == MAP_FAILED) {
+        map_failed = true;
+        break;
+      }
+      fresh.emplace_back(r, base);
+    }
+    if (map_failed || !fresh.empty()) {
+      std::lock_guard<std::mutex> lk(s->files_mu);
+      for (auto& [r, base] : fresh) {
+        if (r->base) {  // a concurrent serve won the install race
+          munmap(base, (size_t)r->size);
+          continue;
+        }
+        r->base = base;
+        s->mapped_bytes += r->size;
+        if (s->mapped_bytes > s->peak_mapped_bytes)
+          s->peak_mapped_bytes = s->mapped_bytes;
+        if (r->evicted) {
+          r->evicted = false;
+          s->remaps.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+      if (map_failed) {
+        status = kStatusError;  // transient: the client retries
+        for (Region* r : pinned) region_unpin_locked(s, r);
+        pinned.clear();
+        resp_len = 0;
+      }
+      enforce_budget_locked(s);
+    }
+  }
+  // frame: [total][type][req_id q][status i][flags i][data][crc32*]
+  bool crc = crc_mode && status == kStatusOk && count > 0;
+  bool zc = s->zero_copy.load(std::memory_order_relaxed) &&
+            status == kStatusOk;
+  size_t trailer = crc ? (size_t)count * 4 : 0;
+  uint32_t out_total = (uint32_t)(8 + 16 + resp_len + trailer);
+  uint8_t* o = extend_owned(c, 24);
+  memcpy(o, &out_total, 4);
+  memcpy(o + 4, &kRespType, 4);
+  memcpy(o + 8, &req_id, 8);
+  memcpy(o + 16, &status, 4);
+  uint32_t flags = crc ? kFlagCrc32 : 0;
+  memcpy(o + 20, &flags, 4);
+  if (status != kStatusOk) return;
+  std::vector<uint32_t> crcs(crc ? count : 0);
+  std::vector<std::pair<Region*, int>> window_pins;  // extra refs to take
+  window_pins.reserve(8);
+  uint64_t zc_blocks = 0;
+  for (uint32_t i = 0; i < count; ++i) {
+    uint32_t token, length;
+    uint64_t offset;
+    memcpy(&token, blocks + i * 16, 4);
+    memcpy(&offset, blocks + i * 16 + 4, 8);
+    memcpy(&length, blocks + i * 16 + 12, 4);
+    (void)token;
+    if (length == 0) {
+      if (crc) crcs[i] = 0;
+      continue;
+    }
+    // the pinned snapshot from the validate phase: stable without the
+    // lock (base can't be unmapped while refs > 1), and immune to a
+    // concurrent unregister/re-register of the token; CRC-table answers
+    // were resolved there too (the table itself isn't lock-free)
+    Region* src = block_regions[i];
+    const uint8_t* base = (const uint8_t*)src->base + offset;
+    bool have_crc = crc && crc_hit[i];
+    if (zc && (!crc || have_crc)) {
+      append_window(c, src, base, length);
+      window_pins.emplace_back(src, 1);
+      zc_blocks += 1;
+      if (crc) {
+        crcs[i] = table_crcs[i];
+        s->crc_reused.fetch_add(1, std::memory_order_relaxed);
+      }
+    } else {
+      // CRC-trailer fallback (or zero-copy disabled): one copy into the
+      // owned stream; the checksum covers this server's own read+copy
+      uint8_t* dst = extend_owned(c, length);
+      memcpy(dst, base, length);
+      if (crc) {
+        if (have_crc) {
+          crcs[i] = table_crcs[i];
+          s->crc_reused.fetch_add(1, std::memory_order_relaxed);
+        } else {
+          crcs[i] = crc32_ieee(dst, length);
+        }
+      }
+    }
+  }
+  if (crc) {
+    uint8_t* t = extend_owned(c, trailer);
+    memcpy(t, crcs.data(), trailer);
+  }
+  // transfer pins: each zero-copy window takes its own reference, the
+  // request-level pins release — one files_mu acquisition for the batch.
+  // Releasing pins is a budget edge (evictions they blocked can go now).
+  {
+    std::lock_guard<std::mutex> lk(s->files_mu);
+    for (auto& [r, n] : window_pins) r->refs += n;
+    for (Region* r : pinned) region_unpin_locked(s, r);
+    enforce_budget_locked(s);
+  }
+  s->bytes_served += resp_len;
+  s->requests_served += 1;
+  s->zero_copy_blocks.fetch_add(zc_blocks, std::memory_order_relaxed);
 }
 
 // Parse + serve every complete frame in c->in; append responses to c->out.
 bool process_frames(Server* s, Conn* c) {
   size_t pos = 0;
   while (c->in.size() - pos >= 8) {
-    if (c->out.size() - c->out_off > kOutHighWater) break;  // backpressure
+    if (c->out_bytes > kOutHighWater) break;  // backpressure
     uint32_t total, type;
     memcpy(&total, c->in.data() + pos, 4);
     memcpy(&type, c->in.data() + pos + 4, 4);
@@ -175,81 +622,59 @@ bool process_frames(Server* s, Conn* c) {
     // protocol violation — drop the connection so the client fails fast
     // (a TransportError) instead of timing out on a silently-ignored frame
     if (type != kReqType || plen < 16) return false;
-    {
-      int64_t req_id;
-      uint32_t count;
-      memcpy(&req_id, p, 8);
-      // p+8..12: shuffle_id (unused server-side: tokens are global)
-      memcpy(&count, p + 12, 4);
-      const uint8_t* blocks = p + 16;
-      int32_t status = kStatusOk;
-      uint64_t resp_len = 0;
-      if (plen != 16 + (size_t)count * 16) {
-        status = kStatusBadRange;
-        count = 0;
-      }
-      std::lock_guard<std::mutex> lk(s->files_mu);
-      // validate + size pass
-      for (uint32_t i = 0; i < count && status == kStatusOk; ++i) {
-        uint32_t token, length;
-        uint64_t offset;
-        memcpy(&token, blocks + i * 16, 4);
-        memcpy(&offset, blocks + i * 16 + 4, 8);
-        memcpy(&length, blocks + i * 16 + 12, 4);
-        auto it = s->files.find(token);
-        if (it == s->files.end()) {
-          status = kStatusUnknown;
-        } else if (offset > it->second.size ||
-                   length > it->second.size - offset) {
-          status = kStatusBadRange;
-        } else {
-          resp_len += length;
-        }
-      }
-      if (resp_len > kMaxRespPayload && status == kStatusOk)
-        status = kStatusBadRange;
-      if (status != kStatusOk) resp_len = 0;
-      // frame: [total][type][req_id q][status i][flags i][data][crc32*]
-      bool crc = s->checksum.load(std::memory_order_relaxed) &&
-                 status == kStatusOk && count > 0;
-      size_t trailer = crc ? (size_t)count * 4 : 0;
-      uint32_t out_total = (uint32_t)(8 + 16 + resp_len + trailer);
-      size_t base = c->out.size();
-      c->out.resize(base + out_total);
-      uint8_t* o = c->out.data() + base;
-      memcpy(o, &out_total, 4);
-      memcpy(o + 4, &kRespType, 4);
-      memcpy(o + 8, &req_id, 8);
-      memcpy(o + 16, &status, 4);
-      uint32_t flags = crc ? kFlagCrc32 : 0;
-      memcpy(o + 20, &flags, 4);
-      uint8_t* data = o + 24;
-      uint8_t* crcs = o + 24 + resp_len;
-      if (status == kStatusOk) {
-        for (uint32_t i = 0; i < count; ++i) {
-          uint32_t token, length;
-          uint64_t offset;
-          memcpy(&token, blocks + i * 16, 4);
-          memcpy(&offset, blocks + i * 16 + 4, 8);
-          memcpy(&length, blocks + i * 16 + 12, 4);
-          const MappedFile& f = s->files.at(token);
-          memcpy(data, (const char*)f.base + offset, length);
-          if (crc) {
-            // checksum the RESPONSE copy, not the mapped file: the check
-            // must cover this server's own read+copy, end to end
-            uint32_t sum = crc32_ieee(data, length);
-            memcpy(crcs + (size_t)i * 4, &sum, 4);
-          }
-          data += length;
-        }
-        s->bytes_served += resp_len;
-        s->requests_served += 1;
-      }
-    }
+    int64_t req_id;
+    uint32_t count;
+    memcpy(&req_id, p, 8);
+    // p+8..12: shuffle_id (unused server-side: tokens are global)
+    memcpy(&count, p + 12, 4);
+    serve_request(s, c, req_id, p + 16, count, plen);
     pos += total;
   }
   if (pos) c->in.erase(c->in.begin(), c->in.begin() + pos);
   return true;
+}
+
+// Flush pending segments with one gathered sendmsg per syscall (writev
+// with MSG_NOSIGNAL): owned headers/trailers and mapped-region windows
+// interleave in a single iovec batch. Returns false on a dead socket.
+bool flush_out(Server* s, Conn* c) {
+  std::vector<Region*> drained;  // window pins released in one batch below
+  bool alive = true;
+  while (c->out_bytes) {
+    iovec iov[kMaxIov];
+    int n = 0;
+    for (const OutSeg& seg : c->out) {
+      if (n == kMaxIov) break;
+      size_t rem = seg.total() - seg.off;
+      if (rem == 0) continue;
+      iov[n].iov_base = (void*)(seg.data() + seg.off);
+      iov[n].iov_len = rem;
+      ++n;
+    }
+    msghdr mh{};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = (size_t)n;
+    ssize_t sent = sendmsg(c->fd, &mh, MSG_NOSIGNAL);
+    if (sent < 0) {
+      alive = errno == EAGAIN || errno == EWOULDBLOCK;
+      break;
+    }
+    c->out_bytes -= (size_t)sent;
+    size_t left = (size_t)sent;
+    while (left && !c->out.empty()) {
+      OutSeg& seg = c->out.front();
+      size_t rem = seg.total() - seg.off;
+      size_t take = rem < left ? rem : left;
+      seg.off += take;
+      left -= take;
+      if (seg.off == seg.total()) {
+        if (seg.region) drained.push_back(seg.region);
+        c->out.pop_front();
+      }
+    }
+  }
+  region_unpin_batch(s, drained);
+  return alive;
 }
 
 void worker_loop(Worker* w) {
@@ -295,23 +720,13 @@ void worker_loop(Worker* w) {
         }
         if (!dead && !process_frames(s, c)) dead = true;
       }
-      if (!dead && c->out.size() > c->out_off) {
-        while (c->out.size() > c->out_off) {
-          ssize_t w2 = send(c->fd, c->out.data() + c->out_off,
-                            c->out.size() - c->out_off, MSG_NOSIGNAL);
-          if (w2 > 0) {
-            c->out_off += (size_t)w2;
-          } else {
-            if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true;
-            break;
-          }
-        }
-        if (c->out_off == c->out.size()) {
-          c->out.clear();
-          c->out_off = 0;
+      if (!dead && c->out_bytes) {
+        if (!flush_out(s, c)) dead = true;
+        if (!dead && c->out_bytes == 0) {
           // backlog drained: serve any requests parked by the high-water
           // mark while we were blocked on the socket
           if (!c->in.empty() && !process_frames(s, c)) dead = true;
+          if (!dead && c->out_bytes && !flush_out(s, c)) dead = true;
         }
       }
       if (dead) {
@@ -462,7 +877,27 @@ void bs_set_checksum(void* handle, int enabled) {
   ((Server*)handle)->checksum.store(enabled != 0);
 }
 
-// mmap `path` and serve it under `token`. Returns 0 on success.
+// Toggle the zero-copy serve path (serve_zero_copy config key). Off =
+// every block pays the copy-and-recompute fallback — the regression
+// escape hatch and the A/B baseline the serve bench measures against.
+void bs_set_zero_copy(void* handle, int enabled) {
+  ((Server*)handle)->zero_copy.store(enabled != 0);
+}
+
+// Mapped-bytes budget for the registered-region pool (the
+// registered_region_budget config key). 0 = unbounded. Past it, the
+// least-recently-served unpinned mappings unmap; a later serve remaps on
+// demand (counted by bs_remaps).
+void bs_set_region_budget(void* handle, uint64_t budget) {
+  Server* s = (Server*)handle;
+  std::lock_guard<std::mutex> lk(s->files_mu);
+  s->region_budget = budget;
+  enforce_budget_locked(s);
+}
+
+// Register `path` for serving under `token` — registration-on-demand: the
+// file is validated (open/fstat) but NOT mapped; the first serve maps it.
+// Returns 0 on success.
 int bs_register_file(void* handle, uint32_t token, const char* path) {
   Server* s = (Server*)handle;
   int fd = open(path, O_RDONLY);
@@ -472,30 +907,52 @@ int bs_register_file(void* handle, uint32_t token, const char* path) {
     close(fd);
     return -1;
   }
-  void* base = nullptr;
-  if (st.st_size > 0) {
-    base = mmap(nullptr, (size_t)st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
-    if (base == MAP_FAILED) {
-      close(fd);
-      return -1;
-    }
-  }
-  close(fd);
+  Region* r = new Region();
+  r->path = path;
+  r->size = (uint64_t)st.st_size;
+  r->fd = fd;  // retained: pins the inode against rename-over re-commits
   std::lock_guard<std::mutex> lk(s->files_mu);
   auto it = s->files.find(token);
-  if (it != s->files.end() && it->second.base)
-    munmap(it->second.base, it->second.size);
-  s->files[token] = MappedFile{base, (uint64_t)st.st_size};
+  if (it != s->files.end())
+    region_unpin_locked(s, it->second);  // replace: old region drains out
+  s->files[token] = r;
   return 0;
 }
 
+// Attach attested CRC ranges (at-rest sidecar partitions / merge-ledger
+// rows) to a registered token: ranges[i] = (offsets[i], lengths[i]) with
+// CRC32 crcs[i]. Serves whose blocks tile these ranges exactly reuse the
+// CRCs instead of recomputing — and may therefore stay zero-copy with
+// trailers on. Returns 0 on success, -1 for an unknown token.
+int bs_set_file_crcs(void* handle, uint32_t token, const uint64_t* offsets,
+                     const uint32_t* lengths, const uint32_t* crcs,
+                     uint32_t n) {
+  Server* s = (Server*)handle;
+  std::lock_guard<std::mutex> lk(s->files_mu);
+  auto it = s->files.find(token);
+  if (it == s->files.end()) return -1;
+  std::vector<CrcRange> v;
+  v.reserve(n);
+  for (uint32_t i = 0; i < n; ++i)
+    if (lengths[i] > 0) v.push_back({offsets[i], lengths[i], crcs[i]});
+  std::sort(v.begin(), v.end(),
+            [](const CrcRange& a, const CrcRange& b) { return a.off < b.off; });
+  it->second->crcs = std::move(v);
+  return 0;
+}
+
+// Unregister: the token disappears immediately (new requests answer
+// kStatusUnknown); the mapping itself lives until the last in-flight pin
+// (a serving request or a draining zero-copy window) releases — an
+// unregister during an in-flight serve is safe by construction.
 int bs_unregister_file(void* handle, uint32_t token) {
   Server* s = (Server*)handle;
   std::lock_guard<std::mutex> lk(s->files_mu);
   auto it = s->files.find(token);
   if (it == s->files.end()) return -1;
-  if (it->second.base) munmap(it->second.base, it->second.size);
+  Region* r = it->second;
   s->files.erase(it);
+  region_unpin_locked(s, r);
   return 0;
 }
 
@@ -505,6 +962,48 @@ uint64_t bs_bytes_served(void* handle) {
 
 uint64_t bs_requests_served(void* handle) {
   return ((Server*)handle)->requests_served.load();
+}
+
+// -- registered-region pool gauges (the leased_bytes-style accounting the
+// Python control plane surfaces and traces) ------------------------------
+
+uint64_t bs_mapped_bytes(void* handle) {
+  Server* s = (Server*)handle;
+  std::lock_guard<std::mutex> lk(s->files_mu);
+  return s->mapped_bytes;
+}
+
+uint64_t bs_peak_mapped_bytes(void* handle) {
+  Server* s = (Server*)handle;
+  std::lock_guard<std::mutex> lk(s->files_mu);
+  return s->peak_mapped_bytes;
+}
+
+uint64_t bs_registered_bytes(void* handle) {
+  Server* s = (Server*)handle;
+  std::lock_guard<std::mutex> lk(s->files_mu);
+  uint64_t total = 0;
+  for (auto& [tok, r] : s->files) {
+    (void)tok;
+    total += r->size;
+  }
+  return total;
+}
+
+uint64_t bs_remaps(void* handle) {
+  return ((Server*)handle)->remaps.load();
+}
+
+uint64_t bs_zero_copy_blocks(void* handle) {
+  return ((Server*)handle)->zero_copy_blocks.load();
+}
+
+uint64_t bs_crc_reused(void* handle) {
+  return ((Server*)handle)->crc_reused.load();
+}
+
+uint64_t bs_pin_events(void* handle) {
+  return ((Server*)handle)->pin_events.load();
 }
 
 void bs_stop(void* handle) {
@@ -518,6 +1017,10 @@ void bs_stop(void* handle) {
     if (w.th.joinable()) w.th.join();
     for (auto& [fd, c] : w.conns) {
       close(c->fd);
+      std::vector<Region*> drained;
+      for (OutSeg& seg : c->out)
+        if (seg.region) drained.push_back(seg.region);
+      region_unpin_batch(s, drained);
       delete c;
     }
     w.conns.clear();
@@ -528,8 +1031,12 @@ void bs_stop(void* handle) {
   }
   {
     std::lock_guard<std::mutex> lk(s->files_mu);
-    for (auto& [tok, f] : s->files)
-      if (f.base) munmap(f.base, f.size);
+    for (auto& [tok, r] : s->files) {
+      (void)tok;
+      region_unmap_locked(s, r);
+      if (r->fd >= 0) close(r->fd);
+      delete r;
+    }
     s->files.clear();
   }
   destroy(s);

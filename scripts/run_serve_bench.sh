@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Serve-path sweep: the zero-copy serve path's test matrix
+# (tests/test_serve_path.py — native-vs-Python byte identity on both
+# coalesce dataplanes, CRC-reuse parity, LRU remap under budget,
+# unregister-during-serve safety, the CPU-per-GB acceptance gate)
+# across a set of extra seeds, then the serve microbench with its
+# acceptance gates: >= 2x lower serve-side CPU per GB than the memcpy
+# path at equal-or-better throughput, byte-identical responses with CRC
+# on and off. A red seed replays exactly:
+#
+#     SERVE_SEED=<seed> python -m pytest tests/test_serve_path.py
+#
+# Usage: scripts/run_serve_bench.sh [seed ...]
+#   SERVE_SEEDS="0 1 2"   alternative way to pass the seed list
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SEEDS=${*:-${SERVE_SEEDS:-"0 7 42"}}
+failed=()
+for seed in $SEEDS; do
+  echo "=== serve sweep: seed ${seed} ==="
+  if ! SERVE_SEED="${seed}" JAX_PLATFORMS=cpu \
+       python -m pytest tests/test_serve_path.py -q \
+         -p no:cacheprovider -p no:randomly; then
+    echo "!!! seed ${seed} FAILED — replay with:"
+    echo "    SERVE_SEED=${seed} python -m pytest tests/test_serve_path.py"
+    failed+=("${seed}")
+  fi
+done
+
+echo "=== serve microbench (CPU-per-GB acceptance) ==="
+if ! JAX_PLATFORMS=cpu python - <<'EOF'
+import json, sys, tempfile
+from sparkrdma_tpu.shuffle.serve_bench import run_serve_microbench
+
+ok = True
+for checksum in (False, True):
+    with tempfile.TemporaryDirectory(prefix="servebench_") as td:
+        res = run_serve_microbench(td, total_mb=512, checksum=checksum)
+    print(json.dumps(res))
+    thr = res["throughput_gb_s"]
+    ok = (ok and res["identical"] and res["trailer_ok"]
+          and res["cpu_speedup"] >= 2.0
+          and thr["zero_copy"] >= 0.95 * thr["memcpy"])
+sys.exit(0 if ok else 1)
+EOF
+then
+  echo "!!! serve microbench FAILED its acceptance gates"
+  failed+=("microbench")
+fi
+
+if [ ${#failed[@]} -gt 0 ]; then
+  echo "serve sweep: FAILURES: ${failed[*]}"
+  exit 1
+fi
+echo "serve sweep: all green"

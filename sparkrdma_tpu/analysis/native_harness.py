@@ -62,6 +62,19 @@ def _load(path: str) -> ctypes.CDLL:
     lib.bs_unregister_file.restype = ctypes.c_int
     lib.bs_stop.argtypes = [vp]
     lib.bs_stop.restype = None
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.bs_set_zero_copy.argtypes = [vp, ctypes.c_int]
+    lib.bs_set_zero_copy.restype = None
+    lib.bs_set_region_budget.argtypes = [vp, u64]
+    lib.bs_set_region_budget.restype = None
+    lib.bs_set_file_crcs.argtypes = [vp, ctypes.c_uint32,
+                                     ctypes.POINTER(u64), u32p, u32p,
+                                     ctypes.c_uint32]
+    lib.bs_set_file_crcs.restype = ctypes.c_int
+    for fn in ("bs_mapped_bytes", "bs_remaps", "bs_zero_copy_blocks",
+               "bs_crc_reused", "bs_pin_events"):
+        getattr(lib, fn).argtypes = [vp]
+        getattr(lib, fn).restype = u64
     return lib
 
 
@@ -223,6 +236,143 @@ def exercise_block_server(lib) -> None:
         os.unlink(path)
 
 
+def exercise_zero_copy_serve(lib) -> None:
+    """The one-sided serve path under sanitizers: zero-copy vectored
+    responses (bytes must still be exact), CRC-trailer reuse from an
+    attested-range table (incl. the crc32_combine matrix math, checked
+    against zlib), LRU eviction + remap under a registered-region
+    budget, and the register/unregister-during-in-flight-vectored-serve
+    race that refcount pinning exists for (a munmap under a draining
+    response is a guaranteed ASan use-after-poison)."""
+    print("zero-copy serve path:")
+    import threading
+
+    datas = {t: bytes(((i * (t + 3) + 7) % 256)
+                      for i in range(1 << 16)) for t in (1, 2, 3)}
+    paths = {}
+    for t, data in datas.items():
+        with tempfile.NamedTemporaryFile(suffix=f".zc{t}", delete=False) as f:
+            f.write(data)
+            paths[t] = f.name
+    server = lib.bs_create(b"127.0.0.1", 0, 2, None, 0)
+    try:
+        _check(bool(server), "bs_create")
+        port = lib.bs_port(server)
+        for t in datas:
+            _check(lib.bs_register_file(server, t, paths[t].encode()) == 0,
+                   f"register token {t}")
+
+        sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            # zero-copy vectored read spanning tokens, no checksum
+            blocks = [(1, 0, 4096), (2, 100, 0), (3, 1024, 2048),
+                      (1, len(datas[1]) - 9, 9)]
+            want = b"".join(datas[t][o:o + ln] for t, o, ln in blocks)
+            resp = _fetch(sock, 1, 0, blocks)
+            _check(resp.status == M.STATUS_OK and resp.data == want,
+                   "zero-copy vectored read: payload bytes exact")
+            _check(lib.bs_zero_copy_blocks(server) >= 3,
+                   "zero-copy blocks counted")
+
+            # CRC reuse: attest token 1 as four 16 KiB ranges; aligned
+            # reads must reuse (combine included), unaligned recompute —
+            # trailers verify against zlib either way
+            n_ranges, rlen = 4, 1 << 14
+            offs = (ctypes.c_uint64 * n_ranges)(*(i * rlen
+                                                  for i in range(n_ranges)))
+            lens = (ctypes.c_uint32 * n_ranges)(*([rlen] * n_ranges))
+            crcs = (ctypes.c_uint32 * n_ranges)(
+                *(zlib.crc32(datas[1][i * rlen:(i + 1) * rlen])
+                  for i in range(n_ranges)))
+            _check(lib.bs_set_file_crcs(server, 1, offs, lens, crcs,
+                                        n_ranges) == 0, "bs_set_file_crcs")
+            lib.bs_set_checksum(server, 1)
+            blocks = [(1, 0, rlen),            # exact range -> reuse
+                      (1, 0, 2 * rlen),        # two ranges -> combine
+                      (1, 0, 4 * rlen),        # whole file -> combine
+                      (1, 7, 100),             # unaligned -> recompute
+                      (2, 0, 512)]             # unattested -> recompute
+            reused_before = lib.bs_crc_reused(server)
+            resp = _fetch(sock, 2, 0, blocks)
+            _check(resp.status == M.STATUS_OK
+                   and resp.flags & M.FLAG_CRC32, "CRC serve: OK + flag")
+            body_len = sum(ln for _, _, ln in blocks)
+            body, trailer = resp.data[:body_len], resp.data[body_len:]
+            want = b"".join(datas[t][o:o + ln] for t, o, ln in blocks)
+            _check(body == want, "CRC serve: payload bytes exact")
+            got = struct.unpack(f"<{len(blocks)}I", trailer)
+            pos, ok = 0, True
+            for (_, _, ln), crc in zip(blocks, got):
+                ok = ok and crc == zlib.crc32(body[pos:pos + ln])
+                pos += ln
+            _check(ok, "CRC trailers (reused + combined + recomputed) "
+                       "all match zlib")
+            _check(lib.bs_crc_reused(server) == reused_before + 3,
+                   "exactly the aligned blocks reused attested CRCs")
+            lib.bs_set_checksum(server, 0)
+
+            # budget pressure: with room for ~one file, alternating
+            # tokens must evict + remap, bytes staying exact
+            lib.bs_set_region_budget(server, len(datas[1]) + 1024)
+            for r in range(6):
+                t = (r % 3) + 1
+                resp = _fetch(sock, 10 + r, 0, [(t, 128, 4096)])
+                _check(resp.status == M.STATUS_OK
+                       and resp.data == datas[t][128:128 + 4096],
+                       f"over-budget serve {r} (token {t}) byte-exact")
+            _check(lib.bs_remaps(server) >= 2, "LRU evictions remapped")
+            _check(lib.bs_mapped_bytes(server) <= len(datas[1]) + 1024,
+                   "mapped bytes within budget after serves")
+            lib.bs_set_region_budget(server, 0)
+        finally:
+            sock.close()
+
+        # register/unregister storm during in-flight vectored serves:
+        # pins must keep every draining response's mapping alive
+        stop = threading.Event()
+
+        def churn():
+            import time
+            while not stop.is_set():
+                lib.bs_unregister_file(server, 3)
+                lib.bs_register_file(server, 3, paths[3].encode())
+                # let serves land mid-registration so the unregister
+                # races DRAINING zero-copy windows, not just lookups
+                time.sleep(0.0002)
+            lib.bs_register_file(server, 3, paths[3].encode())
+
+        th = threading.Thread(target=churn)
+        th.start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            try:
+                okc = unkc = 0
+                for r in range(300):
+                    blocks = [(3, 0, 8192), (1, 0, 64), (3, 4096, 8192)]
+                    resp = _fetch(sock, 100 + r, 0, blocks)
+                    if resp.status == M.STATUS_OK:
+                        want = b"".join(datas[t][o:o + ln]
+                                        for t, o, ln in blocks)
+                        assert resp.data == want, "served bytes diverged"
+                        okc += 1
+                    else:
+                        assert resp.status == M.STATUS_UNKNOWN_SHUFFLE
+                        unkc += 1
+                _check(okc > 0,
+                       f"serves landed through the churn ({okc} ok, "
+                       f"{unkc} unknown)")
+            finally:
+                sock.close()
+        finally:
+            stop.set()
+            th.join()
+        _check(lib.bs_pin_events(server) > 0, "region pins counted")
+    finally:
+        lib.bs_stop(server)
+        for p in paths.values():
+            os.unlink(p)
+
+
 def main(argv) -> int:
     so = (argv[0] if argv else
           os.environ.get("TPU_SHUFFLE_SANITIZER_SO", ""))
@@ -234,6 +384,7 @@ def main(argv) -> int:
     lib = _load(so)
     exercise_writer_scatter(lib)
     exercise_block_server(lib)
+    exercise_zero_copy_serve(lib)
     print("native harness: all exercises passed")
     return 0
 

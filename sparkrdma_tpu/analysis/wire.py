@@ -338,6 +338,7 @@ def _mirror_spec() -> Dict[str, Dict[str, Callable[[], int]]]:
             "kStatusOk": lambda: M.STATUS_OK,
             "kStatusUnknown": lambda: M.STATUS_UNKNOWN_SHUFFLE,
             "kStatusBadRange": lambda: M.STATUS_BAD_RANGE,
+            "kStatusError": lambda: M.STATUS_ERROR,
             "kMaxReqFrame": lambda: M.NATIVE_MAX_REQ_FRAME,
             "kFlagCrc32": lambda: M.FLAG_CRC32,
         },
@@ -350,6 +351,8 @@ _IGNORED_NATIVE = {
                             # it as kStatusBadRange, never plan against it
         "kOutHighWater",    # per-connection outbound buffering threshold
         "kInHighWater",     # inbound buffering threshold
+        "kMaxIov",          # iovec batch per sendmsg flush, never on the
+                            # wire (IOV_MAX-bounded server tuning)
     },
     "arena.cpp": {
         "kMaxRegion",       # allocator carve-region size, never on the wire

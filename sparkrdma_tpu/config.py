@@ -192,6 +192,28 @@ _KEYS = [
     _Key("block_server_cpus", "", "str",
          doc="Comma-separated cores to pin block-server workers to; empty = "
              "no pinning (ref cpuList + java/RdmaThread.java:46-48)."),
+    _Key("registered_region_budget", 0, "bytes", 0, 1 << 44,
+         doc="Mapped-bytes budget of the native block server's "
+             "registered-region pool. Committed outputs, merged segments "
+             "and external tokens register by path (one open/fstat) and "
+             "mmap on FIRST SERVE — registration-on-demand instead of "
+             "eager mmap-at-commit; past the budget the least-recently-"
+             "served unpinned mappings unmap (LRU) and remap on demand "
+             "(serve.remap instants, bs stats 'remaps'). In-flight serves "
+             "hold refcount pins, so eviction and unregister never unmap "
+             "under a live read. 0 = unbounded (every registered file may "
+             "stay mapped, the pre-pool behavior minus the eager map)."),
+    _Key("serve_zero_copy", True, "bool",
+         doc="Native serve fast path: responses frame as a small header "
+             "plus sendmsg/writev windows STRAIGHT from the registered "
+             "mapping — constant server CPU per request regardless of "
+             "bytes served. With CRC trailers on, a block whose range "
+             "tiles the at-rest sidecar / merge-ledger attested ranges "
+             "reuses the committed CRC32s (crc32_combine across ranges) "
+             "and stays zero-copy; unaligned ranges fall back to "
+             "copy-and-recompute per block. Off = always copy (the "
+             "regression escape hatch and the serve bench's memcpy "
+             "baseline; responses byte-identical either way)."),
     _Key("task_threads", 4, "int", 1, 1024,
          doc="Worker threads for shipped engine tasks per executor "
              "(Spark's executor task slots analogue)."),

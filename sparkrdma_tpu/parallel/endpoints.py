@@ -1842,12 +1842,21 @@ class ExecutorEndpoint:
             # per-block CRC32 trailer, appended BEFORE compression/codec
             # so the check spans server read -> client consume (a zlib or
             # codec layer already fails loudly on ITS OWN wire bytes, but
-            # says nothing about corruption before the encode)
+            # says nothing about corruption before the encode). Blocks
+            # whose range tiles the at-rest sidecar's attested ranges
+            # reuse the committed CRCs (resolver.block_crc — the same
+            # contract the native server's CRC table implements in C)
+            # instead of re-hashing the bytes on every serve.
             import struct
             import zlib
             flags |= M.FLAG_CRC32
-            payload += struct.pack(f"<{len(parts)}I",
-                                   *(zlib.crc32(p) for p in parts))
+            attested = getattr(self.data_source, "block_crc", None)
+            crcs = []
+            for (token, offset, length), p in zip(msg.blocks, parts):
+                crc = (attested(msg.shuffle_id, token, offset, length)
+                       if attested is not None else None)
+                crcs.append(zlib.crc32(p) if crc is None else crc)
+            payload += struct.pack(f"<{len(parts)}I", *crcs)
         # DCN wire compression — the analogue of the engine-level shuffle
         # block compression the reference inherits from Spark's serializer
         # (scala/RdmaShuffleReader.scala:54-69 wraps streams the same way).

@@ -81,6 +81,26 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     if hasattr(lib, "bs_set_checksum"):
         lib.bs_set_checksum.argtypes = [vp, ctypes.c_int]
         lib.bs_set_checksum.restype = None
+    # optional symbols: the one-sided serve path (zero-copy responses,
+    # registration-on-demand region pool, CRC-reuse tables). A pre-serve-
+    # path .so degrades to its eager-mmap copy behavior; the Python
+    # control plane guards each call with has_serve_path().
+    if hasattr(lib, "bs_set_zero_copy"):
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.bs_set_zero_copy.argtypes = [vp, ctypes.c_int]
+        lib.bs_set_zero_copy.restype = None
+        lib.bs_set_region_budget.argtypes = [vp, u64]
+        lib.bs_set_region_budget.restype = None
+        lib.bs_set_file_crcs.argtypes = [vp, ctypes.c_uint32,
+                                         ctypes.POINTER(u64), u32p, u32p,
+                                         ctypes.c_uint32]
+        lib.bs_set_file_crcs.restype = ctypes.c_int
+        for fn in ("bs_mapped_bytes", "bs_peak_mapped_bytes",
+                   "bs_registered_bytes", "bs_remaps",
+                   "bs_zero_copy_blocks", "bs_crc_reused",
+                   "bs_pin_events"):
+            getattr(lib, fn).argtypes = [vp]
+            getattr(lib, fn).restype = u64
     lib.bs_register_file.argtypes = [vp, ctypes.c_uint32, cp]
     lib.bs_register_file.restype = ctypes.c_int
     lib.bs_unregister_file.argtypes = [vp, ctypes.c_uint32]
@@ -105,3 +125,10 @@ def has_writer_scatter() -> bool:
     """True when the loaded .so exports the streaming write-path scatter
     kernel (csrc/writer.cpp) — older checked-in builds predate it."""
     return LIB is not None and hasattr(LIB, "writer_scatter")
+
+
+def has_serve_path() -> bool:
+    """True when the loaded .so exports the one-sided serve path (zero-
+    copy responses, registered-region pool, CRC reuse) — older builds
+    degrade to eager-mmap copy serving."""
+    return LIB is not None and hasattr(LIB, "bs_set_zero_copy")

@@ -64,22 +64,48 @@ class SpillFile:
         self._rc_cv = threading.Condition()
         self._readers = 0
         self._disposed = False
-        actual = os.path.getsize(path)
+        self._mapped = False  # registration-on-demand: map at first read
+        # the validation open's fd is RETAINED to pin the inode: a
+        # speculative re-commit os.replace()s this very path before the
+        # old token unregisters, and the deferred first map must read the
+        # bytes committed under THIS token, not the path's current content
+        self._fd = os.open(path, os.O_RDONLY)
+        actual = os.fstat(self._fd).st_size
         if actual < self.size:
+            os.close(self._fd)
+            self._fd = -1
             raise ValueError(f"spill file {path} shorter ({actual}) than "
                              f"declared partitions ({self.size})")
+
+    def _map_locked(self) -> None:
+        """One-time source mapping, under ``_rc_cv``. Deferred from
+        __init__ (registration-on-demand, the NP-RDMA argument applied
+        host-side): a committed output that is only ever served by the
+        native block server — or never read at all — costs no mapping
+        here, and the pure-Python fallback stops paying a full file read
+        at every commit. A map failure surfaces as OSError to the
+        reader, the retryable serve-error class. Maps through the
+        retained fd (``/proc/self/fd``), never by path — the path may
+        have been renamed over by a re-commit since construction."""
+        fd_path = f"/proc/self/fd/{self._fd}"
         if native.available() and self.size > 0:
             out_size = ctypes.c_uint64()
-            h = native.LIB.staging_map_file(path.encode(), ctypes.byref(out_size))
+            h = native.LIB.staging_map_file(fd_path.encode(),
+                                            ctypes.byref(out_size))
             if h:
                 self._native_handle = h
         if self._native_handle is None and self.size > 0:
-            self._py_data = np.fromfile(path, dtype=np.uint8)
+            os.lseek(self._fd, 0, os.SEEK_SET)
+            with os.fdopen(os.dup(self._fd), "rb", closefd=True) as f:
+                self._py_data = np.fromfile(f, dtype=np.uint8)
+        self._mapped = True
 
     def _enter_read(self) -> None:
         with self._rc_cv:
             if self._disposed:
                 raise RuntimeError(f"spill file {self.path} is disposed")
+            if not self._mapped:
+                self._map_locked()
             self._readers += 1
 
     def _exit_read(self) -> None:
@@ -160,6 +186,9 @@ class SpillFile:
                 native.LIB.staging_unmap(self._native_handle)
                 self._native_handle = None
             self._py_data = None
+            if self._fd >= 0:
+                os.close(self._fd)
+                self._fd = -1
         if self._delete and os.path.exists(self.path):
             os.unlink(self.path)
 

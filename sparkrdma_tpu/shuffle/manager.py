@@ -130,7 +130,8 @@ class TpuShuffleManager:
         self.merge_client = None
         if executor_id != "driver":
             from sparkrdma_tpu.runtime.blockserver import maybe_create
-            self.block_server = maybe_create(self.conf, host=host)
+            self.block_server = maybe_create(self.conf, host=host,
+                                             tracer=self.tracer)
             spill_dir = spill_dir or tempfile.mkdtemp(prefix="tpushuffle_")
             self.resolver = TpuShuffleBlockResolver(
                 spill_dir, block_server=self.block_server, conf=self.conf)
@@ -261,6 +262,11 @@ class TpuShuffleManager:
         RdmaBufferManager.java:217-231)."""
         if self.reader_stats is not None:
             self.reader_stats.log_summary(log)
+        if self.block_server is not None:
+            # flush the registered-region pool's activity into the trace
+            # (serve.pin / serve.zero_copy / serve.remap instants) BEFORE
+            # the dump below writes the file
+            self.block_server.trace_serve()
         if self.tracer.enabled and self.conf.trace_file:
             # one file per role so a cluster of managers sharing one conf
             # doesn't overwrite each other's dumps
@@ -284,7 +290,10 @@ class TpuShuffleManager:
         if self.resolver is not None:
             self.resolver.stop()
         if self.block_server is not None:
-            log.info("native block server stats: %s", self.block_server.stats())
+            # second flush catches serves that landed after the trace dump
+            # (in-memory instants only) and logs the final gauges
+            log.info("native block server stats: %s",
+                     self.block_server.trace_serve())
             self.block_server.stop()
         pool_stats = self.pool.stop()
         if pool_stats.get("bins"):

@@ -495,20 +495,30 @@ class MergeStore:
                 continue
             covered = bitmap_new(num_maps)
             ranges: List[Tuple[int, int]] = []
+            range_crcs: List[int] = []  # one per coalesced range
             crc = 0
             try:
                 with open(ledger.path, "rb") as f:
                     for m, _fence, off, ln, _row_crc in rows:
                         bitmap_set(covered, m)
                         f.seek(off)
-                        crc = zlib.crc32(f.read(ln), crc)
+                        seg = f.read(ln)
+                        crc = zlib.crc32(seg, crc)
                         if ranges and ranges[-1][0] + ranges[-1][1] == off:
                             ranges[-1] = (ranges[-1][0],
                                           ranges[-1][1] + ln)
+                            range_crcs[-1] = zlib.crc32(seg, range_crcs[-1])
                         else:
                             ranges.append((off, ln))
+                            range_crcs.append(zlib.crc32(seg))
+                # the reducer's merged read requests EXACTLY these
+                # coalesced ranges, so attesting them here lets the
+                # serving side reuse the CRCs (zero-copy with trailers
+                # on) instead of re-hashing the segment every serve
                 token = self.resolver.register_external(
-                    shuffle_id, ledger.path, ledger.size)
+                    shuffle_id, ledger.path, ledger.size,
+                    crc_ranges=[(o, ln, c) for (o, ln), c
+                                in zip(ranges, range_crcs)])
             except OSError as e:
                 log.warning("finalize of %s failed: %s", ledger.path, e)
                 continue

@@ -135,6 +135,13 @@ class TpuShuffleBlockResolver:
         # interleave into a mismatched durable set
         self._map_fences: Dict[Tuple[int, int], int] = {}
         self._integrity: Dict[int, _SpillIntegrity] = {}
+        # attested (offset, length, crc32) ranges per served token — the
+        # at-rest sidecar's partition CRCs (or a merge ledger's row CRCs)
+        # re-shaped for serve-time reuse: a CRC-trailer serve over blocks
+        # that tile these ranges combines the committed CRCs instead of
+        # re-hashing the bytes, on BOTH serving dataplanes (the native
+        # server gets the same table via bs_set_file_crcs)
+        self._crc_ranges: Dict[int, list] = {}
         self.at_rest_checksum = bool(self.conf.at_rest_checksum)
         # spill-dir health, shared by every writer of this executor:
         # consecutive-failure counts; a dir past spill_dir_max_failures
@@ -305,11 +312,15 @@ class TpuShuffleBlockResolver:
             if fence is not None:
                 self._map_fences[(shuffle_id, map_id)] = fence
         token = next(self._tokens)
+        crc_ranges = (integrity.partition_crc_ranges(lengths_arr.tolist(),
+                                                     partition_crcs)
+                      if self.at_rest_checksum and partition_crcs else None)
         try:
             fault_mod.storage_check("mmap_open", final)
             spill = SpillFile(final, lengths_arr.tolist(), file_token=token)
             if self.block_server is not None:
-                self.block_server.register_file(token, final)
+                self.block_server.register_file(token, final,
+                                                crc_ranges=crc_ranges)
         except BaseException:
             # same invariant past the durable writes: a commit that can't
             # be mapped/served is no commit — a durable triplet that never
@@ -328,6 +339,8 @@ class TpuShuffleBlockResolver:
             old = self._shuffles.setdefault(shuffle_id, {}).get(map_id)
             self._shuffles[shuffle_id][map_id] = spill
             self._by_token[token] = spill
+            if crc_ranges:
+                self._crc_ranges[token] = crc_ranges
             self._integrity[token] = _SpillIntegrity(
                 partition_crcs if self.at_rest_checksum else None,
                 len(lengths_arr),
@@ -337,6 +350,7 @@ class TpuShuffleBlockResolver:
             if old is not None:
                 self._by_token.pop(old.file_token, None)
                 self._integrity.pop(old.file_token, None)
+                self._crc_ranges.pop(old.file_token, None)
         if old is not None:
             if self.block_server is not None:
                 self.block_server.unregister_file(old.file_token)
@@ -369,7 +383,14 @@ class TpuShuffleBlockResolver:
         self.corrupt_outputs += 1
         log.error("at-rest corruption in %s: %s (quarantined; the map "
                   "will be re-executed)", spill.path, detail)
+        with self._lock:
+            # its committed CRCs attest bytes the file no longer holds —
+            # no serve may reuse them for a trailer again
+            self._crc_ranges.pop(spill.file_token, None)
         if self.block_server is not None:
+            # pin-safe: the native server withdraws the token immediately
+            # but defers the munmap until in-flight serve pins drain, so
+            # quarantining never unmaps under a concurrent vectored read
             self.block_server.unregister_file(spill.file_token)
 
     def _verify_file(self, spill: SpillFile, integ: _SpillIntegrity) -> None:
@@ -462,6 +483,19 @@ class TpuShuffleBlockResolver:
         spill.gather([offset], [length], out)
         return out.tobytes()
 
+    def block_crc(self, shuffle_id: int, buf_token: int, offset: int,
+                  length: int) -> Optional[int]:
+        """The attested CRC32 of one served block when committed ranges
+        (sidecar partitions / ledger rows) tile ``[offset, offset +
+        length)`` exactly; None = not covered, the server recomputes.
+        The Python serve loop's half of the CRC-reuse contract the
+        native server implements in C (parity-tested both paths)."""
+        with self._lock:
+            ranges = self._crc_ranges.get(buf_token)
+        if not ranges:
+            return None
+        return integrity.ranges_crc(ranges, offset, length)
+
     # -- local reads (short-circuit path) --------------------------------
 
     def local_blocks(self, shuffle_id: int, map_id: int,
@@ -500,19 +534,27 @@ class TpuShuffleBlockResolver:
     # -- externally-owned served files (push-merge) ----------------------
 
     def register_external(self, shuffle_id: int, path: str,
-                          length: int) -> int:
+                          length: int, crc_ranges=None) -> int:
         """Make one externally-owned file (a finalized merged segment or
         an overflow blob, shuffle/push_merge.py) token-addressable on
         BOTH serving dataplanes — the Python ``read_block`` path and the
         native block server — without entering the map-output tables.
+        ``crc_ranges`` — optional attested ``(offset, length, crc32)``
+        ranges (the merge ledger's surviving rows) — feeds the same
+        serve-time CRC reuse committed outputs get from their sidecar.
         The caller owns the file's content; :meth:`release_externals`
         (or ``remove_shuffle``) unregisters and deletes it."""
         token = next(self._tokens)
         spill = SpillFile(path, [length], file_token=token)
         if self.block_server is not None:
-            self.block_server.register_file(token, path)
+            self.block_server.register_file(token, path,
+                                            crc_ranges=crc_ranges)
         with self._lock:
             self._by_token[token] = spill
+            if crc_ranges:
+                self._crc_ranges[token] = sorted(
+                    (int(o), int(ln), int(c) & 0xFFFFFFFF)
+                    for o, ln, c in crc_ranges if int(ln) > 0)
             self._external.setdefault(shuffle_id, []).append(spill)
         return token
 
@@ -521,6 +563,7 @@ class TpuShuffleBlockResolver:
             spills = self._external.pop(shuffle_id, [])
             for spill in spills:
                 self._by_token.pop(spill.file_token, None)
+                self._crc_ranges.pop(spill.file_token, None)
         for spill in spills:
             if self.block_server is not None:
                 self.block_server.unregister_file(spill.file_token)
@@ -551,6 +594,7 @@ class TpuShuffleBlockResolver:
             for spill in spills.values():
                 self._by_token.pop(spill.file_token, None)
                 self._integrity.pop(spill.file_token, None)
+                self._crc_ranges.pop(spill.file_token, None)
         for spill in spills.values():
             if self.block_server is not None:
                 self.block_server.unregister_file(spill.file_token)
@@ -637,9 +681,13 @@ class TpuShuffleBlockResolver:
                                   file_token=token)
             except (ValueError, OSError):
                 continue  # truncated data file: treat as lost
+            crc_ranges = (integrity.partition_crc_ranges(lengths.tolist(),
+                                                         part_crcs)
+                          if part_crcs else None)
             if self.block_server is not None:
                 try:
-                    self.block_server.register_file(token, data_path)
+                    self.block_server.register_file(token, data_path,
+                                                    crc_ranges=crc_ranges)
                 except OSError as e:
                     # one unmappable file must cost ONE output (treated
                     # as lost → recompute), not abort recovery of every
@@ -653,6 +701,8 @@ class TpuShuffleBlockResolver:
             with self._lock:
                 self._shuffles.setdefault(shuffle_id, {})[map_id] = spill
                 self._by_token[token] = spill
+                if crc_ranges:
+                    self._crc_ranges[token] = crc_ranges
                 # the mmap-open verify above attested the file for
                 # REGISTRATION, but must not exempt it from serve-time
                 # spot checks: rot landing between recover and first

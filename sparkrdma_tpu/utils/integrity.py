@@ -126,6 +126,58 @@ def combine_parts(crcs: Sequence[int], lengths: Sequence[int]) -> int:
     return total
 
 
+# -- range-aligned CRC reuse ----------------------------------------------
+# The serve path recomputes nothing the commit already attested: a sidecar
+# (or merge ledger) names per-range CRCs, and any served block whose
+# [offset, offset+length) tiles those ranges end-to-end derives its
+# trailer CRC by crc32_combine instead of re-hashing the bytes. Both
+# serving dataplanes share this shape — the native server mirrors it in C
+# (csrc/blockserver.cpp crc_from_table), the Python fallback calls
+# :func:`ranges_crc` directly.
+
+def partition_crc_ranges(partition_lengths: Sequence[int],
+                         partition_crcs: Sequence[int]
+                         ) -> List[Tuple[int, int, int]]:
+    """Sidecar partition CRCs as sorted ``(offset, length, crc)`` ranges
+    of the partition-contiguous data file (zero-length partitions
+    dropped — they attest nothing and would stall range walks)."""
+    out: List[Tuple[int, int, int]] = []
+    off = 0
+    for ln, crc in zip(partition_lengths, partition_crcs):
+        ln = int(ln)
+        if ln > 0:
+            out.append((off, ln, int(crc) & 0xFFFFFFFF))
+        off += ln
+    return out
+
+
+def ranges_crc(ranges: Sequence[Tuple[int, int, int]], offset: int,
+               length: int) -> Optional[int]:
+    """CRC32 of ``[offset, offset+length)`` when attested ranges tile it
+    exactly (both endpoints aligned, no holes); None = not covered, the
+    caller recomputes. ``ranges`` is sorted ``(offset, length, crc)``."""
+    if length == 0:
+        return 0
+    import bisect
+    i = bisect.bisect_left(ranges, offset, key=lambda r: r[0]) \
+        if ranges else 0
+    if i >= len(ranges) or ranges[i][0] != offset:
+        return None
+    end = offset + length
+    cur = offset
+    crc = 0
+    while i < len(ranges):
+        o, ln, c = ranges[i]
+        if o != cur or cur + ln > end:
+            return None
+        crc = c if cur == offset else crc32_combine(crc, c, ln)
+        cur += ln
+        if cur == end:
+            return crc
+        i += 1
+    return None
+
+
 # -- sidecar I/O ----------------------------------------------------------
 
 def write_sidecar(data_path: str, fence: int,

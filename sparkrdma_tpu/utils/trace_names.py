@@ -57,6 +57,9 @@ INSTANTS = frozenset({
     "plan.replan",
     "plan.split",
     "serve.corrupt",
+    "serve.pin",
+    "serve.remap",
+    "serve.zero_copy",
     "write.cleanup_error",
     "write.spill_remote",
     "write.spill_retry",
