@@ -464,6 +464,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("skew plan done")
     _bench_fused_exchange(detail)
     _progress("fused exchange done")
+    _bench_topo_exchange(detail)
+    _progress("hierarchical exchange done")
     _bench_serve_path(detail)
     _progress("serve path done")
 
@@ -705,15 +707,83 @@ def _bench_serve_path(detail: dict) -> None:
         detail["serve_path_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
+def _bench_topo_exchange(detail: dict) -> None:
+    """The two-level (hierarchical) dataplane's win over the flat plan,
+    measured without multi-slice hardware: the same slice-affine shuffle
+    exchanged once flat (every byte priced at the modeled DCN rate — a
+    cross-slice all-to-all is lock-stepped on its slowest links) and
+    once hierarchically (per-slice ICI bulk, DCN only for the residue,
+    link-cost-aware partition layout) on a 2-slice virtual cluster with
+    a 10:1 ICI:DCN cost shim — same process, ratio cancels host noise;
+    byte-identical per-partition output is the gate, and the
+    hierarchical side must move STRICTLY fewer cross-slice bytes. See
+    shuffle/topo_bench.py."""
+    try:
+        from sparkrdma_tpu.shuffle.topo_bench import run_topo_microbench
+
+        # the same env knobs _round_provenance records steer the run
+        # (BENCH_IMPL / BENCH_SORT_MODE precedent): slice count from
+        # BENCH_SLICE_TOPOLOGY ("N" form), cost ratio from the
+        # coefficient pair — so recorded topology matches what ran
+        kw = {}
+        spec = os.environ.get("BENCH_SLICE_TOPOLOGY", "").strip()
+        if spec.isdigit() and int(spec) >= 1:
+            kw["num_slices"] = int(spec)
+        try:
+            kw["cost_ratio"] = (float(os.environ["BENCH_ICI_GBPS"])
+                                / float(os.environ["BENCH_DCN_GBPS"]))
+        except (KeyError, ValueError, ZeroDivisionError):
+            pass
+        res = run_topo_microbench(**kw)
+        if res["slices"] < 2:
+            detail["hierarchical_exchange_error"] = res.get(
+                "note", "single-slice host: no seam to exchange across")
+            return
+        if not res["identical"]:
+            detail["hierarchical_exchange_error"] = \
+                "flat and hierarchical plans exchanged different bytes"
+            return
+        cross = res["cross_slice_bytes"]
+        if cross["hier"] >= cross["flat"]:
+            detail["hierarchical_exchange_error"] = (
+                f"cross-slice bytes not reduced: hier {cross['hier']} >= "
+                f"flat {cross['flat']}")
+            return
+        detail["hierarchical_exchange_speedup"] = res["speedup"]
+        detail["hierarchical_exchange_wall_s"] = res["wall_s"]
+        detail["cross_slice_bytes"] = cross
+    except Exception as e:  # noqa: BLE001
+        detail["hierarchical_exchange_error"] = \
+            f"{type(e).__name__}: {e}"[:120]
+
+
 def _round_provenance(detail: dict) -> dict:
     """Host-contention provenance EVERY bench round must carry: the
     load average (a uniform slowdown across workloads under high load
-    here is noise, not a regression — the BENCH_r05 lesson) and the
-    capture timestamp. The tier-1 round-JSON test asserts these keys
-    are recorded alongside dense_exchange_guard."""
+    here is noise, not a regression — the BENCH_r05 lesson), the
+    capture timestamp, and the DETECTED TOPOLOGY (slice count,
+    devices/slice, link coefficients) so multi-slice rounds are
+    attributable to the fabric they ran on. The tier-1 round-JSON test
+    asserts these keys are recorded alongside dense_exchange_guard."""
     detail["host_load_avg"] = [round(x, 2) for x in os.getloadavg()]
     detail["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                           time.gmtime())
+    try:
+        from sparkrdma_tpu.config import TpuShuffleConf
+        from sparkrdma_tpu.parallel.topology import host_topology
+
+        # a round benched under overridden topology knobs must record
+        # the values the topo secondary actually ran with (the same env
+        # steers _bench_topo_exchange); unset = the auto-detected
+        # fabric + defaults
+        conf_kw = {key: os.environ[env] for env, key in
+                   (("BENCH_SLICE_TOPOLOGY", "slice_topology"),
+                    ("BENCH_ICI_GBPS", "ici_gbps"),
+                    ("BENCH_DCN_GBPS", "dcn_gbps")) if env in os.environ}
+        detail["topology"] = host_topology(
+            TpuShuffleConf(**conf_kw) if conf_kw else None).describe()
+    except Exception as e:  # noqa: BLE001 — provenance never fails a round
+        detail["topology_error"] = f"{type(e).__name__}: {e}"[:120]
     return detail
 
 

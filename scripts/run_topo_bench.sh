@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Topology sweep: the hierarchical-exchange test matrix
+# (tests/test_topology.py — two-level cost model, hierarchical vs
+# flat-device vs host byte parity across uniform/zipfian/affine inputs,
+# empty slices, per-slice degrade, link-cost layout) across a set of
+# extra seeds, then the topo microbench with its acceptance gates:
+# >= 1.5x vs the flat plan on a 2-slice virtual cluster under a 10:1
+# ICI:DCN cost shim, byte-identical per-partition output, and STRICTLY
+# fewer cross-slice bytes. A red seed replays exactly:
+#
+#     TOPO_SEED=<seed> python -m pytest tests/test_topology.py
+#
+# Usage: scripts/run_topo_bench.sh [seed ...]
+#   TOPO_SEEDS="0 1 2"   alternative way to pass the seed list
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SEEDS=${*:-${TOPO_SEEDS:-"0 7 42"}}
+failed=()
+for seed in $SEEDS; do
+  echo "=== topology sweep: seed ${seed} ==="
+  if ! TOPO_SEED="${seed}" JAX_PLATFORMS=cpu \
+       python -m pytest tests/test_topology.py -q \
+         -p no:cacheprovider -p no:randomly; then
+    echo "!!! seed ${seed} FAILED — replay with:"
+    echo "    TOPO_SEED=${seed} python -m pytest tests/test_topology.py"
+    failed+=("${seed}")
+  fi
+done
+
+echo "=== hierarchical-exchange microbench ==="
+if ! JAX_PLATFORMS=cpu \
+     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+     python - <<'EOF'
+import json, sys
+from sparkrdma_tpu.shuffle.topo_bench import run_topo_microbench
+
+res = run_topo_microbench()
+print(json.dumps(res))
+cross = res["cross_slice_bytes"]
+sys.exit(0 if res["identical"] and res["speedup"] >= 1.5
+         and cross["hier"] < cross["flat"] else 1)
+EOF
+then
+  failed+=("microbench")
+fi
+
+if [ "${#failed[@]}" -gt 0 ]; then
+  echo "topology sweep: FAILED: ${failed[*]}"
+  exit 1
+fi
+echo "topology sweep: all seeds green, microbench gates met"

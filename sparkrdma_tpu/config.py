@@ -418,6 +418,43 @@ _KEYS = [
              "connect_timeout_ms. A response landing after the deadline is "
              "routed to the orphan path so flow-control credits still "
              "heal."),
+    _Key("mesh_rows_per_round", 0, "int", 0, 1 << 31,
+         doc="DEPRECATED: static per-device rows per fused exchange "
+             "round. 0 (the default) lets rounds auto-size from "
+             "device_hbm_budget — the preferred sizing; a nonzero value "
+             "still pins the round size (one deprecation warning per "
+             "process) so mixed-version configs stay parseable."),
+    # --- two-level topology (TPU-only: parallel/topology.py,
+    # docs/CONFIG.md "Topology")
+    _Key("slice_topology", "", "str",
+         doc="Slice grouping of the mesh's devices along the exchange "
+             "axis: '' = auto-derive from device slice_index / "
+             "process_index (single-host CPU meshes collapse to one "
+             "slice — the degenerate, pre-topology behavior); 'N' = N "
+             "equal contiguous slices (virtual slicing for CI/benches); "
+             "'a,b,c' = explicit per-slice device counts (must sum to "
+             "the device count). Invalid specs fall back to auto. The "
+             "same spec partitions executor SLOTS for the reduce "
+             "planner's link-cost placement."),
+    _Key("ici_gbps", 100.0, "float", 0.001, 1e6,
+         doc="Intra-slice (ICI) link bandwidth coefficient in GB/s for "
+             "the two-level cost model. Only the RATIO to dcn_gbps "
+             "matters for plan ranking; seed from the platform's "
+             "datasheet and refine from a probe/bench round "
+             "(Topology.refine)."),
+    _Key("dcn_gbps", 10.0, "float", 0.001, 1e6,
+         doc="Inter-slice (DCN / host-link) bandwidth coefficient in "
+             "GB/s for the two-level cost model — the first-class "
+             "inter-host channel cost. Defaults model the order-of-"
+             "magnitude ICI:DCN gap of production TPU pods."),
+    _Key("hierarchical_exchange", True, "bool",
+         doc="Let the cost model emit HIERARCHICAL plans on multi-slice "
+             "topologies: fused ICI all-to-all within each slice, host/"
+             "DCN channel only for the slice-crossing residue, composed "
+             "as a factored two-phase redistribution. Off = the flat "
+             "selector (device-or-host for the whole stage, the "
+             "regression escape hatch); single-slice meshes are "
+             "unaffected either way."),
 ]
 
 _KEY_MAP: Dict[str, _Key] = {k.name: k for k in _KEYS}

@@ -1088,17 +1088,38 @@ class DAGEngine:
                             plane=plan.plane, impl=plan.impl,
                             rows_per_round=plan.rows_per_round,
                             reason=plan.reason)
-        if plan.plane != "device":
+        if plan.plane not in ("device", "hierarchical"):
             return _HOST_PLANE
-        # deprecated escape hatch: an explicit mesh_rows_per_round pins
-        # the round size over the budget-derived auto-sizing
-        rows_per_round = self.mesh_rows_per_round or plan.rows_per_round
+        # deprecated escape hatch: an explicit mesh_rows_per_round (ctor
+        # arg or conf key) pins the round size over the budget-derived
+        # auto-sizing — one deprecation warning per process
+        conf = getattr(self.driver.native, "conf", None)
+        legacy_rows = self.mesh_rows_per_round or (
+            conf.mesh_rows_per_round if conf is not None else 0)
+        if legacy_rows:
+            from sparkrdma_tpu.parallel.device_plane import (
+                warn_mesh_rows_deprecated,
+            )
+
+            warn_mesh_rows_deprecated()
+        rows_per_round = legacy_rows or plan.rows_per_round
         try:
-            results = run_mesh_reduce_fused(
-                mgrs, handle, self.mesh, axis_name=self.mesh_axis,
-                impl=plan.impl, rows_per_round=rows_per_round,
-                out_factor=out_factor, expect_maps=handle.num_maps,
-                tracer=self.tracer)
+            if plan.plane == "hierarchical":
+                from sparkrdma_tpu.shuffle.mesh_service import (
+                    run_mesh_reduce_hier,
+                )
+
+                results = run_mesh_reduce_hier(
+                    mgrs, handle, self.mesh, plan.topology,
+                    axis_name=self.mesh_axis, impl=plan.impl,
+                    rows_per_round=rows_per_round, out_factor=out_factor,
+                    expect_maps=handle.num_maps, tracer=self.tracer)
+            else:
+                results = run_mesh_reduce_fused(
+                    mgrs, handle, self.mesh, axis_name=self.mesh_axis,
+                    impl=plan.impl, rows_per_round=rows_per_round,
+                    out_factor=out_factor, expect_maps=handle.num_maps,
+                    tracer=self.tracer)
         except OverflowError as e:
             # skew beat the headroom for this stage: degrade exactly
             # this stage to the host dataplane instead of failing
@@ -1121,7 +1142,13 @@ class DAGEngine:
 
     def _select_plan(self, handle, est_bytes: int, out_factor: int):
         """Ask the cost model which plane carries this stage; engine
-        ctor args override conf keys override "auto"."""
+        ctor args override conf keys override "auto". On a multi-slice
+        topology (detected from the mesh / the ``slice_topology`` conf
+        key, gated by ``hierarchical_exchange``) the model may answer
+        HIERARCHICAL — per-slice ICI with a DCN residue — scored by the
+        two-level link cost; single-slice meshes get the flat selector
+        bit-for-bit."""
+        from sparkrdma_tpu.parallel import topology as topology_mod
         from sparkrdma_tpu.parallel.device_plane import (
             StageProfile,
             select_dataplane,
@@ -1134,12 +1161,17 @@ class DAGEngine:
             override = conf.device_plane
         budget = self.device_hbm_budget or (
             conf.device_hbm_budget if conf is not None else 64 << 20)
+        topo = None
+        if self.mesh is not None and (conf is None
+                                      or conf.hierarchical_exchange):
+            topo = topology_mod.detect_topology(self.mesh, self.mesh_axis,
+                                                conf)
         row_bytes = 4 * device_row_words(handle.row_payload_bytes)
         profile = StageProfile(est_bytes=est_bytes, row_bytes=row_bytes,
                                resident=True, out_factor=out_factor)
         return select_dataplane(self.mesh, self.mesh_axis, profile,
                                 impl=self.mesh_impl, hbm_budget=budget,
-                                override=override)
+                                override=override, topology=topo)
 
     # -- recovery (scala/RdmaShuffleFetcherIterator.scala:376-381) -------
 

@@ -35,20 +35,68 @@ Overflow (per-pair skew past the dense slot, or a receive past the
 capacity headroom) raises ``OverflowError``; the ENGINE degrades exactly
 the overflowing stage to the host dataplane instead of failing the job
 (`engine.py` catches it and re-serves the stage through the fetcher).
+
+Multi-slice topologies (``parallel/topology.py``) add a THIRD plan kind:
+**hierarchical** — the fused ICI step runs per slice over its sub-mesh
+(bulk bytes stay on ICI), and only the slice-crossing residue moves over
+the host/DCN channel, re-homed into its destination slice's next round
+(local regroup -> cross-slice move -> local regroup: the factored
+redistribution of "Memory-efficient array redistribution through
+portable collective communication", PAPERS.md — no full intermediate is
+ever materialized). ``select_dataplane`` scores the candidates by the
+two-level link cost ``intra_bytes/ici_bw + inter_bytes/dcn_bw`` instead
+of a residency boolean; a single-slice (degenerate) topology reproduces
+the flat selector bit-for-bit. One slice's overflow (or a collective
+failure under a lost device) degrades ONLY that slice's residue to
+host-side serving, byte-identically — the other slices stay on ICI.
 """
 
 from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from sparkrdma_tpu.parallel import topology as topology_mod
 from sparkrdma_tpu.utils import trace as trace_mod
 
 DEVICE_PLANE = "device"
 HOST_PLANE = "host"
+HIERARCHICAL_PLANE = "hierarchical"
+
+
+def _resolve_plan_impl(mesh, impl: str, axis_name: str) -> str:
+    """The shared transport resolution (``exchange.resolve_transport``):
+    ring transports pass through verbatim, everything else goes through
+    the per-mesh probe — one helper so the override arm and the plane
+    planners can't drift apart."""
+    from sparkrdma_tpu.parallel.exchange import resolve_transport
+
+    return resolve_transport(mesh, impl, axis_name)
+
+
+# one-time latch for the mesh_rows_per_round deprecation (engine ctor
+# arg or conf key): the knob still pins round sizes for mixed-version
+# configs, but auto-sizing from device_hbm_budget is the supported path
+_rows_knob_warned = False
+
+
+def warn_mesh_rows_deprecated(source: str = "mesh_rows_per_round") -> None:
+    """Emit the one-per-process deprecation warning for the legacy
+    static round-size knob; later calls are silent."""
+    global _rows_knob_warned
+    if _rows_knob_warned:
+        return
+    _rows_knob_warned = True
+    import warnings
+
+    warnings.warn(
+        f"{source} is deprecated: rounds auto-size from device_hbm_budget"
+        " (docs/CONFIG.md 'Device exchange'); the pinned value is still"
+        " honored for mixed-version configs", DeprecationWarning,
+        stacklevel=3)
 
 # conservative per-device HBM footprint of one fused round, in row
 # multiples: the input buffer + its destination-grouped copy (2 x cap)
@@ -69,12 +117,20 @@ class StageProfile:
     staged straight into this process's HBM (in-process executors; a
     remote-only stage can't ride the local mesh). ``out_factor``:
     receive headroom the runner will allocate.
+
+    ``intra_bytes`` / ``inter_bytes`` decompose ``est_bytes`` BY LINK
+    for multi-slice topologies: bytes whose destination stays in the
+    producing map's home slice vs. bytes that must cross the DCN seam.
+    ``-1`` = unknown — the cost model falls back to the topology's
+    uniform-destination estimate; flat topologies never look at them.
     """
 
     est_bytes: int
     row_bytes: int
     resident: bool = True
     out_factor: int = 2
+    intra_bytes: int = -1
+    inter_bytes: int = -1
 
 
 @dataclass(frozen=True)
@@ -82,12 +138,17 @@ class ExchangePlan:
     """One stage's dataplane decision: which plane, which transport,
     and (device plane) the auto-sized round bound. ``rows_per_round``
     0 = one shot; ``reason`` is the cost model's audit trail (surfaced
-    on the ``exchange.select`` trace instant)."""
+    on the ``exchange.select`` trace instant). ``topology`` rides along
+    on HIERARCHICAL plans — the runner needs the slice bounds the plan
+    was scored against (None on flat plans); hierarchical ``impl`` is
+    the RAW transport ask (``"auto"`` re-probes per sub-mesh — the
+    opcode a cross-slice mesh rejects may compile per slice)."""
 
     plane: str
     impl: str = ""
     rows_per_round: int = 0
     reason: str = ""
+    topology: Optional[topology_mod.Topology] = None
 
 
 class Exchange:
@@ -128,10 +189,7 @@ class DeviceExchange(Exchange):
         ok, why = self.supports(mesh, axis_name, profile)
         if not ok:
             return None
-        from sparkrdma_tpu.parallel.exchange import resolve_impl
-
-        resolved = (impl if impl in ("ring", "ring_interpret")
-                    else resolve_impl(mesh, impl, axis_name))
+        resolved = _resolve_plan_impl(mesh, impl, axis_name)
         n = mesh.shape[axis_name]
         rows_cap = auto_rows_per_round(profile.row_bytes, hbm_budget,
                                        profile.out_factor)
@@ -181,12 +239,25 @@ _PLANES = (DeviceExchange(), HostExchange())
 
 def select_dataplane(mesh, axis_name: str, profile: StageProfile, *,
                      impl: str = "auto", hbm_budget: int = 64 << 20,
-                     override: str = "auto") -> ExchangePlan:
+                     override: str = "auto",
+                     topology: Optional[topology_mod.Topology] = None,
+                     ) -> ExchangePlan:
     """The per-stage cost model: device plane when the stage is mesh-
     resident and its bytes fit the HBM budget's round sizing, host
     plane otherwise. ``override`` short-circuits: ``"device"`` /
     ``"host"`` force a plane (the old ``mesh_impl``-flag behavior,
-    kept as the escape hatch); ``"auto"`` asks the cost model."""
+    kept as the escape hatch); ``"auto"`` asks the cost model.
+
+    ``topology``: the mesh's two-level description. On a MULTI-slice
+    topology a stage that would ride the device plane is scored by the
+    two-level link cost instead of a residency boolean: the flat
+    collective routes EVERY byte through the DCN-priced inter-slice
+    fabric (a cross-slice all-to-all is lock-stepped on its slowest
+    links, and the native ragged opcode doesn't span slices at all),
+    while the hierarchical plan keeps the intra-slice bulk on ICI and
+    pays DCN only for the slice-crossing residue —
+    ``intra/ici_bw + inter/dcn_bw``. None or a single-slice topology
+    reproduces the flat selector bit-for-bit."""
     if override not in ("auto", DEVICE_PLANE, HOST_PLANE):
         # a typo'd escape hatch must not silently ride the cost model
         # (same rule as make_fused_step's sort_mode)
@@ -194,8 +265,8 @@ def select_dataplane(mesh, axis_name: str, profile: StageProfile, *,
                          "(expected 'auto', 'device' or 'host')")
     if override == HOST_PLANE:
         return ExchangePlan(HOST_PLANE, "", 0, "forced by override")
+    device, host = _PLANES
     if override == DEVICE_PLANE:
-        device = _PLANES[0]
         ok, why = device.supports(mesh, axis_name, profile)
         if not ok:
             # forcing a plane that declared itself unable to carry the
@@ -208,18 +279,46 @@ def select_dataplane(mesh, axis_name: str, profile: StageProfile, *,
             return dev
         # supported but the budget can't hold a row: run minimum rounds
         # rather than silently switching planes under an explicit ask
-        from sparkrdma_tpu.parallel.exchange import resolve_impl
-
-        resolved = (impl if impl in ("ring", "ring_interpret")
-                    else resolve_impl(mesh, impl, axis_name))
-        return ExchangePlan(DEVICE_PLANE, resolved, 1,
-                            "forced by override (budget below one row)")
-    for plane in _PLANES:
-        plan = plane.plan(mesh, axis_name, profile, impl=impl,
-                          hbm_budget=hbm_budget)
-        if plan is not None:
-            return plan
-    return ExchangePlan(HOST_PLANE, "", 0, "no plane volunteered")
+        return ExchangePlan(DEVICE_PLANE, _resolve_plan_impl(
+            mesh, impl, axis_name), 1,
+            "forced by override (budget below one row)")
+    dev = device.plan(mesh, axis_name, profile, impl=impl,
+                      hbm_budget=hbm_budget)
+    if dev is None:
+        # HostExchange.plan always returns a plan — it is the fallback
+        # plane by contract (no "no plane volunteered" tail needed)
+        return host.plan(mesh, axis_name, profile, impl=impl,
+                         hbm_budget=hbm_budget)
+    if (topology is not None and not topology.is_flat
+            and dev.rows_per_round == 0):
+        # one-shot plans only: the hierarchical runner stages the whole
+        # stage host-side before factoring it (the same whole-stage
+        # contract the one-shot fused path has); a CHUNKED plan means
+        # the stage outgrew that contract, and the flat chunked device
+        # plan keeps its streamed bounded-staging discipline
+        est = max(0, profile.est_bytes)
+        intra, inter = profile.intra_bytes, profile.inter_bytes
+        if intra < 0 or inter < 0:
+            # no per-link byte decomposition published for this stage:
+            # fall back to the uniform-destination estimate
+            inter = int(est * topology.uniform_inter_fraction())
+            intra = est - inter
+        hier_s = topology.link_seconds(intra, inter)
+        flat_s = topology.link_seconds(0, intra + inter)
+        if hier_s < flat_s:
+            # the plan carries the RAW transport ask, not the global
+            # mesh's resolution: the native ragged opcode that a
+            # cross-slice mesh rejects may well compile on each
+            # single-slice sub-mesh, so "auto" must re-probe per
+            # sub-mesh inside the runner (make_fused_step)
+            return ExchangePlan(
+                HIERARCHICAL_PLANE, impl, 0,
+                f"two-level: {topology.num_slices} slices, "
+                f"{intra >> 20}MiB intra@{topology.ici_gbps:g}GB/s + "
+                f"{inter >> 20}MiB inter@{topology.dcn_gbps:g}GB/s = "
+                f"{hier_s:.4f}s vs flat {flat_s:.4f}s",
+                topology=topology)
+    return dev
 
 
 # ---------------------------------------------------------------------------
@@ -334,7 +433,7 @@ def make_fused_step(mesh, axis_name: str, row_words: int, *,
     from sparkrdma_tpu.parallel.exchange import (
         group_by_destination,
         ragged_exchange_shard,
-        resolve_impl,
+        resolve_transport,
     )
     from sparkrdma_tpu.utils.compat import shard_map
 
@@ -349,8 +448,7 @@ def make_fused_step(mesh, axis_name: str, row_words: int, *,
         raise ValueError("range partitioning is defined on single-word "
                          "u32 keys")
     n = mesh.shape[axis_name]
-    impl = (impl if impl in ("ring", "ring_interpret")
-            else resolve_impl(mesh, impl, axis_name))
+    impl = resolve_transport(mesh, impl, axis_name)
     spec = P(axis_name)
     sentinel = jnp.uint32(0xFFFFFFFF)
     write_back = key_words == 1
@@ -559,16 +657,233 @@ def run_fused_exchange_rounds(mesh, axis_name: str, blocks,
 
     from sparkrdma_tpu.shuffle.external import merge_runs
 
-    def run_keys(r: np.ndarray) -> np.ndarray:
-        if key_words == 2:
-            return r[:, :2].copy().view(np.uint64).reshape(-1)
-        return r[:, 0]
-
     merged = []
     for d in range(n):
         if not runs[d]:
             merged.append(np.zeros((0, row_words), np.uint32))
             continue
-        _, out = merge_runs([(run_keys(r), r) for r in runs[d]])
+        _, out = merge_runs([(_run_keys(r, key_words), r)
+                             for r in runs[d]])
         merged.append(out)
+    return merged, rounds
+
+
+# ---------------------------------------------------------------------------
+# the hierarchical (two-level) driver: per-slice ICI + DCN residue
+# ---------------------------------------------------------------------------
+
+def _run_keys(r: np.ndarray, key_words: int) -> np.ndarray:
+    """Sort/merge keys of device-row runs: packed u64 for the 2-word
+    layout, column 0 otherwise (shared by the flat and hierarchical
+    drivers' tournament merges and the host-side degrade sort)."""
+    if key_words == 2:
+        return r[:, :2].copy().view(np.uint64).reshape(-1)
+    return r[:, 0]
+
+
+def run_hierarchical_exchange(mesh, axis_name: str,
+                              topology: topology_mod.Topology,
+                              rows: np.ndarray, dest: np.ndarray,
+                              home_slice: np.ndarray, *,
+                              key_words: int = 2, rows_per_round: int = 0,
+                              out_factor: int = 2, impl: str = "auto",
+                              sort_mode: str = "gather", tracer=None,
+                              ) -> Tuple[List[np.ndarray], int]:
+    """Drive the FACTORED two-phase redistribution over a multi-slice
+    topology: local regroup -> cross-slice move -> local regroup, per
+    "Memory-efficient array redistribution through portable collective
+    communication" (PAPERS.md) — no full intermediate is ever
+    materialized.
+
+    * **Phase 1 (intra)**: every row whose destination device lives in
+      its home slice rides that slice's fused partition+exchange+sort
+      step over the slice sub-mesh (``topology.slice_mesh``) — the bulk
+      bytes, on ICI, in budget-bounded rounds exactly like the flat
+      driver.
+    * **DCN move**: the slice-crossing residue is tallied and charged
+      (``topology.record_cross_slice`` + the installed shim) WHILE the
+      phase-1 collectives are in flight — the DCN phase overlaps the ICI
+      phase (``exchange.overlap``), the two-level analogue of the flat
+      driver's double buffering.
+    * **Phase 2 (regroup at destination)**: arrived residue rows run the
+      destination slice's fused step — the second local regroup.
+
+    ``home_slice: i32[N]`` names each row's producing slice (executor
+    slots map to slices via ``Topology.slice_of_slot``); ``dest`` is the
+    GLOBAL destination device per row. Returns the flat drivers'
+    contract: per-device key-sorted rows (runs merged across phases and
+    rounds), plus the total ICI round count.
+
+    Per-slice degrade: a slice whose receive overflows (or whose
+    collective fails under a lost device) falls back to host-side
+    serving for ITS rows only — byte-identically, the other slices stay
+    on ICI (``exchange.degrade`` instant with ``scope="slice"``).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkrdma_tpu.parallel.exchange import record_exchange
+
+    tracer = tracer if tracer is not None else trace_mod.NULL
+    n = mesh.shape[axis_name]
+    row_words = rows.shape[1]
+    if topology is None or topology.is_flat:
+        # degenerate single-slice topology: the flat driver IS the plan
+        return run_fused_exchange(
+            mesh, axis_name, rows, dest, key_words=key_words,
+            rows_per_round=rows_per_round, out_factor=out_factor,
+            impl=impl, sort_mode=sort_mode, tracer=tracer)
+    dest = np.asarray(dest, dtype=np.int32)
+    home = np.asarray(home_slice, dtype=np.int32)
+    dev_slice = topology.device_slices()
+    dest_slice = dev_slice[dest] if len(dest) else dest
+    runs: List[list] = [[] for _ in range(n)]
+    degraded: set = set()
+    rounds = 0
+    row_bytes = row_words * 4
+
+    def host_fallback(s: int, chunk: np.ndarray, dchunk: np.ndarray):
+        """Serve one slice-chunk host-side, byte-identically: group by
+        destination device, key-sort each group (the receiving device's
+        sort), append as ordinary runs."""
+        lo, hi = topology.slice_bounds(s)
+        for d in range(lo, hi):
+            sub = chunk[dchunk == d]
+            if not len(sub):
+                continue
+            order = np.argsort(_run_keys(sub, key_words), kind="stable")
+            runs[d].append(np.ascontiguousarray(sub[order]))
+
+    def collect(s: int, lo: int, ns: int, result) -> None:
+        out, counts, overflowed = result
+        if np.asarray(overflowed).any():
+            raise OverflowError(
+                f"hierarchical exchange receive overflow in slice {s}")
+        out = np.asarray(out).reshape(ns, -1, row_words)
+        counts = np.asarray(counts)
+        for i in range(ns):
+            runs[lo + i].append(out[i][:int(counts[i].sum())].copy())
+
+    def run_phase(per_slice: Dict[int, Tuple[np.ndarray, np.ndarray]],
+                  phase: str, dcn_moves=None) -> None:
+        """Dispatch every slice's budget-bounded rounds; charge the DCN
+        residue move while round 0's collectives are in flight; collect
+        with per-slice degrade."""
+        nonlocal rounds
+        sched = []
+        for s in sorted(per_slice):
+            rs, ds = per_slice[s]
+            if not len(rs):
+                continue
+            lo, hi = topology.slice_bounds(s)
+            ns = hi - lo
+            cap = rows_per_round if rows_per_round > 0 else -(-len(rs) // ns)
+            per_round = max(1, cap) * ns
+            submesh = topology_mod.slice_mesh(mesh, axis_name, topology, s)
+            step = make_fused_step(submesh, axis_name, row_words,
+                                   out_factor=out_factor, impl=impl,
+                                   sort_mode=sort_mode, key_words=key_words,
+                                   partition="dest")
+            sharding = NamedSharding(submesh, P(axis_name))
+            chunks = [(rs[o:o + per_round], ds[o:o + per_round])
+                      for o in range(0, len(rs), per_round)]
+            sched.append((s, lo, ns, per_round, step, sharding, chunks))
+
+        charged = dcn_moves is None
+
+        def charge():
+            nonlocal charged
+            if charged:
+                return
+            charged = True
+            for (src, dst) in sorted(dcn_moves):
+                topology_mod.record_cross_slice(dcn_moves[(src, dst)])
+
+        for r in range(max((len(c[6]) for c in sched), default=0)):
+            batch = []
+            for s, lo, ns, per_round, step, sharding, chunks in sched:
+                if r >= len(chunks):
+                    continue
+                chunk, dchunk = chunks[r]
+                if s in degraded:
+                    host_fallback(s, chunk, dchunk)
+                    continue
+                with tracer.span("exchange.round", "exchange",
+                                 round=rounds, phase=phase, slice=s,
+                                 rows=len(chunk)):
+                    rows_p = np.zeros((per_round, row_words), np.uint32)
+                    rows_p[:len(chunk)] = chunk
+                    dest_p = np.full(per_round, -1, np.int32)
+                    dest_p[:len(chunk)] = dchunk - lo  # slice-local device
+                    out = step(jax.device_put(rows_p, sharding),
+                               jax.device_put(dest_p, sharding))
+                record_exchange(len(chunk))
+                batch.append((s, lo, ns, chunk, dchunk, out))
+            if batch and not charged:
+                # jax dispatch is async: the residue crosses DCN while
+                # the ICI collectives above are in flight
+                tracer.instant("exchange.overlap", "exchange",
+                               dispatched=rounds, collecting=-1,
+                               phase=phase)
+            charge()
+            for s, lo, ns, chunk, dchunk, out in batch:
+                try:
+                    collect(s, lo, ns, out)
+                except OverflowError:
+                    # degrade ONLY this slice's residue to host serving;
+                    # the other slices stay on ICI
+                    degraded.add(s)
+                    tracer.instant("exchange.degrade", "exchange",
+                                   scope="slice", slice=s,
+                                   reason="overflow")
+                    host_fallback(s, chunk, dchunk)
+            if batch:
+                rounds += 1
+        charge()  # a phase with no ICI rounds still pays its DCN move
+
+    if len(rows):
+        intra = dest_slice == home
+        phase1 = {}
+        phase2 = {}
+        dcn_moves: Dict[Tuple[int, int], int] = {}
+        for s in range(topology.num_slices):
+            m = intra & (home == s)
+            phase1[s] = (rows[m], dest[m])
+        inter_rows = 0
+        for t in range(topology.num_slices):
+            segs_r, segs_d = [], []
+            for s in range(topology.num_slices):
+                if s == t:
+                    continue
+                m = (home == s) & (dest_slice == t)
+                cnt = int(m.sum())
+                if not cnt:
+                    continue
+                dcn_moves[(s, t)] = cnt * row_bytes
+                inter_rows += cnt
+                segs_r.append(rows[m])
+                segs_d.append(dest[m])
+            if segs_r:
+                phase2[t] = (np.concatenate(segs_r),
+                             np.concatenate(segs_d))
+        run_phase(phase1, "intra", dcn_moves=dcn_moves)
+        run_phase(phase2, "residue")
+        tracer.instant("exchange.hierarchical", "exchange",
+                       slices=topology.num_slices,
+                       intra_rows=int(intra.sum()), inter_rows=inter_rows,
+                       cross_slice_bytes=inter_rows * row_bytes,
+                       degraded_slices=sorted(degraded))
+
+    from sparkrdma_tpu.shuffle.external import merge_runs
+
+    merged = []
+    for d in range(n):
+        if not runs[d]:
+            merged.append(np.zeros((0, row_words), np.uint32))
+        elif len(runs[d]) == 1:
+            merged.append(runs[d][0])
+        else:
+            _, out = merge_runs([(_run_keys(r, key_words), r)
+                                 for r in runs[d]])
+            merged.append(out)
     return merged, rounds

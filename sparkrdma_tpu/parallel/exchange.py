@@ -388,6 +388,17 @@ def resolve_impl(mesh: Mesh, impl: str = "auto",
     return "dense"
 
 
+def resolve_transport(mesh: Mesh, impl: str,
+                      axis_name: Optional[str] = None) -> str:
+    """The transport resolution every plan/build site shares: ring
+    transports pass through verbatim (they are explicit asks, never
+    probed), everything else goes through ``resolve_impl``'s per-mesh
+    probe. One helper so the step builders and the cost model's plan
+    sites can't drift apart."""
+    return (impl if impl in ("ring", "ring_interpret")
+            else resolve_impl(mesh, impl, axis_name))
+
+
 # (mesh, axis) pairs whose topology-rejection warning already fired:
 # only _native_compiles is cached, so without this memo EVERY
 # resolve_impl call re-logged the same rejection — iterative stages
@@ -459,8 +470,7 @@ def _make_chunked_exchange(mesh: Mesh, axis_name: str, quota: int,
     ``group_by_destination``).
     """
     n = mesh.shape[axis_name]
-    impl_resolved = (impl if impl in ("ring", "ring_interpret")
-                     else resolve_impl(mesh, impl, axis_name))
+    impl_resolved = resolve_transport(mesh, impl, axis_name)
     spec = P(axis_name)
 
     # pallas interpret-mode outputs confuse the vma checker when mixed
@@ -561,8 +571,7 @@ def _make_chunked_exchange_acc(mesh: Mesh, axis_name: str, quota: int,
     count matrix).
     """
     n = mesh.shape[axis_name]
-    impl_resolved = (impl if impl in ("ring", "ring_interpret")
-                     else resolve_impl(mesh, impl, axis_name))
+    impl_resolved = resolve_transport(mesh, impl, axis_name)
     spec = P(axis_name)
     shard_kwargs = dict(mesh=mesh, in_specs=(spec, spec, None, spec),
                         out_specs=spec)
@@ -696,8 +705,7 @@ def make_shuffle_exchange(mesh: Mesh, axis_name: str, impl: str = "auto",
     """
     spec = P(axis_name)
     n = mesh.shape[axis_name]
-    impl = (impl if impl in ("ring", "ring_interpret")
-            else resolve_impl(mesh, impl, axis_name))
+    impl = resolve_transport(mesh, impl, axis_name)
 
     # pallas interpret-mode outputs confuse the vma checker when mixed
     # with collectives; disable it ONLY for the ring transports so the

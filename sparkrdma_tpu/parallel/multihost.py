@@ -182,6 +182,21 @@ def run_multihost_mesh_reduce(managers: Sequence, handle, mesh,
     rows = _rows_to_u32(keys, payload)
     dest = np.asarray(partitioner(keys), dtype=np.int32) % n_global
 
+    # cross-slice accounting: the per-host seams ARE the topology's DCN
+    # links (parallel/topology.py) — tally the bytes this process sends
+    # across them so multi-host rounds report cross_slice_bytes the same
+    # way the in-process hierarchical exchange does
+    from sparkrdma_tpu.parallel import topology as topology_mod
+
+    topo = topology_mod.detect_topology(mesh)
+    if not topo.is_flat and len(dest):
+        dev_slice = topo.device_slices()
+        my_pos = next(i for i, d in enumerate(mesh.devices.flat)
+                      if d.process_index == jax.process_index())
+        crossing = int((dev_slice[dest] != dev_slice[my_pos]).sum())
+        if crossing:
+            topology_mod.record_cross_slice(crossing * rows.shape[1] * 4)
+
     # 2. one tiny host-side allgather carries ALL the cross-host metadata:
     # per-process (row total, mesh-device count) for capacity agreement,
     # plus the staged-map bitmap for global completeness

@@ -1,0 +1,298 @@
+"""Two-level (ICI/DCN) topology: the dataplane layer's first-class input.
+
+Production TPU jobs span *slices*: devices inside a slice are joined by
+ICI (the fabric the fused exchange rides), slices are joined by DCN /
+host links an order of magnitude slower. Until now the cost model
+(`parallel/device_plane.select_dataplane`) treated the world as one flat
+link — a whole stage was device-or-host. This module makes the two-level
+structure explicit so the dataplane layer can *factor* a redistribution
+into composable intra- and inter-slice moves (the recipe of
+"Memory-efficient array redistribution through portable collective
+communication", PAPERS.md) and treat the inter-slice channel as a
+first-class link with its own cost (RAMC, PAPERS.md):
+
+* :class:`Topology` — contiguous slice sizes along the exchange axis
+  plus per-link bandwidth coefficients (config-seeded via ``ici_gbps``
+  / ``dcn_gbps``, probe-refinable via :meth:`Topology.refine`). The
+  single-slice case is the *degenerate* topology: ``is_flat`` is True
+  and every consumer reproduces today's behavior bit-for-bit.
+* :func:`detect_topology` — derive the slice grouping automatically
+  from the mesh (`jax` device ``slice_index`` on TPU pods; the
+  per-process ownership seams ``multihost.py`` already carries on
+  virtual-device clusters), or from the ``slice_topology`` conf key
+  (virtual slicing for CI / benches on one host).
+* :func:`slice_mesh` — the per-slice sub-mesh the intra-slice fused
+  step runs over (memoized like the step builders).
+* ``CROSS_SLICE`` / :func:`record_cross_slice` — the host-side tally of
+  bytes that actually crossed the slice boundary (the analogue of
+  ``exchange.DATA_PLANE``), plus the ``cross_slice_shim`` hook point a
+  bench installs to charge a modeled DCN cost per residue byte (the
+  ``fetch_bench`` delay-shim precedent).
+
+Executor slots get the same treatment (:func:`topology_for_slots`,
+:meth:`Topology.slice_of_slot`): the reduce planner scores placements by
+link cost so partition ranges land slice-aligned and the bytes that
+cross DCN are minimized by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass, replace
+from typing import Dict, Optional, Tuple
+
+# Host-side tally of bytes moved ACROSS a slice boundary (the residue
+# the hierarchical exchange hands the host dataplane). Tests and the
+# bench assert against it the way they assert DATA_PLANE — the
+# hierarchical plan's whole point is keeping this strictly below the
+# flat plan's cross-slice traffic.
+CROSS_SLICE = {"moves": 0, "bytes": 0}
+_CROSS_SLICE_LOCK = threading.Lock()
+
+# Bench/chaos hook: a callable charged ``(nbytes)`` at every cross-slice
+# move — no-op until installed (the storage/fault shim precedent,
+# parallel/faults.py). The topo bench installs a sleep modeling the DCN
+# cost per byte so a CPU loopback run prices the two plans honestly.
+cross_slice_shim = None
+
+
+def record_cross_slice(nbytes: int) -> None:
+    """Tally one host-side cross-slice move of ``nbytes`` bytes and
+    charge the installed shim (if any)."""
+    with _CROSS_SLICE_LOCK:
+        CROSS_SLICE["moves"] += 1
+        CROSS_SLICE["bytes"] += int(nbytes)
+    shim = cross_slice_shim
+    if shim is not None:
+        shim(int(nbytes))
+
+
+def cross_slice_snapshot() -> Dict[str, int]:
+    with _CROSS_SLICE_LOCK:
+        return dict(CROSS_SLICE)
+
+
+@dataclass(frozen=True)
+class Topology:
+    """Two-level description of the exchange fabric.
+
+    ``slice_sizes[s]`` is the number of contiguous devices (along the
+    exchange axis, in mesh order) slice ``s`` owns; devices inside a
+    slice are ICI-joined, slices are DCN-joined. ``ici_gbps`` /
+    ``dcn_gbps`` are the per-link bandwidth coefficients in GB/s —
+    config-seeded (they only need to be *relatively* right for the cost
+    model to rank plans) and refinable from a probe
+    (:meth:`refine`)."""
+
+    slice_sizes: Tuple[int, ...]
+    ici_gbps: float = 100.0
+    dcn_gbps: float = 10.0
+
+    @property
+    def num_slices(self) -> int:
+        return len(self.slice_sizes)
+
+    @property
+    def num_devices(self) -> int:
+        return sum(self.slice_sizes)
+
+    @property
+    def is_flat(self) -> bool:
+        """True for the degenerate single-slice (or empty) topology: one
+        ICI domain, no DCN seam — consumers must reproduce the
+        pre-topology behavior bit-for-bit."""
+        return self.num_slices <= 1
+
+    def slice_of(self, device_pos: int) -> int:
+        """The slice owning axis position ``device_pos``."""
+        lo = 0
+        for s, size in enumerate(self.slice_sizes):
+            lo += size
+            if device_pos < lo:
+                return s
+        raise IndexError(f"device position {device_pos} outside the "
+                         f"{self.num_devices}-device topology")
+
+    def device_slices(self):
+        """``i32[num_devices]`` — slice id per axis position (the
+        vectorized ``slice_of``, what the hierarchical runner indexes
+        row destinations through)."""
+        import numpy as np
+
+        return np.repeat(np.arange(self.num_slices, dtype=np.int32),
+                         self.slice_sizes)
+
+    def slice_bounds(self, s: int) -> Tuple[int, int]:
+        """``[lo, hi)`` axis positions of slice ``s``."""
+        lo = sum(self.slice_sizes[:s])
+        return lo, lo + self.slice_sizes[s]
+
+    def slice_of_slot(self, slot: int, num_slots: int) -> int:
+        """The home slice of executor slot ``slot`` out of
+        ``num_slots``: contiguous slot ranges map onto slices
+        proportionally (the same contiguous-range convention the
+        push-merge target assignment and the metadata shard map use), so
+        co-hosted executors and their slice's devices agree on a home.
+        """
+        if num_slots <= 0:
+            return 0
+        slot = max(0, min(int(slot), num_slots - 1))
+        return self.slice_of(min(self.num_devices - 1,
+                                 slot * self.num_devices // num_slots))
+
+    def link_seconds(self, intra_bytes: int, inter_bytes: int) -> float:
+        """The two-level cost: ``intra/ici_bw + inter/dcn_bw`` (seconds
+        for the byte volumes at the configured coefficients) — the score
+        ``select_dataplane`` ranks candidate plans by."""
+        gb = 1 << 30
+        return (max(0, intra_bytes) / (self.ici_gbps * gb)
+                + max(0, inter_bytes) / (self.dcn_gbps * gb))
+
+    def uniform_inter_fraction(self) -> float:
+        """Expected cross-slice traffic fraction when sources and
+        destinations are uniform over devices: a row homed in slice s
+        stays intra with probability ``|s|/D``, so the inter fraction is
+        ``1 - sum((|s|/D)^2)`` — the cost model's estimate when a stage
+        carries no per-link byte decomposition."""
+        d = self.num_devices
+        if d == 0:
+            return 0.0
+        return 1.0 - sum((sz / d) ** 2 for sz in self.slice_sizes)
+
+    def refine(self, ici_gbps: Optional[float] = None,
+               dcn_gbps: Optional[float] = None) -> "Topology":
+        """A copy with probe-measured link coefficients (the config
+        seeds are only priors; a bench round that measured real rates
+        re-anchors the cost model here)."""
+        return replace(self,
+                       ici_gbps=self.ici_gbps if ici_gbps is None
+                       else float(ici_gbps),
+                       dcn_gbps=self.dcn_gbps if dcn_gbps is None
+                       else float(dcn_gbps))
+
+    def describe(self) -> dict:
+        """Provenance record (bench round JSONs carry it alongside
+        ``host_load_avg``)."""
+        return {"slices": self.num_slices,
+                "devices_per_slice": list(self.slice_sizes),
+                "ici_gbps": self.ici_gbps, "dcn_gbps": self.dcn_gbps}
+
+
+def _parse_slice_spec(spec: str, num_devices: int) -> Optional[Tuple[int, ...]]:
+    """Parse the ``slice_topology`` conf value: ``""`` = auto (None),
+    ``"N"`` = N equal contiguous slices, ``"a,b,c"`` = explicit sizes
+    (must sum to the device count). Invalid specs return None (auto) —
+    conf values log-and-default, never raise (config.py contract)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    try:
+        parts = [int(p) for p in spec.split(",") if p.strip()]
+    except ValueError:
+        return None
+    if not parts or any(p <= 0 for p in parts):
+        return None
+    if len(parts) == 1:
+        n = parts[0]
+        if n < 1 or num_devices % n != 0:
+            return None
+        return tuple([num_devices // n] * n)
+    return tuple(parts) if sum(parts) == num_devices else None
+
+
+def _auto_slice_sizes(devices) -> Tuple[int, ...]:
+    """Group the axis-ordered devices into contiguous runs by physical
+    slice: TPU pods expose ``slice_index`` per device; virtual-device
+    clusters fall back to ``process_index`` (the per-host seams
+    ``multihost.py`` stages across). Devices with neither (single-host
+    CPU meshes) collapse to one slice — the degenerate case."""
+    sizes = []
+    prev = object()
+    for d in devices:
+        marker = getattr(d, "slice_index", None)
+        if marker is None:
+            marker = getattr(d, "process_index", 0)
+        if marker != prev:
+            sizes.append(0)
+            prev = marker
+        sizes[-1] += 1
+    return tuple(sizes) if sizes else (0,)
+
+
+def _conf_topology(conf, num_units: int, devices=None) -> Topology:
+    """THE conf -> Topology construction every detector shares: parse
+    the ``slice_topology`` spec against ``num_units``, fall back to the
+    device-marker grouping (when ``devices`` given) or one flat slice,
+    and seed the link coefficients — one path, so the cost model, the
+    planner's slot view, and bench provenance can never disagree about
+    how a conf reads."""
+    spec = str(getattr(conf, "slice_topology", "") or "")
+    sizes = _parse_slice_spec(spec, num_units)
+    if sizes is None:
+        if devices:
+            sizes = _auto_slice_sizes(devices)
+        else:
+            sizes = (num_units,) if num_units else (0,)
+    return Topology(sizes).refine(
+        ici_gbps=getattr(conf, "ici_gbps", None),
+        dcn_gbps=getattr(conf, "dcn_gbps", None))
+
+
+def detect_topology(mesh, axis_name: Optional[str] = None,
+                    conf=None) -> Topology:
+    """The mesh's two-level topology: slice grouping from the
+    ``slice_topology`` conf key when set (virtual slicing for CI /
+    benches), else auto-derived from device ``slice_index`` /
+    ``process_index``; link coefficients seeded from ``ici_gbps`` /
+    ``dcn_gbps``. A single-slice result is the degenerate topology
+    (``is_flat``) and changes nothing downstream.
+
+    The grouping runs along the mesh's flat device order — the same
+    order every exchange in this package shards its leading axis over
+    (meshes here are one-axis by construction)."""
+    devices = list(mesh.devices.flat) if mesh is not None else []
+    return _conf_topology(conf, len(devices), devices or None)
+
+
+def host_topology(conf=None) -> Topology:
+    """The topology of EVERY device this process can see (no mesh
+    needed) — what bench rounds record in their provenance block: the
+    detected slice grouping plus the link coefficients the topo bench
+    ran under. Falls back to the empty degenerate topology when jax has
+    no devices (or is absent)."""
+    try:
+        import jax
+
+        devices = list(jax.devices())
+    except Exception:  # noqa: BLE001 — provenance must never fail a round
+        devices = []
+    return _conf_topology(conf, len(devices), devices or None)
+
+
+def topology_for_slots(conf, num_slots: int) -> Topology:
+    """The executor-slot view of the topology (for the reduce planner,
+    which places tasks on slots, not devices): ``slice_topology``
+    partitions the ``num_slots`` contiguous slots the same way it
+    partitions devices; auto (no spec) is flat — on a real multi-host
+    cluster the driver knows host boundaries from the membership plane
+    and passes an explicit topology instead."""
+    return _conf_topology(conf, num_slots)
+
+
+@functools.lru_cache(maxsize=64)
+def _slice_mesh_cached(mesh, axis_name: str, lo: int, hi: int):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = list(mesh.devices.flat)[lo:hi]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def slice_mesh(mesh, axis_name: str, topology: Topology, s: int):
+    """The sub-mesh over slice ``s``'s contiguous devices — what the
+    intra-slice fused step runs over. Memoized per (mesh, axis, bounds)
+    so per-stage callers reuse the same Mesh object and, through it, the
+    fused-step compile cache."""
+    lo, hi = topology.slice_bounds(s)
+    return _slice_mesh_cached(mesh, axis_name, lo, hi)

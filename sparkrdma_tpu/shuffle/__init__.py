@@ -17,4 +17,5 @@ from sparkrdma_tpu.shuffle.planner import (  # noqa: F401
     ReducePlanner,
     SizeHistogram,
     identity_plan,
+    slice_aligned_partition_map,
 )

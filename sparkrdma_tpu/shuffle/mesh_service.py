@@ -215,6 +215,90 @@ def run_mesh_reduce_fused(managers: Sequence[TpuShuffleManager],
     return results
 
 
+def run_mesh_reduce_hier(managers: Sequence[TpuShuffleManager],
+                         handle: ShuffleHandle, mesh, topology,
+                         axis_name: str = "shuffle", impl: str = "auto",
+                         rows_per_round: int = 0, out_factor: int = 2,
+                         expect_maps: Optional[int] = None, tracer=None,
+                         partition_map: Optional[np.ndarray] = None,
+                         ) -> List[Tuple[np.ndarray, np.ndarray,
+                                         np.ndarray]]:
+    """``run_mesh_reduce_fused`` over a MULTI-SLICE topology: the fused
+    ICI step runs per slice over its sub-mesh (the bulk bytes), and only
+    the slice-crossing residue rides the host/DCN channel, composed as
+    the factored two-phase redistribution
+    (``device_plane.run_hierarchical_exchange``).
+
+    Each staged batch's HOME slice is its staging manager's slot mapped
+    through ``Topology.slice_of_slot`` (co-hosted executors and their
+    slice's devices agree on a home — the same contiguous-range
+    convention the shard map uses). ``partition_map`` is the
+    link-cost-aware partition->device layout (``i32[P]``); None derives
+    the slice-aligned map from the staged per-slice byte histogram
+    (``planner.slice_aligned_partition_map``) so cross-slice bytes are
+    minimized by construction — the flat reduces' ``p % D`` placement is
+    what it replaces. Same result contract as ``run_mesh_reduce_fused``
+    (per-device key-sorted rows; a different partition layout only moves
+    WHICH device serves a partition, never its bytes).
+
+    Staging is WHOLE-STAGE (the one-shot fused path's contract): the
+    cost model only emits a hierarchical plan when the stage fits the
+    one-shot budget, so host staging stays within the same bound —
+    chunked-size stages keep the flat device plan's streamed rounds.
+    ``rows_per_round`` still bounds the per-slice DEVICE rounds.
+    """
+    from sparkrdma_tpu.parallel.device_plane import (
+        run_hierarchical_exchange,
+    )
+    from sparkrdma_tpu.shuffle.planner import slice_aligned_partition_map
+
+    n_dev = mesh.shape[axis_name]
+    partitioner = handle.partitioner.build(handle.num_partitions)
+    row_bytes = 4 * device_row_words(handle.row_payload_bytes)
+    num_mgrs = max(1, len(managers))
+
+    all_rows, all_parts, all_home = [], [], []
+    part_bytes = np.zeros((topology.num_slices, handle.num_partitions),
+                          dtype=np.int64)
+    delivered: set = set()
+    for i, k, p in _iter_committed_batches_indexed(managers, handle,
+                                                   delivered):
+        home = topology.slice_of_slot(i, num_mgrs)
+        parts = np.asarray(partitioner(k), dtype=np.int64)
+        np.add.at(part_bytes[home], parts, row_bytes)
+        all_rows.append(_rows_to_u32(k, p))
+        all_parts.append(parts)
+        all_home.append(np.full(len(k), home, dtype=np.int32))
+    _check_staging_complete(delivered, expect_maps, handle.shuffle_id)
+    if not all_rows:
+        rows = np.zeros((0, device_row_words(handle.row_payload_bytes)),
+                        np.uint32)
+        parts = np.zeros(0, np.int64)
+        home = np.zeros(0, np.int32)
+    else:
+        rows = np.concatenate(all_rows)
+        parts = np.concatenate(all_parts)
+        home = np.concatenate(all_home)
+
+    if partition_map is None:
+        partition_map = slice_aligned_partition_map(part_bytes, topology,
+                                                    n_dev)
+    dest = partition_map[parts].astype(np.int32) if len(parts) else \
+        np.zeros(0, np.int32)
+
+    per_device, _rounds = run_hierarchical_exchange(
+        mesh, axis_name, topology, rows, dest, home, key_words=2,
+        rows_per_round=rows_per_round, out_factor=out_factor, impl=impl,
+        tracer=tracer)
+
+    results = []
+    for d in range(n_dev):
+        k, p = _u32_to_rows(per_device[d], handle.row_payload_bytes)
+        pts = np.asarray(partitioner(k), dtype=np.int64)
+        results.append((k, p, pts))
+    return results
+
+
 def _stage_all(managers, handle, expect_maps: Optional[int]
                ) -> Tuple[np.ndarray, np.ndarray]:
     """Stage every committed local spill into one (keys, payload) pair:
@@ -236,7 +320,21 @@ def _stage_all(managers, handle, expect_maps: Optional[int]
 
 
 def _iter_committed_batches(managers, handle, delivered: Optional[set] = None):
-    """Decoded (keys, payload) batches of every committed local spill.
+    """Decoded (keys, payload) batches of every committed local spill —
+    ``_iter_committed_batches_indexed`` minus the staging-manager index
+    (the flat reduces don't care which executor held a map; the
+    hierarchical reduce does — the index names the home slice)."""
+    for _, k, p in _iter_committed_batches_indexed(managers, handle,
+                                                   delivered):
+        yield k, p
+
+
+def _iter_committed_batches_indexed(managers, handle,
+                                    delivered: Optional[set] = None):
+    """Decoded (manager_index, keys, payload) batches of every committed
+    local spill — THE staging hook: every mesh reduce driver (one-shot,
+    streamed, fused, hierarchical) stages through this one generator,
+    so a shim or chaos injection wrapped around it covers them all.
 
     Each map id is taken from the FIRST resolver holding it: stage retry
     and speculation can leave identical copies of one map output on two
@@ -249,7 +347,7 @@ def _iter_committed_batches(managers, handle, delivered: Optional[set] = None):
     from sparkrdma_tpu.shuffle.writer import decode_rows
 
     seen: set = set()
-    for mgr in managers:
+    for i, mgr in enumerate(managers):
         if mgr.resolver is None:
             continue
         for m in mgr.resolver.map_ids(handle.shuffle_id):
@@ -268,7 +366,7 @@ def _iter_committed_batches(managers, handle, delivered: Optional[set] = None):
             seen.add(m)
             if delivered is not None:
                 delivered.add(m)
-            yield decode_rows(raw, handle.row_payload_bytes)
+            yield (i,) + decode_rows(raw, handle.row_payload_bytes)
 
 
 def _check_staging_complete(delivered: set, expect_maps: Optional[int],

@@ -261,6 +261,64 @@ def identity_plan(shuffle_id: int, num_maps: int, num_partitions: int,
                       tasks)
 
 
+def slice_aligned_partition_map(part_bytes_by_slice, topology,
+                                num_devices: int) -> np.ndarray:
+    """The link-cost-aware partition->device layout (``i32[P]``): each
+    partition lands in the slice that PRODUCED most of its bytes, so the
+    bytes that must cross the DCN seam are minimized by construction —
+    the hierarchical reduce's replacement for the flat ``p % D``
+    placement (which interleaves partitions across slices and makes
+    ~``1 - sum((|s|/D)^2)`` of every stage's bytes cross-slice no matter
+    where they were produced).
+
+    ``part_bytes_by_slice: i64[S, P]`` is the per-slice byte histogram
+    (the same size column the adaptive planner consumes, summed by the
+    producing executor's home slice). Greedy, deterministic, balanced:
+    partitions place byte-descending into their best-producing slice
+    (ties: lower slice) unless that slice's assigned bytes already
+    exceed ``BALANCE_FACTOR`` x its devices-proportional share — then
+    the least-normalized-loaded slice; within a slice, the
+    least-loaded device (ties: fewest partitions, lower id). A flat
+    topology reproduces ``p % D`` bit-for-bit."""
+    hist = np.asarray(part_bytes_by_slice, dtype=np.int64)
+    num_parts = hist.shape[1] if hist.ndim == 2 else 0
+    if (topology is None or topology.is_flat or num_devices <= 0
+            or hist.ndim != 2):
+        return (np.arange(max(0, num_parts), dtype=np.int32)
+                % max(1, num_devices))
+    n_slices = hist.shape[0]
+    totals = hist.sum(axis=0)
+    total = int(totals.sum())
+    share = np.array([topology.slice_sizes[s] / max(1, num_devices)
+                      for s in range(n_slices)])
+    cap = ReducePlanner.BALANCE_FACTOR * total * share
+    slice_load = np.zeros(n_slices, dtype=np.int64)
+    dev_lo = [topology.slice_bounds(s)[0] for s in range(n_slices)]
+    dev_hi = [topology.slice_bounds(s)[1] for s in range(n_slices)]
+    dev_load = np.zeros(num_devices, dtype=np.int64)
+    dev_count = np.zeros(num_devices, dtype=np.int64)
+    out = np.zeros(num_parts, dtype=np.int32)
+    order = sorted(range(num_parts), key=lambda p: (-int(totals[p]), p))
+    for p in order:
+        best = max(range(n_slices),
+                   key=lambda s: (int(hist[s, p]), -int(slice_load[s]), -s))
+        if total and slice_load[best] >= cap[best]:
+            # the producing slice already carries its fair share: spill
+            # to the least-normalized-loaded slice (same existing-load
+            # gate as the planner's locality placement)
+            best = min(range(n_slices),
+                       key=lambda s: (slice_load[s] / max(share[s], 1e-9),
+                                      s))
+        devs = range(dev_lo[best], dev_hi[best])
+        d = min(devs, key=lambda i: (int(dev_load[i]), int(dev_count[i]),
+                                     i))
+        out[p] = d
+        slice_load[best] += int(totals[p])
+        dev_load[d] += int(totals[p])
+        dev_count[d] += 1
+    return out
+
+
 class ReducePlanner:
     """Size-driven plan construction + mid-stage re-planning.
 
@@ -276,6 +334,36 @@ class ReducePlanner:
         self.coalesce_target = int(conf.coalesce_target_bytes)
         self.split_threshold = int(conf.split_threshold_bytes)
         self.locality = bool(conf.locality_placement)
+        # slot topology for link-cost placement: the slice_topology spec
+        # partitions executor SLOTS the way it partitions devices; a
+        # flat result (the default) keeps placement purely byte-driven
+        self._conf = conf
+
+    def _slot_topology(self, num_slots: int):
+        """The executor-slot view of the two-level topology (None /
+        flat = pre-topology placement, bit-for-bit)."""
+        from sparkrdma_tpu.parallel.topology import topology_for_slots
+
+        topo = topology_for_slots(self._conf, num_slots)
+        return None if topo.is_flat else topo
+
+    @staticmethod
+    def _link_cost(per_slot: Dict[int, int], slot: int, slot_slice,
+                   topo) -> float:
+        """Seconds to move one task's input bytes to ``slot`` under the
+        two-level link coefficients: co-located bytes are free, same-
+        slice bytes ride ICI, cross-slice bytes pay the DCN price — the
+        planner's placement generalized from "most bytes here" to
+        "cheapest link bill"."""
+        gb = 1 << 30
+        here = slot_slice(slot)
+        cost = 0.0
+        for o, b in per_slot.items():
+            if o == slot:
+                continue
+            bw = topo.ici_gbps if slot_slice(o) == here else topo.dcn_gbps
+            cost += b / (bw * gb)
+        return cost
 
     # -- plan construction ------------------------------------------------
 
@@ -364,10 +452,13 @@ class ReducePlanner:
                live_slots: List[int]) -> ReducePlan:
         """Greedy locality placement under a balance cap: each task (in
         byte-descending order, so the big rocks place first) goes to the
-        live slot holding the largest share of its input, unless that
-        slot's assigned bytes already exceed BALANCE_FACTOR x the even
-        share — then the least-loaded live slot. Deterministic: ties
-        break on the lower slot index."""
+        live slot holding the largest share of its input — or, on a
+        multi-slice slot topology, the slot with the LOWEST two-level
+        link bill (co-located bytes free, same-slice at ICI, cross-slice
+        at DCN: ``_link_cost``), so reduce ranges land slice-aligned —
+        unless that slot's assigned bytes already exceed BALANCE_FACTOR
+        x the even share — then the least-loaded live slot.
+        Deterministic: ties break on the lower slot index."""
         if not self.locality or not live_slots:
             return plan
         # one histogram pass per task: the slot-byte dicts feed both the
@@ -379,15 +470,28 @@ class ReducePlanner:
         total = sum(task_bytes.values())
         cap = ((total / max(1, len(live_slots))) * self.BALANCE_FACTOR
                if total else float("inf"))
+        num_slots = 1 + max([*live_slots,
+                             *(o for o in owners.values()
+                               if o is not None and o >= 0), 0])
+        topo = self._slot_topology(num_slots)
+        slot_slice = ((lambda s: topo.slice_of_slot(s, num_slots))
+                      if topo is not None else None)
         assigned: Dict[int, int] = {s: 0 for s in live_slots}
         placement: Dict[int, int] = {}
         order = sorted(plan.tasks,
                        key=lambda t: (-task_bytes[t.task_id], t.task_id))
         for t in order:
             per_slot = slot_bytes[t.task_id]
-            best = max(
-                (s for s in live_slots),
-                key=lambda s: (per_slot.get(s, 0), -assigned[s], -s))
+            if topo is not None:
+                best = min(
+                    (s for s in live_slots),
+                    key=lambda s: (self._link_cost(per_slot, s,
+                                                   slot_slice, topo),
+                                   assigned[s], s))
+            else:
+                best = max(
+                    (s for s in live_slots),
+                    key=lambda s: (per_slot.get(s, 0), -assigned[s], -s))
             if assigned[best] >= cap:
                 # the locality slot already carries its fair share:
                 # spill to the least-loaded (the gate is on EXISTING
@@ -429,11 +533,25 @@ class ReducePlanner:
                 keep[t.task_id] = t.placement
                 if t.placement in assigned:
                     assigned[t.placement] += 1
+        num_slots = 1 + max([*live,
+                             *(o for o in owners.values()
+                               if o is not None and o >= 0), 0])
+        topo = self._slot_topology(num_slots)
+        slot_slice = ((lambda s: topo.slice_of_slot(s, num_slots))
+                      if topo is not None else None)
         new_place: Dict[int, int] = dict(keep)
         for t in orphans:
             per_slot = self._task_slot_bytes(t, hist, owners)
-            live_sorted = sorted(
-                live, key=lambda s: (-per_slot.get(s, 0), assigned[s], s))
+            if topo is not None:
+                # link-cost scoring: orphans re-home to the cheapest
+                # slot under the two-level coefficients, same as _place
+                live_sorted = sorted(
+                    live, key=lambda s: (self._link_cost(
+                        per_slot, s, slot_slice, topo), assigned[s], s))
+            else:
+                live_sorted = sorted(
+                    live, key=lambda s: (-per_slot.get(s, 0),
+                                         assigned[s], s))
             best = live_sorted[0] if live_sorted else -1
             new_place[t.task_id] = best
             if best in assigned:

@@ -43,6 +43,7 @@ SPANS = frozenset({
 INSTANTS = frozenset({
     "commit.fenced",
     "exchange.degrade",
+    "exchange.hierarchical",
     "exchange.overlap",
     "exchange.select",
     "fetch.coalesce_fallback",

@@ -749,7 +749,10 @@ def test_chaos_device_plane_loss_degrades_to_host(tmp_path, monkeypatch):
             holder["degraded"] = {}
             state = {"fired": False}
             if chaos:
-                orig_iter = mesh_service._iter_committed_batches
+                # the INDEXED iterator is the one staging hook every
+                # mesh reduce driver (one-shot, fused, hierarchical)
+                # flows through — injecting here covers them all
+                orig_iter = mesh_service._iter_committed_batches_indexed
 
                 def chaos_iter(managers, handle, delivered=None):
                     for batch in orig_iter(managers, handle, delivered):
@@ -765,15 +768,17 @@ def test_chaos_device_plane_loss_degrades_to_host(tmp_path, monkeypatch):
                             victim.resolver.remove_shuffle(
                                 handle.shuffle_id)
 
-                monkeypatch.setattr(mesh_service,
-                                    "_iter_committed_batches", chaos_iter)
+                monkeypatch.setattr(
+                    mesh_service, "_iter_committed_batches_indexed",
+                    chaos_iter)
             stage = MapStage(maps, ShuffleDependency(
                 P, PartitionerSpec("modulo"), row_payload_bytes=4),
                 map_fn)
             out = engine.run(ResultStage(P, reduce_fn, parents=[stage]))
             if chaos:
-                monkeypatch.setattr(mesh_service,
-                                    "_iter_committed_batches", orig_iter)
+                monkeypatch.setattr(
+                    mesh_service, "_iter_committed_batches_indexed",
+                    orig_iter)
             return out, engine, state
         finally:
             for ex in execs:

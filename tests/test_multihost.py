@@ -103,6 +103,12 @@ for m in ((0, 1) if pid == 0 else (2, 3)):
 mesh = global_mesh("shuffle")
 results = run_multihost_mesh_reduce([mgr], handle, mesh)
 
+# the 2-process cluster IS a 2-slice topology (per-host seams): the
+# reduce must have tallied its cross-host bytes on the DCN metric
+from sparkrdma_tpu.parallel import topology as topo_mod
+assert not topo_mod.detect_topology(mesh).is_flat, "seams undetected"
+assert topo_mod.CROSS_SLICE["bytes"] > 0, "per-host seam traffic untallied"
+
 # verify OUR devices against the deterministic global truth
 tk = np.concatenate([table(m)[0] for m in range(MAPS)])
 tp = np.concatenate([table(m)[1] for m in range(MAPS)])
