@@ -52,10 +52,12 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include <arpa/inet.h>
@@ -105,6 +107,10 @@ constexpr size_t kInHighWater = 4u << 20;
 // iovec batch per sendmsg() flush: plenty for a coalesced response's
 // header + data windows + trailer, comfortably under IOV_MAX.
 constexpr int kMaxIov = 64;
+// Fair-share mode: parsed requests a connection may hold in its worker's
+// tenant queues before the connection stops being read (the queue-depth
+// analogue of kInHighWater — bounds deferred-request memory per conn).
+constexpr uint32_t kMaxPendingPerConn = 4096;
 
 // CRC-32 (IEEE 802.3, the zlib polynomial) — table-driven, computed inline
 // so the shared library needs no zlib link. Must match Python's
@@ -202,6 +208,7 @@ struct Region {
   int refs = 1;          // registration + in-flight pins (files_mu)
   bool evicted = false;  // unmapped by LRU pressure; next map is a remap
   uint64_t last_use = 0; // LRU tick of the last serve touching it
+  uint32_t tenant = 0;   // owning tenant (fair-share queueing + eviction)
   std::vector<CrcRange> crcs;  // sorted, disjoint; empty = no attestation
 };
 
@@ -223,12 +230,33 @@ struct Conn {
   std::vector<uint8_t> in;  // accumulated unparsed bytes
   std::deque<OutSeg> out;   // pending response segments, in send order
   size_t out_bytes = 0;     // total unsent bytes across `out`
+  uint32_t queued = 0;      // fair-mode requests parked in tenant queues
 };
 
 struct Server;
 
+// One parsed-but-deferred request (fair-share mode): the block list is
+// COPIED out of the connection's input buffer so the buffer can compact
+// while the request waits its DRR turn.
+struct PendingReq {
+  Conn* c = nullptr;
+  int64_t req_id = 0;
+  std::vector<uint8_t> blocks;  // count * 16 bytes
+  uint32_t count = 0;
+  size_t plen = 0;
+  uint64_t cost = 0;  // requested payload bytes (the DRR currency)
+};
+
+// One tenant's FIFO of deferred requests + its DRR deficit counter.
+struct TenantQ {
+  std::deque<PendingReq> q;
+  uint64_t deficit = 0;
+};
+
 // One epoll loop; owns the connections assigned to it. Never touched by
-// other threads except through (pending_mu, pending, wake_fd).
+// other threads except through (pending_mu, pending, wake_fd). The
+// fair-share tenant queues are worker-local: requests defer and dispatch
+// on the SAME thread that parsed them, so the DRR needs no locking.
 struct Worker {
   Server* server = nullptr;
   int epoll_fd = -1;
@@ -237,6 +265,8 @@ struct Worker {
   std::unordered_map<int, Conn*> conns;
   std::mutex pending_mu;
   std::vector<int> pending;  // accepted fds awaiting registration here
+  std::map<uint32_t, TenantQ> tq;  // tenant -> deferred requests (DRR)
+  size_t pending_reqs = 0;         // total deferred across tq
 };
 
 struct Server {
@@ -250,6 +280,12 @@ struct Server {
   std::atomic<bool> stop{false};
   std::atomic<bool> checksum{false};   // append per-block CRC32 trailers
   std::atomic<bool> zero_copy{true};   // serve from the mapping when legal
+  // Fair-share mode (tenancy): requests queue per owning tenant of the
+  // requested token and dispatch by byte-cost deficit round robin
+  // instead of parse order. Off = exact legacy inline serving.
+  std::atomic<bool> fair{false};
+  std::atomic<uint64_t> fair_quantum{256u << 10};
+  std::atomic<uint64_t> fair_queued{0};  // requests ever deferred (audit)
   // files_mu guards ONLY token lookup + region refcount/mapping/LRU
   // bookkeeping — O(blocks) pointer work per request. No payload byte is
   // ever touched under it, so a 256 MiB response can't serialize the
@@ -323,8 +359,31 @@ void enforce_budget_locked(Server* s) {
             [](const Region* a, const Region* b) {
               return a->last_use < b->last_use;
             });
+  if (s->fair.load(std::memory_order_relaxed)) {
+    // Tenancy-aware first pass: evict (LRU) only regions of tenants
+    // holding MORE than their even share of the budget — the dynamic
+    // per-tenant sizing of the registered set (NP-RDMA's
+    // registration-on-demand argument, per tenant). The plain LRU pass
+    // below mops up whatever imbalance this pass couldn't express.
+    std::map<uint32_t, uint64_t> mapped_by;  // tenant -> mapped bytes
+    for (auto& [tok, r] : s->files) {
+      (void)tok;
+      if (r->base) mapped_by[r->tenant] += r->size;
+    }
+    if (mapped_by.size() > 1) {
+      uint64_t share = s->region_budget / mapped_by.size();
+      for (Region* r : victims) {
+        if (s->mapped_bytes <= s->region_budget) return;
+        if (!r->base || mapped_by[r->tenant] <= share) continue;
+        mapped_by[r->tenant] -= r->size;
+        r->evicted = true;
+        region_unmap_locked(s, r);
+      }
+    }
+  }
   for (Region* r : victims) {
     if (s->mapped_bytes <= s->region_budget) break;
+    if (!r->base) continue;  // already evicted by the tenant pass
     r->evicted = true;
     region_unmap_locked(s, r);
   }
@@ -403,6 +462,22 @@ void close_conn(Worker* w, Conn* c) {
   epoll_ctl(w->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
   w->conns.erase(c->fd);
+  // purge this connection's deferred fair-mode requests: they hold a
+  // Conn* that is about to dangle (worker-local, so no lock needed)
+  if (c->queued) {
+    for (auto it = w->tq.begin(); it != w->tq.end();) {
+      std::deque<PendingReq>& q = it->second.q;
+      for (auto rit = q.begin(); rit != q.end();) {
+        if (rit->c == c) {
+          rit = q.erase(rit);
+          --w->pending_reqs;
+        } else {
+          ++rit;
+        }
+      }
+      it = q.empty() ? w->tq.erase(it) : std::next(it);
+    }
+  }
   // release the pins of undelivered zero-copy windows (one lock hold)
   std::vector<Region*> drained;
   for (OutSeg& seg : c->out)
@@ -412,7 +487,9 @@ void close_conn(Worker* w, Conn* c) {
 }
 
 void arm(Worker* w, Conn* c) {
-  bool want_in = c->in.size() < kInHighWater && c->out_bytes < kOutHighWater;
+  bool want_in = c->in.size() < kInHighWater &&
+                 c->out_bytes < kOutHighWater &&
+                 c->queued < kMaxPendingPerConn;
   epoll_event ev{};
   ev.events = (want_in ? EPOLLIN : 0u) | (c->out_bytes ? EPOLLOUT : 0u);
   ev.data.ptr = c;
@@ -606,11 +683,16 @@ void serve_request(Server* s, Conn* c, int64_t req_id, const uint8_t* blocks,
   s->zero_copy_blocks.fetch_add(zc_blocks, std::memory_order_relaxed);
 }
 
-// Parse + serve every complete frame in c->in; append responses to c->out.
-bool process_frames(Server* s, Conn* c) {
+// Parse every complete frame in c->in. Legacy (FIFO) mode serves each
+// request inline, appending responses to c->out; fair-share mode DEFERS
+// each request into the worker's per-tenant DRR queues (tenant = owner
+// of the first block's token), dispatched by drain_pending.
+bool process_frames(Server* s, Worker* w, Conn* c) {
+  bool fair = s->fair.load(std::memory_order_relaxed);
   size_t pos = 0;
   while (c->in.size() - pos >= 8) {
     if (c->out_bytes > kOutHighWater) break;  // backpressure
+    if (fair && c->queued >= kMaxPendingPerConn) break;
     uint32_t total, type;
     memcpy(&total, c->in.data() + pos, 4);
     memcpy(&type, c->in.data() + pos + 4, 4);
@@ -627,11 +709,93 @@ bool process_frames(Server* s, Conn* c) {
     memcpy(&req_id, p, 8);
     // p+8..12: shuffle_id (unused server-side: tokens are global)
     memcpy(&count, p + 12, 4);
-    serve_request(s, c, req_id, p + 16, count, plen);
+    if (!fair) {
+      serve_request(s, c, req_id, p + 16, count, plen);
+    } else {
+      PendingReq r;
+      r.c = c;
+      r.req_id = req_id;
+      r.count = count;
+      r.plen = plen;
+      size_t blen = plen >= 16 ? plen - 16 : 0;
+      r.blocks.assign(p + 16, p + 16 + blen);
+      uint32_t tenant = 0;
+      if (count > 0 && blen >= (size_t)count * 16) {
+        for (uint32_t i = 0; i < count; ++i) {
+          uint32_t length;
+          memcpy(&length, r.blocks.data() + i * 16 + 12, 4);
+          r.cost += length;
+        }
+        uint32_t token;
+        memcpy(&token, r.blocks.data(), 4);
+        std::lock_guard<std::mutex> lk(s->files_mu);
+        auto it = s->files.find(token);
+        if (it != s->files.end()) tenant = it->second->tenant;
+      }
+      w->tq[tenant].q.push_back(std::move(r));
+      ++w->pending_reqs;
+      ++c->queued;
+      s->fair_queued.fetch_add(1, std::memory_order_relaxed);
+    }
     pos += total;
   }
   if (pos) c->in.erase(c->in.begin(), c->in.begin() + pos);
   return true;
+}
+
+// Dispatch deferred requests by deficit round robin: each pass grants
+// every queued tenant one quantum of byte credit and serves head-of-line
+// requests that fit it (per-tenant FIFO preserved). A connection past
+// its out high-water mark parks its tenant's head until the socket
+// drains — other tenants keep dispatching around it. Returns the set of
+// connections that gained output (the caller flushes + re-arms them).
+void drain_pending(Worker* w, std::unordered_set<Conn*>& touched) {
+  Server* s = w->server;
+  if (w->pending_reqs == 0) return;
+  uint64_t quantum = s->fair_quantum.load(std::memory_order_relaxed);
+  // Loop passes until every queue is empty or every head is parked on
+  // its connection's out high-water mark. A head merely short on
+  // deficit keeps the loop going (`starved`): its deficit grows by one
+  // quantum per pass, so a request costing K quanta dispatches after K
+  // passes of THIS call — never parked until the next epoll tick.
+  bool again = true;
+  while (w->pending_reqs > 0 && again) {
+    again = false;
+    for (auto it = w->tq.begin(); it != w->tq.end();) {
+      TenantQ& tq = it->second;
+      if (tq.q.empty()) {
+        it = w->tq.erase(it);
+        continue;
+      }
+      if (tq.q.front().c->out_bytes > kOutHighWater) {
+        // head parked on its socket: no quantum grant while blocked (a
+        // long-blocked tenant must not bank credit and later burst)
+        ++it;
+        continue;
+      }
+      tq.deficit += quantum;
+      while (!tq.q.empty()) {
+        PendingReq& r = tq.q.front();
+        if (r.c->out_bytes > kOutHighWater) break;  // socket-blocked
+        if (r.cost > tq.deficit) {
+          again = true;  // starved, not blocked: grow and retry
+          break;
+        }
+        tq.deficit -= r.cost;
+        serve_request(s, r.c, r.req_id, r.blocks.data(), r.count, r.plen);
+        touched.insert(r.c);
+        --r.c->queued;
+        tq.q.pop_front();
+        --w->pending_reqs;
+        again = true;
+      }
+      if (tq.q.empty()) {
+        it = w->tq.erase(it);  // drained: leftover deficit forfeits
+      } else {
+        ++it;
+      }
+    }
+  }
 }
 
 // Flush pending segments with one gathered sendmsg per syscall (writev
@@ -692,7 +856,7 @@ void worker_loop(Worker* w) {
           fds.swap(w->pending);
         }
         for (int fd : fds) {
-          Conn* c = new Conn{fd, {}, {}, 0};
+          Conn* c = new Conn{fd, {}, {}, 0, 0};
           w->conns[fd] = c;
           epoll_event ev{};
           ev.events = EPOLLIN;
@@ -718,14 +882,14 @@ void worker_loop(Worker* w) {
             break;
           }
         }
-        if (!dead && !process_frames(s, c)) dead = true;
+        if (!dead && !process_frames(s, w, c)) dead = true;
       }
       if (!dead && c->out_bytes) {
         if (!flush_out(s, c)) dead = true;
         if (!dead && c->out_bytes == 0) {
           // backlog drained: serve any requests parked by the high-water
           // mark while we were blocked on the socket
-          if (!c->in.empty() && !process_frames(s, c)) dead = true;
+          if (!c->in.empty() && !process_frames(s, w, c)) dead = true;
           if (!dead && c->out_bytes && !flush_out(s, c)) dead = true;
         }
       }
@@ -734,6 +898,48 @@ void worker_loop(Worker* w) {
       } else {
         arm(w, c);
       }
+    }
+    // fair-share dispatch: requests deferred into the tenant queues by
+    // this pass's parses (or parked earlier behind a blocked socket)
+    // dispatch by DRR now, then their connections flush + re-arm. Runs
+    // every loop iteration, so a parked backlog retries at least every
+    // epoll timeout even with no new events. Loops until no progress:
+    // a connection parked at kMaxPendingPerConn still holds complete
+    // unparsed frames in c->in that no future epoll event may ever
+    // announce (the kernel rx buffer can be empty and the out side
+    // fully flushed) — once dispatch frees its queue slots, those
+    // frames must re-parse HERE or the client hangs.
+    while (w->pending_reqs > 0) {
+      // every Conn* in `touched` is live: a closed connection's
+      // deferred requests were purged by close_conn, so drain_pending
+      // can never have served it, and nothing in this loop closes a
+      // connection other than the one being flushed
+      std::unordered_set<Conn*> touched;
+      drain_pending(w, touched);
+      if (touched.empty()) break;  // every head socket-blocked: retry
+                                   // on EPOLLOUT / next epoll tick
+      bool parsed_more = false;
+      for (Conn* c : touched) {
+        if (!flush_out(s, c)) {
+          close_conn(w, c);
+          continue;
+        }
+        if (c->out_bytes == 0 && !c->in.empty()) {
+          uint32_t before = c->queued;
+          if (!process_frames(s, w, c)) {
+            close_conn(w, c);
+            continue;
+          }
+          if (c->queued > before) parsed_more = true;
+          if (c->out_bytes && !flush_out(s, c)) {
+            close_conn(w, c);
+            continue;
+          }
+        }
+        arm(w, c);
+      }
+      if (!parsed_more) break;  // nothing newly deferred; what's left
+                                // is parked behind blocked sockets
     }
   }
 }
@@ -895,10 +1101,13 @@ void bs_set_region_budget(void* handle, uint64_t budget) {
   enforce_budget_locked(s);
 }
 
-// Register `path` for serving under `token` — registration-on-demand: the
-// file is validated (open/fstat) but NOT mapped; the first serve maps it.
-// Returns 0 on success.
-int bs_register_file(void* handle, uint32_t token, const char* path) {
+// Register `path` for serving under `token` for `tenant` —
+// registration-on-demand: the file is validated (open/fstat) but NOT
+// mapped; the first serve maps it. The tenant tag keys fair-share
+// request queueing and the budget eviction's per-tenant share. Returns
+// 0 on success.
+int bs_register_file2(void* handle, uint32_t token, const char* path,
+                      uint32_t tenant) {
   Server* s = (Server*)handle;
   int fd = open(path, O_RDONLY);
   if (fd < 0) return -1;
@@ -911,12 +1120,34 @@ int bs_register_file(void* handle, uint32_t token, const char* path) {
   r->path = path;
   r->size = (uint64_t)st.st_size;
   r->fd = fd;  // retained: pins the inode against rename-over re-commits
+  r->tenant = tenant;
   std::lock_guard<std::mutex> lk(s->files_mu);
   auto it = s->files.find(token);
   if (it != s->files.end())
     region_unpin_locked(s, it->second);  // replace: old region drains out
   s->files[token] = r;
   return 0;
+}
+
+// Legacy single-tenant registration (kept for older control planes and
+// the sanitizer harness): everything lands under tenant 0.
+int bs_register_file(void* handle, uint32_t token, const char* path) {
+  return bs_register_file2(handle, token, path, 0);
+}
+
+// Deficit-round-robin fair-share serving (the fair_share_serving /
+// fair_share_quantum_bytes config keys): on, requests defer into
+// per-tenant worker-local queues and dispatch by byte-cost DRR; off
+// (the default) preserves the legacy inline FIFO serve exactly.
+void bs_set_fair(void* handle, int enabled, uint64_t quantum_bytes) {
+  Server* s = (Server*)handle;
+  if (quantum_bytes > 0) s->fair_quantum.store(quantum_bytes);
+  s->fair.store(enabled != 0);
+}
+
+// Requests ever deferred through the fair-share queues (audit gauge).
+uint64_t bs_fair_queued(void* handle) {
+  return ((Server*)handle)->fair_queued.load();
 }
 
 // Attach attested CRC ranges (at-rest sidecar partitions / merge-ledger

@@ -35,6 +35,15 @@
 #                               the whole fault matrix, byte-identical;
 #                               the dedicated merge scenarios force it
 #                               on regardless)
+#   CHAOS_TENANT_MODES="0 1"    tenancy modes to sweep (default both:
+#                               off, and CHAOS_TENANT=1 so every
+#                               shuffle registers under a real tenant
+#                               id — TenantMapMsg pushes, serve-path
+#                               DRR queues, ledger charging, a live
+#                               TTL sweeper — under the whole fault
+#                               matrix; the cross-tenant isolation
+#                               scenarios assert blast radius
+#                               regardless)
 #   CHAOS_DISK=0          drop the storage-fault matrix from the sweep
 #   CHAOS_LOCKGRAPH=1     run every scenario under the lock-order shim
 #                         (sparkrdma_tpu/analysis/lockgraph.py): the
@@ -49,30 +58,37 @@ MODES=${CHAOS_COALESCE_MODES:-"1 0"}
 WARM_MODES=${CHAOS_WARM_MODES:-"1 0"}
 SKEW_MODES=${CHAOS_SKEW_MODES:-"0 1"}
 MERGE_MODES=${CHAOS_MERGE_MODES:-"0 1"}
+TENANT_MODES=${CHAOS_TENANT_MODES:-"0 1"}
 DISK=${CHAOS_DISK:-1}
 failed=()
+for tenant in $TENANT_MODES; do
 for merge in $MERGE_MODES; do
 for skew in $SKEW_MODES; do
 for warm in $WARM_MODES; do
 for coalesce in $MODES; do
   for seed in $SEEDS; do
     echo "=== chaos sweep: seed ${seed} coalesce=${coalesce}" \
-         "warm=${warm} skew=${skew} merge=${merge} disk=${DISK} ==="
+         "warm=${warm} skew=${skew} merge=${merge}" \
+         "tenant=${tenant} disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
          CHAOS_WARM="${warm}" CHAOS_SKEW="${skew}" \
-         CHAOS_MERGE="${merge}" CHAOS_DISK="${DISK}" \
+         CHAOS_MERGE="${merge}" CHAOS_TENANT="${tenant}" \
+         CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
            -p no:cacheprovider -p no:randomly; then
       echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm}" \
-           "skew=${skew} merge=${merge} FAILED — replay with:"
+           "skew=${skew} merge=${merge} tenant=${tenant}" \
+           "FAILED — replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
            "CHAOS_WARM=${warm} CHAOS_SKEW=${skew}" \
-           "CHAOS_MERGE=${merge} CHAOS_DISK=${DISK}" \
+         "CHAOS_MERGE=${merge} CHAOS_TENANT=${tenant}" \
+           "CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}")
+      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}t${tenant}")
     fi
   done
+done
 done
 done
 done
@@ -83,5 +99,5 @@ if [ "${#failed[@]}" -gt 0 ]; then
   exit 1
 fi
 echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
-     "planes, both reduce-planning modes, both push-merge modes" \
-     "(disk=${DISK})"
+     "planes, both reduce-planning modes, both push-merge modes," \
+     "both tenancy modes (disk=${DISK})"

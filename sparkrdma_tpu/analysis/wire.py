@@ -353,6 +353,9 @@ _IGNORED_NATIVE = {
         "kInHighWater",     # inbound buffering threshold
         "kMaxIov",          # iovec batch per sendmsg flush, never on the
                             # wire (IOV_MAX-bounded server tuning)
+        "kMaxPendingPerConn",  # fair-share deferred-request cap per
+                               # connection; pure server memory tuning,
+                               # clients just see backpressure
     },
     "arena.cpp": {
         "kMaxRegion",       # allocator carve-region size, never on the wire

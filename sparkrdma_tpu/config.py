@@ -424,6 +424,80 @@ _KEYS = [
              "device_hbm_budget — the preferred sizing; a nonzero value "
              "still pins the round size (one deprecation warning per "
              "process) so mixed-version configs stay parseable."),
+    # --- tenancy / multi-tenant service (TPU-only: shuffle/tenancy.py,
+    # docs/CONFIG.md "Tenancy")
+    _Key("fair_share_serving", True, "bool",
+         doc="Deficit-round-robin fair-share scheduling on BOTH serve "
+             "paths (the Python serve loop and the native block "
+             "server's request queue): block requests queue per tenant "
+             "of the shuffle being served and dispatch by byte-cost "
+             "DRR, so one tenant's wide fan-in cannot starve another "
+             "tenant's latency-sensitive fetch. The registered-region "
+             "pool's LRU eviction also prefers regions of tenants over "
+             "their even share of registered_region_budget. With one "
+             "tenant (every pre-tenancy deployment) DRR degenerates to "
+             "FIFO exactly. Off = plain FIFO serving (the regression "
+             "escape hatch and the isolation bench's baseline)."),
+    _Key("fair_share_quantum_bytes", "256k", "bytes", 1024, 1 << 30,
+         doc="DRR quantum: bytes each tenant's serve queue may dispatch "
+             "per scheduling round. Smaller = tighter latency isolation "
+             "but more rounds; the default matches "
+             "shuffle_read_block_size so one per-map read is one "
+             "quantum."),
+    _Key("admission_max_inflight", 0, "int", 0, 1 << 20,
+         doc="Per-tenant cap on concurrently registered (in-flight) "
+             "shuffles at the driver. Past it, registerShuffle parks in "
+             "a bounded FIFO queue and — past admission_queue_depth or "
+             "the park deadline — is rejected with an AdmissionRejected "
+             "carrying a retry-after hint, shedding load cleanly "
+             "instead of OOMing shared pools. 0 = no admission control "
+             "(the pre-tenancy behavior)."),
+    _Key("admission_queue_depth", 16, "int", 0, 1 << 20,
+         doc="Queued registerShuffle calls allowed per tenant past its "
+             "in-flight cap before queue-or-reject rejects outright."),
+    _Key("admission_retry_after_ms", 1000, "int", 1, 3600_000,
+         doc="How long a queued registerShuffle parks for a slot before "
+             "rejection — and the retry-after hint an AdmissionRejected "
+             "carries either way."),
+    _Key("shuffle_ttl_ms", 0, "int", 0, 86_400_000,
+         doc="Shuffle idle time-to-live: the driver's GC sweep "
+             "unregisters shuffles UNTOUCHED (no publish, no driver "
+             "table sync) for longer than this (terminal EPOCH_DEAD "
+             "push; executors reap committed outputs, merged segments "
+             "and overflow blobs from disk on receipt), so abandoned "
+             "jobs can't leak spill-dir bytes forever. Warm iterative "
+             "jobs issue zero driver RPCs by design — size the TTL "
+             "above their run or leave it 0 = no TTL (explicit "
+             "unregister only)."),
+    _Key("tenant_pool_quota", 0, "bytes", 0, 1 << 44,
+         doc="Per-tenant byte quota on BufferPool leases (the "
+             "leased_bytes gauge, charged at bin size): a tenant's "
+             "writers/readers/pushers leasing past it get a "
+             "TenantQuotaError instead of dragging every co-hosted "
+             "tenant into the pool's high-water trim. 0 = unbounded "
+             "(single-tenant behavior)."),
+    _Key("tenant_spill_quota", 0, "bytes", 0, 1 << 44,
+         doc="Per-tenant byte quota on local shuffle disk: committed "
+             "map outputs plus merged segments charge the owning "
+             "tenant; a commit past the quota fails cleanly (tmp "
+             "reaped, TenantQuotaError) and a merge push past it is "
+             "rejected like a full segment (its maps stay per-map-"
+             "fetched). 0 = unbounded."),
+    _Key("tenant_cache_quota", 0, "bytes", 0, 1 << 44,
+         doc="Per-tenant byte cap inside dist_cache_budget. 0 = an even "
+             "share of the budget across tenants holding cached "
+             "shuffles. Either way evictions are charged to the "
+             "INSERTING tenant only — a cold bulk job can evict its own "
+             "LRU shuffles, never another tenant's warm iterative "
+             "ranges (cross-tenant eviction is regression-tested to "
+             "zero)."),
+    _Key("tenant_hbm_quota", 0, "bytes", 0, 1 << 40,
+         doc="Per-tenant device-HBM budget for fused exchange round "
+             "sizing. 0 = device_hbm_budget split evenly across tenants "
+             "with registered shuffles (dynamic sizing, NP-RDMA-style, "
+             "instead of static partitioning); nonzero pins each "
+             "tenant's slice. Single-tenant stages see the full "
+             "budget either way."),
     # --- two-level topology (TPU-only: parallel/topology.py,
     # docs/CONFIG.md "Topology")
     _Key("slice_topology", "", "str",

@@ -1161,6 +1161,19 @@ class DAGEngine:
             override = conf.device_plane
         budget = self.device_hbm_budget or (
             conf.device_hbm_budget if conf is not None else 64 << 20)
+        # tenancy: device HBM is the scarcest shared resource — when
+        # several tenants hold registered shuffles, each stage plans its
+        # rounds against the tenant's slice (tenant_hbm_quota, or an
+        # even share) so concurrent tenants' rounds can't sum past the
+        # device. Single-tenant: n_tenants == 1 and the full budget
+        # passes through untouched.
+        if conf is not None and not self.device_hbm_budget:
+            from sparkrdma_tpu.shuffle import tenancy
+            drv = getattr(self.driver.native, "driver", None)
+            n_tenants = (drv.active_tenant_count()
+                         if drv is not None else 1)
+            budget = min(budget,
+                         tenancy.effective_hbm_budget(conf, n_tenants))
         topo = None
         if self.mesh is not None and (conf is None
                                       or conf.hierarchical_exchange):

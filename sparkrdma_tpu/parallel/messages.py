@@ -938,6 +938,33 @@ class FetchMergedResp(RpcMsg):
         return cls(req_id, status, epoch, payload[_QI.size + _Q.size:])
 
 
+@register()
+class TenantMapMsg(RpcMsg):
+    """Driver -> executors push at registerShuffle time: shuffle
+    ``shuffle_id`` belongs to tenant ``tenant`` (and expires
+    ``ttl_ms`` after registration; 0 = no TTL). Executors key their
+    serve-path fair-share queues, cache charging, and quota ledgers by
+    it. One-sided like every push on the announce channel: a lost push
+    (or a late-joining executor) degrades that executor's view of the
+    shuffle to DEFAULT_TENANT — a fairness approximation, never a
+    correctness problem, and the local writer/reader path re-teaches
+    the mapping from the handle on first use."""
+
+    def __init__(self, shuffle_id: int, tenant: int, ttl_ms: int):
+        self.shuffle_id = shuffle_id
+        self.tenant = tenant
+        self.ttl_ms = ttl_ms
+
+    def payload(self) -> bytes:
+        return struct.pack("<iiq", self.shuffle_id, self.tenant,
+                           self.ttl_ms)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "TenantMapMsg":
+        shuffle_id, tenant, ttl_ms = struct.unpack_from("<iiq", payload, 0)
+        return cls(shuffle_id, tenant, ttl_ms)
+
+
 # Status codes shared by responses.
 STATUS_OK = 0
 STATUS_UNKNOWN_SHUFFLE = 1

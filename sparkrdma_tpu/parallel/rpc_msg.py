@@ -68,6 +68,7 @@ WIRE_IDS: Dict[str, int] = {
     "MergedPublishMsg": 32,
     "FetchMergedReq": 33,
     "FetchMergedResp": 34,
+    "TenantMapMsg": 35,
 }
 
 # Ids deliberately absent from the dense 1..max range, with the reason

@@ -138,14 +138,41 @@ class BlockServer:
                 return 0
             return int(native.LIB.bs_port(self._h))
 
+    def set_fair(self, enabled: bool, quantum_bytes: int = 0) -> None:
+        """Deficit-round-robin fair-share serving (``fair_share_serving``
+        / ``fair_share_quantum_bytes``): requests queue per owning
+        tenant of the requested token and dispatch by byte-cost DRR. A
+        pre-tenancy .so degrades to FIFO serving (warned once)."""
+        with self._lock:
+            if self._stopped:
+                return
+            if not native.has_fair_serving():
+                if enabled:
+                    log.warning("libtpushuffle.so predates fair-share "
+                                "serving; native responses stay FIFO "
+                                "(rebuild with make -C csrc)")
+                return
+            native.LIB.bs_set_fair(self._h, int(enabled),
+                                   int(quantum_bytes))
+
+    def fair_queued(self) -> int:
+        """Requests ever deferred through the fair-share DRR queues
+        (0 with fair serving off or a pre-tenancy .so)."""
+        with self._lock:
+            if self._stopped or not native.has_fair_serving():
+                return 0
+            return int(native.LIB.bs_fair_queued(self._h))
+
     def register_file(self, token: int, path: str,
                       crc_ranges: Optional[Sequence[Tuple[int, int, int]]]
-                      = None) -> None:
+                      = None, tenant: int = 0) -> None:
         """Register ``path`` for serving under ``token`` (validated now,
-        mapped at first serve). ``crc_ranges`` — optional attested
-        ``(offset, length, crc32)`` ranges from the at-rest sidecar or
-        the merge ledger — lets CRC-trailer serves over aligned blocks
-        reuse the committed CRCs instead of recomputing."""
+        mapped at first serve) owned by ``tenant`` (keys fair-share
+        queueing and budget-eviction shares). ``crc_ranges`` — optional
+        attested ``(offset, length, crc32)`` ranges from the at-rest
+        sidecar or the merge ledger — lets CRC-trailer serves over
+        aligned blocks reuse the committed CRCs instead of
+        recomputing."""
         # chaos hook: an mmap-open failure here surfaces as an OSError at
         # commit/recover time (the write-failure path owns it) instead of
         # a silently unservable token
@@ -154,7 +181,13 @@ class BlockServer:
         with self._lock:
             if self._stopped:
                 return
-            rc = native.LIB.bs_register_file(self._h, token, path.encode())
+            if tenant and native.has_fair_serving():
+                rc = native.LIB.bs_register_file2(self._h, token,
+                                                  path.encode(),
+                                                  int(tenant))
+            else:
+                rc = native.LIB.bs_register_file(self._h, token,
+                                                 path.encode())
             if rc != 0:
                 raise OSError(f"block server could not map {path}")
             if crc_ranges and native.has_serve_path():
@@ -249,11 +282,14 @@ def maybe_create(conf, host: str = "", tracer=None) -> Optional[BlockServer]:
                 log.warning("block_server_cpus: ignoring unparseable token "
                             "%r (expected a comma-separated core list)", part)
         try:
-            return BlockServer(host=host, threads=conf.block_server_threads,
-                               cpus=cpus, checksum=conf.fetch_checksum,
-                               region_budget=conf.registered_region_budget,
-                               zero_copy=conf.serve_zero_copy,
-                               tracer=tracer)
+            srv = BlockServer(host=host, threads=conf.block_server_threads,
+                              cpus=cpus, checksum=conf.fetch_checksum,
+                              region_budget=conf.registered_region_budget,
+                              zero_copy=conf.serve_zero_copy,
+                              tracer=tracer)
+            srv.set_fair(conf.fair_share_serving,
+                         conf.fair_share_quantum_bytes)
+            return srv
         except (OSError, socket.gaierror) as e:
             log.warning("native block server unavailable, serving via the "
                         "control path instead: %s", e)

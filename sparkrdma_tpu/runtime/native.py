@@ -103,6 +103,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             getattr(lib, fn).restype = u64
     lib.bs_register_file.argtypes = [vp, ctypes.c_uint32, cp]
     lib.bs_register_file.restype = ctypes.c_int
+    # optional symbols: tenant-tagged registration + fair-share serving
+    # (multi-tenant DRR request queue). A pre-tenancy .so degrades to
+    # FIFO serving under tenant 0.
+    if hasattr(lib, "bs_set_fair"):
+        lib.bs_register_file2.argtypes = [vp, ctypes.c_uint32, cp,
+                                          ctypes.c_uint32]
+        lib.bs_register_file2.restype = ctypes.c_int
+        lib.bs_set_fair.argtypes = [vp, ctypes.c_int, u64]
+        lib.bs_set_fair.restype = None
+        lib.bs_fair_queued.argtypes = [vp]
+        lib.bs_fair_queued.restype = u64
     lib.bs_unregister_file.argtypes = [vp, ctypes.c_uint32]
     lib.bs_unregister_file.restype = ctypes.c_int
     lib.bs_bytes_served.argtypes = [vp]
@@ -132,3 +143,10 @@ def has_serve_path() -> bool:
     copy responses, registered-region pool, CRC reuse) — older builds
     degrade to eager-mmap copy serving."""
     return LIB is not None and hasattr(LIB, "bs_set_zero_copy")
+
+
+def has_fair_serving() -> bool:
+    """True when the loaded .so exports tenant-tagged registration and
+    the DRR fair-share request queue — older builds serve FIFO under
+    tenant 0."""
+    return LIB is not None and hasattr(LIB, "bs_set_fair")

@@ -40,14 +40,18 @@ def _round_up_pow2(size: int, min_block: int) -> int:
 
 
 class PoolBuffer:
-    """One leased pool buffer. ``view`` is a writable numpy uint8 view."""
+    """One leased pool buffer. ``view`` is a writable numpy uint8 view.
+    ``tenant`` is who the lease is charged to (tenancy.DEFAULT_TENANT
+    for every pre-tenancy caller)."""
 
-    __slots__ = ("token", "size", "view", "_pool", "_freed")
+    __slots__ = ("token", "size", "view", "tenant", "_pool", "_freed")
 
-    def __init__(self, token: int, size: int, view: np.ndarray, pool: "BufferPool"):
+    def __init__(self, token: int, size: int, view: np.ndarray,
+                 pool: "BufferPool", tenant: int = 0):
         self.token = token
         self.size = size
         self.view = view
+        self.tenant = tenant
         self._pool = pool
         self._freed = False
 
@@ -70,8 +74,8 @@ class RegisteredBuffer:
     registered region; the region returns to the pool on last release.
     """
 
-    def __init__(self, pool: "BufferPool", size: int):
-        self._buf = pool.get(size)
+    def __init__(self, pool: "BufferPool", size: int, tenant: int = 0):
+        self._buf = pool.get(size, tenant=tenant)
         self._offset = 0
         self._refs = 1  # creator's reference
         self._lock = threading.Lock()
@@ -211,6 +215,10 @@ class BufferPool:
         # property read instead of a guess.
         self._leased_bytes = 0
         self._peak_leased_bytes = 0
+        # per-tenant lease ledger (shuffle/tenancy.py): quota 0 =
+        # unbounded, so single-tenant deployments pay one dict update
+        from sparkrdma_tpu.shuffle.tenancy import TenantLedger
+        self._tenant_leases = TenantLedger("pool", conf.tenant_pool_quota)
         if self._use_native:
             self._h = native.LIB.arena_create(
                 conf.max_buffer_allocation_size, self.min_block, int(zero_on_get))
@@ -223,7 +231,22 @@ class BufferPool:
     def is_native(self) -> bool:
         return self._use_native
 
-    def get(self, size: int) -> PoolBuffer:
+    def get(self, size: int, tenant: int = 0) -> PoolBuffer:
+        # Quota check BEFORE the arena allocation: a tenant over its
+        # lease quota raises TenantQuotaError without consuming arena
+        # memory (bin-size accounting, same as the leased gauge) — the
+        # caller sheds that tenant's work instead of OOMing the pool
+        # every co-hosted tenant shares. The charge is conservative
+        # (requested size rounded to the bin) and re-trued below.
+        bin_est = _round_up_pow2(max(size, 1), self.min_block)
+        self._tenant_leases.charge(tenant, bin_est)
+        try:
+            return self._get_charged(size, tenant, bin_est)
+        except BaseException:
+            self._tenant_leases.release(tenant, bin_est)
+            raise
+
+    def _get_charged(self, size: int, tenant: int, bin_est: int) -> PoolBuffer:
         # self._lock guards handle lifetime against concurrent stop(); the
         # arena's own mutex guards its internal state.
         with self._lock:
@@ -244,10 +267,13 @@ class BufferPool:
             self._leased_bytes += int(bin_size)
             self._peak_leased_bytes = max(self._peak_leased_bytes,
                                           self._leased_bytes)
-        return PoolBuffer(int(token), int(bin_size), view, self)
+        if int(bin_size) != bin_est:  # defensive: arenas bin identically
+            self._tenant_leases.release(tenant, bin_est)
+            self._tenant_leases.charge(tenant, int(bin_size))
+        return PoolBuffer(int(token), int(bin_size), view, self, tenant)
 
-    def get_registered(self, size: int) -> RegisteredBuffer:
-        return RegisteredBuffer(self, size)
+    def get_registered(self, size: int, tenant: int = 0) -> RegisteredBuffer:
+        return RegisteredBuffer(self, size, tenant=tenant)
 
     def _release(self, buf: PoolBuffer) -> None:
         with self._lock:
@@ -260,6 +286,11 @@ class BufferPool:
             else:
                 self._py.put(buf.token)
             self._leased_bytes -= buf.size
+        self._tenant_leases.release(buf.tenant, buf.size)
+
+    def tenant_leased_bytes(self, tenant: int) -> int:
+        """Bytes currently checked out by one tenant (bin sizes)."""
+        return self._tenant_leases.usage(tenant)
 
     def preallocate(self, size: int, count: int) -> None:
         with self._lock:
@@ -320,6 +351,9 @@ class BufferPool:
         if out:
             out["leased_bytes"] = self._leased_bytes
             out["peak_leased_bytes"] = self._peak_leased_bytes
+            tenants = self._tenant_leases.snapshot()
+            if tenants:
+                out["tenant_leased_bytes"] = tenants
         return out
 
     def _backend_stats_locked(self) -> dict:

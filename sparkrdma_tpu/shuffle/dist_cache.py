@@ -55,15 +55,58 @@ plan_invalidations = 0  # warm-range drops caused by plan-epoch changes
 _bytes: Dict[Tuple[str, int], int] = {}
 _budget = 256 << 20
 evicted = 0  # budget evictions (NOT invalidations/drops), monotone
+# tenancy (shuffle/tenancy.py): shuffle -> owning tenant. Evictions are
+# charged to the INSERTING tenant — a cold bulk job filling the cache
+# can evict its own LRU shuffles but never another tenant's warm
+# iterative ranges. Each tenant is bounded by _tenant_quota (conf
+# tenant_cache_quota), or an even share of the budget across tenants
+# currently holding bytes; with one tenant (every pre-tenancy caller:
+# everything maps to DEFAULT_TENANT) the share IS the budget, so
+# single-job behavior is unchanged bit-for-bit.
+_tenants: Dict[int, int] = {}
+_tenant_quota = 0
+cross_tenant_evictions = 0  # must stay 0: regression-tested invariant
 
 
-def configure(budget_bytes: int) -> None:
+def configure(budget_bytes: int, tenant_quota: int = 0) -> None:
     """Set the byte budget (conf ``dist_cache_budget``; 0 disables both
-    stores). Shrinking evicts immediately."""
-    global _budget
+    stores) and the per-tenant cap (conf ``tenant_cache_quota``; 0 =
+    even share). Shrinking evicts immediately (admin action: global
+    LRU, not charged to any tenant)."""
+    global _budget, _tenant_quota
     with _lock:
         _budget = max(0, int(budget_bytes))
+        _tenant_quota = max(0, int(tenant_quota))
         _evict_to_budget_locked()
+
+
+def set_tenant(shuffle_id: int, tenant: int) -> None:
+    """Record the shuffle's owning tenant (manager/endpoint teach this
+    at registration and on the TenantMapMsg push)."""
+    with _lock:
+        _tenants[shuffle_id] = int(tenant)
+
+
+def _tenant_of_locked(shuffle_id: int) -> int:
+    return _tenants.get(shuffle_id, 0)
+
+
+def _active_tenants_locked(including: int) -> int:
+    """Distinct tenants holding cached bytes (plus the inserter)."""
+    active = {_tenant_of_locked(sid) for _, sid in _bytes}
+    active.add(including)
+    return len(active)
+
+
+def _tenant_bytes_locked(tenant: int) -> int:
+    return sum(n for (_, sid), n in _bytes.items()
+               if _tenant_of_locked(sid) == tenant)
+
+
+def _tenant_cap_locked(tenant: int) -> int:
+    if _tenant_quota:
+        return min(_budget, _tenant_quota)
+    return _budget // max(1, _active_tenants_locked(tenant))
 
 
 def _nbytes(*arrays: np.ndarray) -> int:
@@ -75,30 +118,54 @@ def _total_locked() -> int:
 
 
 def _evict_to_budget_locked(need: int = 0) -> None:
-    """Drop least-recently-used shuffles (across both stores, oldest
-    touch first) until ``need`` more bytes fit the budget."""
-    global evicted
-    while _bytes and _total_locked() + need > _budget:
-        # the least-recently-touched shuffle across both stores
+    """Admin-path eviction (configure shrink): global LRU, any owner."""
+    _evict_for_locked(need, None)
+
+
+def _evict_for_locked(need: int, tenant: Optional[int]) -> bool:
+    """Make room for ``need`` more bytes charged to ``tenant``: drop
+    least-recently-used shuffles (across both stores, oldest touch
+    first) until the need fits BOTH the global budget and the tenant's
+    cap. Victims are restricted to the charging tenant (``None`` = any
+    owner, the admin/configure path) — eviction is charged to the
+    inserter, so one tenant's cold bulk insert can never wipe another
+    tenant's warm ranges. Returns False when the need cannot fit (the
+    caller rejects the insert; correctness-wise a rejected cache insert
+    just costs a re-fetch)."""
+    global evicted, cross_tenant_evictions
+
+    def over() -> bool:
+        if _total_locked() + need > _budget:
+            return True
+        return (tenant is not None
+                and _tenant_bytes_locked(tenant) + need
+                > _tenant_cap_locked(tenant))
+
+    while over():
+        # the least-recently-touched ELIGIBLE shuffle per store
         candidates: List[Tuple[str, int]] = []
-        if _cache:
-            candidates.append(("mesh", next(iter(_cache))))
-        if _ranges:
-            candidates.append(("warm", next(iter(_ranges))))
+        for kind, stores in (("mesh", _cache), ("warm", _ranges)):
+            for sid in stores:
+                if tenant is None or _tenant_of_locked(sid) == tenant:
+                    candidates.append((kind, sid))
+                    break
         if not candidates:
-            break
+            return not over()
         # OrderedDict iteration order IS recency order (oldest first);
         # with one candidate per store, evict the one carrying bytes —
         # prefer the warm store (re-fetchable for the price of RPCs)
         # over mesh results (re-entering a collective costs the group)
         kind, sid = max(candidates,
                         key=lambda c: (c[0] == "warm", _bytes.get(c, 0)))
+        if tenant is not None and _tenant_of_locked(sid) != tenant:
+            cross_tenant_evictions += 1  # defense: must be unreachable
         if kind == "mesh":
             _cache.pop(sid, None)
         else:
             _ranges.pop(sid, None)
         _bytes.pop((kind, sid), None)
         evicted += 1
+    return True
 
 
 # -- mesh-reduce results (distributed mesh mode) -------------------------
@@ -128,13 +195,21 @@ def store(shuffle_id: int, device_results: List[tuple]) -> List[int]:
             by_part[int(parts[s])] = (k, p)
             total += _nbytes(k, p)
     with _lock:
-        if total > _budget:
+        tenant = _tenant_of_locked(shuffle_id)
+        if total > min(_budget, _tenant_cap_locked(tenant)):
             # a single oversized shuffle can never fit: don't thrash the
             # whole cache out for it (callers fall back to the fetcher)
             _cache.pop(shuffle_id, None)
             _bytes.pop(("mesh", shuffle_id), None)
             return sorted(by_part)
-        _evict_to_budget_locked(total - _bytes.get(("mesh", shuffle_id), 0))
+        if not _evict_for_locked(
+                total - _bytes.get(("mesh", shuffle_id), 0), tenant):
+            # other tenants hold the budget and this tenant has nothing
+            # left to evict: reject the insert (callers re-fetch) rather
+            # than wipe a sibling tenant's cache
+            _cache.pop(shuffle_id, None)
+            _bytes.pop(("mesh", shuffle_id), None)
+            return sorted(by_part)
         _cache[shuffle_id] = by_part
         _cache.move_to_end(shuffle_id)
         _bytes[("mesh", shuffle_id)] = total
@@ -177,17 +252,25 @@ def put_range(shuffle_id: int, epoch: int, start: int, end: int,
     total = _nbytes(keys, payload)
     key = _range_key(start, end, map_range)
     with _lock:
-        if total > _budget:
+        tenant = _tenant_of_locked(shuffle_id)
+        if total > min(_budget, _tenant_cap_locked(tenant)):
             return False
         # detach this shuffle's store first so eviction can't race the
         # update (re-admitted whole below, newest-touched)
         ranges = _ranges.pop(shuffle_id, {})
-        prev = _bytes.pop(("warm", shuffle_id), 0)
+        orig_prev = _bytes.pop(("warm", shuffle_id), 0)
+        prev = orig_prev
         old = ranges.get(key)
         if old is not None:
             prev -= _nbytes(old[1], old[2])
         need = max(0, prev) + total
-        _evict_to_budget_locked(need)
+        if not _evict_for_locked(need, tenant):
+            # can't fit without evicting another tenant: restore the
+            # detached entries untouched and decline the insert
+            if ranges:
+                _ranges[shuffle_id] = ranges
+                _bytes[("warm", shuffle_id)] = orig_prev
+            return False
         ranges[key] = (epoch, keys, payload)
         _ranges[shuffle_id] = ranges
         _bytes[("warm", shuffle_id)] = need
@@ -249,6 +332,10 @@ def on_epoch(shuffle_id: int, epoch: int) -> None:
     with _lock:
         if epoch < 0:
             _drop_locked(shuffle_id)
+            # terminal: the shuffle id will never cache again under
+            # this registration; forget its tenant (re-register
+            # re-teaches the mapping)
+            _tenants.pop(shuffle_id, None)
             return
         ranges = _ranges.get(shuffle_id)
         if not ranges:
@@ -293,4 +380,9 @@ def stats() -> dict:
             "warm_shuffles": len(_ranges),
             "evicted": evicted,
             "plan_invalidations": plan_invalidations,
+            "cross_tenant_evictions": cross_tenant_evictions,
+            "tenant_bytes": {
+                t: _tenant_bytes_locked(t)
+                for t in {_tenant_of_locked(sid) for _, sid in _bytes}
+            },
         }

@@ -261,6 +261,12 @@ class ShuffleFetcher:
         # lease — many logical blocks, one pool buffer, returned on last
         # consumer release (java/RdmaRegisteredBuffer.java:28-87)
         self.pool = pool
+        # tenancy: staging leases charge the shuffle's owning tenant
+        self.tenant = (resolver.tenant_of(shuffle_id)
+                       if resolver is not None
+                       and hasattr(resolver, "tenant_of")
+                       else endpoint.tenant_of(shuffle_id)
+                       if hasattr(endpoint, "tenant_of") else 0)
         self.reader_stats = reader_stats  # ShuffleReaderStats | None
         self.tracer = tracer or trace_mod.NULL
         self.shuffle_id = shuffle_id
@@ -1130,7 +1136,8 @@ class ShuffleFetcher:
         returns to the pool on the last consumer's ``free``)."""
         lease = None
         if self.pool is not None and vf.total_bytes:
-            lease = self.pool.get_registered(vf.total_bytes)
+            lease = self.pool.get_registered(vf.total_bytes,
+                                             tenant=self.tenant)
         pos = 0
         for seg in vf.segments:
             n = seg.total_bytes

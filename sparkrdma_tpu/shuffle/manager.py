@@ -88,6 +88,11 @@ class ShuffleHandle:
     row_payload_bytes: int
     partitioner: PartitionerSpec
     combiner: Optional[Callable] = None
+    # tenancy: the tenant id minted at registerShuffle rides the handle
+    # through task serialization, so every writer/reader/pool lease on
+    # every executor charges the right owner even if the one-sided
+    # TenantMapMsg push was lost (shuffle/tenancy.py)
+    tenant: int = 0
 
 
 class TpuShuffleManager:
@@ -111,7 +116,8 @@ class TpuShuffleManager:
         # iterative ranges, shuffle/dist_cache.py) — process-global, so
         # co-hosted managers share one bound like they share the process
         from sparkrdma_tpu.shuffle import dist_cache
-        dist_cache.configure(self.conf.dist_cache_budget)
+        dist_cache.configure(self.conf.dist_cache_budget,
+                             tenant_quota=self.conf.tenant_cache_quota)
         self.reader_stats = (ShuffleReaderStats(self.conf)
                              if self.conf.collect_shuffle_reader_stats else None)
         self.tracer = trace_mod.get(self.conf)
@@ -163,13 +169,23 @@ class TpuShuffleManager:
                          num_partitions: int,
                          partitioner: PartitionerSpec,
                          row_payload_bytes: int = 0,
-                         combiner=None) -> ShuffleHandle:
-        """Driver-side (scala/RdmaShuffleManager.scala:143-183)."""
+                         combiner=None, tenant: int = 0) -> ShuffleHandle:
+        """Driver-side (scala/RdmaShuffleManager.scala:143-183).
+
+        ``tenant`` is the owning tenant id minted here and threaded
+        through every layer (quotas, fair-share serving, admission).
+        With ``admission_max_inflight`` configured, a tenant at its
+        in-flight cap parks in the admission queue and — past the queue
+        depth or the park deadline — gets
+        :class:`~sparkrdma_tpu.shuffle.tenancy.AdmissionRejected` with
+        a retry-after hint instead of a registration."""
         if self.driver is None:
             raise RuntimeError("register_shuffle is a driver-role call")
-        self.driver.register_shuffle(shuffle_id, num_maps, num_partitions)
+        self.driver.register_shuffle(shuffle_id, num_maps, num_partitions,
+                                     tenant=tenant)
         handle = ShuffleHandle(shuffle_id, num_maps, num_partitions,
-                               row_payload_bytes, partitioner, combiner)
+                               row_payload_bytes, partitioner, combiner,
+                               tenant=tenant)
         with self._lock:
             self._handles[shuffle_id] = handle
         return handle
@@ -183,6 +199,7 @@ class TpuShuffleManager:
         ``(keys_sorted, payload_sorted) -> (keys', payload')``)."""
         if self.executor is None or self.resolver is None:
             raise RuntimeError("get_writer is an executor-role call")
+        self._teach_tenant(handle)
         overflow = (self.merge_client.overflow_spill
                     if self.merge_client is not None else None)
         inner = TpuShuffleWriter(
@@ -202,6 +219,7 @@ class TpuShuffleManager:
         the partition range from just those maps; None reads all."""
         if self.executor is None:
             raise RuntimeError("get_reader is an executor-role call")
+        self._teach_tenant(handle)
         return TpuShuffleReader(self.executor, self.resolver, self.conf,
                                 handle.shuffle_id, handle.num_maps,
                                 start_partition, end_partition,
@@ -209,6 +227,33 @@ class TpuShuffleManager:
                                 reader_stats=self.reader_stats,
                                 tracer=self.tracer, pool=self.pool,
                                 map_range=map_range)
+
+    def _teach_tenant(self, handle: ShuffleHandle) -> None:
+        """Teach local components the handle's tenant (the backstop for
+        a lost TenantMapMsg push — handles travel with tasks, so the
+        local path always knows the owner)."""
+        tenant = getattr(handle, "tenant", 0)
+        if self.resolver is not None:
+            self.resolver.note_tenant(handle.shuffle_id, tenant)
+        if self.executor is not None:
+            self.executor.note_tenant(handle.shuffle_id, tenant)
+        from sparkrdma_tpu.shuffle import dist_cache
+        dist_cache.set_tenant(handle.shuffle_id, tenant)
+
+    def gc_orphans(self, live_shuffle_ids, min_age_s: float = 60.0) -> int:
+        """Executor-role GC sweep: reap committed outputs, merged
+        segments and overflow blobs of shuffles absent from the
+        driver's live set (``live_shuffle_ids``) and unknown locally —
+        debris of dead processes that no unregister push will ever
+        name. ``min_age_s`` skips files fresh enough to be a commit or
+        push racing the live-set snapshot. Returns files reaped."""
+        if self.resolver is None:
+            raise RuntimeError("gc_orphans is an executor-role call")
+        n = self.resolver.reap_orphans(live_shuffle_ids, min_age_s)
+        if self.executor is not None and self.executor.merge_store is not None:
+            n += self.executor.merge_store.reap_orphans(live_shuffle_ids,
+                                                        min_age_s)
+        return n
 
     def plan_reduce(self, handle: ShuffleHandle):
         """Driver-role: build + publish the shuffle's adaptive

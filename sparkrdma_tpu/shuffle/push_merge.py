@@ -295,7 +295,7 @@ class _ShuffleSegments:
     """One shuffle's state on a merge target."""
 
     __slots__ = ("ledgers", "num_maps", "finalized", "last_push",
-                 "overflow_tokens", "writing")
+                 "overflow_tokens", "writing", "charged")
 
     def __init__(self):
         self.ledgers: Dict[int, _Ledger] = {}  # partition -> ledger
@@ -304,6 +304,12 @@ class _ShuffleSegments:
         self.last_push = time.monotonic()
         self.overflow_tokens: List[int] = []
         self.writing = 0  # reserved-but-unwritten segment appends
+        # disk-ledger charges BY TENANT: early pushes can land before
+        # the TenantMapMsg teaches this target's resolver (charged to
+        # DEFAULT_TENANT), later ones after — the release at drop must
+        # repay each ledger exactly what was charged to it, or one
+        # tenant retains phantom bytes while another's quota erases
+        self.charged: Dict[int, int] = {}
 
 
 class MergeStore:
@@ -381,6 +387,17 @@ class MergeStore:
                 if ledger.size + size > self.max_segment:
                     self.pushes_rejected += 1
                     continue  # segment full: this map stays per-map here
+                # tenancy: merged-segment disk charges the OWNING tenant
+                # (resolver.disk_ledger); past its spill quota the push
+                # is shed exactly like a full segment — the map stays
+                # per-map-fetched, nothing breaks
+                tenant = self.resolver.tenant_of(shuffle_id)
+                try:
+                    self.resolver.disk_ledger.charge(tenant, size)
+                except Exception:
+                    self.pushes_rejected += 1
+                    continue
+                state.charged[tenant] = state.charged.get(tenant, 0) + size
                 if ledger.fd is None:
                     try:
                         ledger.fd = os.open(
@@ -389,15 +406,18 @@ class MergeStore:
                         log.warning("merge segment open %s failed: %s",
                                     ledger.path, e)
                         self.pushes_rejected += 1
+                        # un-charge: no bytes will land for this push
+                        state.charged[tenant] -= size
+                        self.resolver.disk_ledger.release(tenant, size)
                         continue
                 row = (map_id, fence, ledger.size, size,
                        zlib.crc32(segs[i]))
                 ledger.rows.append(row)
                 ledger.size += size
-                writes.append((ledger, row[2], segs[i], i, row))
+                writes.append((ledger, row[2], segs[i], i, row, tenant))
             state.writing += len(writes)
         ok = 0
-        for ledger, off, seg, i, row in writes:
+        for ledger, off, seg, i, row, row_tenant in writes:
             try:
                 os.pwrite(ledger.fd, seg, off)
                 accepted[i] = 1
@@ -414,6 +434,9 @@ class MergeStore:
                     except ValueError:
                         pass
                     self.pushes_rejected += 1
+                    state.charged[row_tenant] = \
+                        state.charged.get(row_tenant, 0) - row[3]
+                self.resolver.disk_ledger.release(row_tenant, row[3])
         with self._lock:
             self.pushes_accepted += ok
             state.writing -= len(writes)
@@ -427,6 +450,12 @@ class MergeStore:
         with self._lock:
             seq = self._ovf_seq
             self._ovf_seq += 1
+        # tenancy: overflow blobs are disk the owning tenant parks here
+        tenant = self.resolver.tenant_of(shuffle_id)
+        try:
+            self.resolver.disk_ledger.charge(tenant, len(data))
+        except Exception:
+            return M.STATUS_ERROR, 0
         path = os.path.join(
             self.dir, f"ovf_{shuffle_id}_{map_id}_{fence}.{seq}.bin")
         try:
@@ -436,6 +465,7 @@ class MergeStore:
                                                     len(data))
         except OSError as e:
             log.warning("overflow blob store failed: %s", e)
+            self.resolver.disk_ledger.release(tenant, len(data))
             return M.STATUS_ERROR, 0
         with self._lock:
             state = self._shuffles.get(shuffle_id)
@@ -443,6 +473,8 @@ class MergeStore:
                 state = _ShuffleSegments()
                 self._shuffles[shuffle_id] = state
             state.overflow_tokens.append(token)
+            state.charged[tenant] = state.charged.get(tenant, 0) \
+                + len(data)
         return M.STATUS_OK, token
 
     # -- finalize --------------------------------------------------------
@@ -547,6 +579,9 @@ class MergeStore:
             state = self._shuffles.pop(shuffle_id, None)
         if state is None:
             return
+        for tenant, nbytes in state.charged.items():
+            if nbytes > 0:
+                self.resolver.disk_ledger.release(tenant, nbytes)
         for ledger in state.ledgers.values():
             ledger.close_fd()
             try:
@@ -556,6 +591,41 @@ class MergeStore:
         # finalized segments + overflow blobs were registered with the
         # resolver; external release unregisters serving and deletes
         self.resolver.release_externals(shuffle_id)
+
+    def reap_orphans(self, live_shuffle_ids, min_age_s: float = 60.0
+                     ) -> int:
+        """GC sweep of ``<spill>/merge/``: delete segment files and
+        overflow blobs whose shuffle is neither registered at the driver
+        (``live_shuffle_ids``) nor known to this store — leftovers of a
+        crashed process no unregister push will ever name. ``min_age_s``
+        guards the snapshot race (a push landing for a shuffle
+        registered after the live set was taken); only files older than
+        it are eligible. Returns the number of files reaped."""
+        import re
+        live = set(int(s) for s in live_shuffle_ids)
+        with self._lock:
+            local = set(self._shuffles)
+        pat = re.compile(r"^(?:seg|ovf)_(\d+)_")
+        cutoff = time.time() - min_age_s
+        reaped = 0
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for name in names:
+            m = pat.match(name)
+            if m is None or int(m.group(1)) in live \
+                    or int(m.group(1)) in local:
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                if os.stat(path).st_mtime > cutoff:
+                    continue  # too fresh: may be a racing push
+                os.unlink(path)
+                reaped += 1
+            except OSError:
+                pass
+        return reaped
 
     def stop(self) -> None:
         with self._lock:
@@ -678,16 +748,20 @@ class SegmentPusher:
         return merge_targets(task.num_partitions, live, my,
                              int(self.conf.merge_replicas))
 
-    def _stage(self, nbytes: int):
+    def _stage(self, nbytes: int, tenant: int = 0):
         """A staging lease for one partition-range: pool-leased when the
         pool admits it within a short bounded wait (foreground writers
         win contention), else a plain buffer — the pusher degrades,
-        never blocks the write path."""
+        never blocks the write path. A tenant over its lease quota
+        degrades the same way (the push still happens, unleased)."""
         if self.pool is None or nbytes == 0:
             return None
+        from sparkrdma_tpu.shuffle.tenancy import TenantQuotaError
         for _ in range(3):
             try:
-                return self.pool.get(nbytes)
+                return self.pool.get(nbytes, tenant=tenant)
+            except TenantQuotaError:
+                return None
             except MemoryError:
                 time.sleep(0.005)
         return None
@@ -725,7 +799,9 @@ class SegmentPusher:
                 # push's in-flight bytes against the pool gauge (so the
                 # pusher waits when foreground writers hold the pool)
                 # without copying — `data` itself rides the wire
-                lease = self._stage(len(data))
+                lease = self._stage(len(data),
+                                    tenant=self.resolver.tenant_of(
+                                        task.shuffle_id))
                 try:
                     ok = self._send(slot, task, lo, sizes, data)
                 finally:

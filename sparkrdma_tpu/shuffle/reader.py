@@ -180,7 +180,7 @@ class TpuShuffleReader:
                 payload = jax.device_put(
                     np.zeros((0, self.row_payload_bytes), dtype=np.uint8), device)
                 return keys, payload
-            with pool.get(total) as buf:
+            with pool.get(total, tenant=self.fetcher.tenant) as buf:
                 pos = 0
                 for r in chunks:
                     n = len(r.data)

@@ -56,6 +56,7 @@ import itertools
 import logging
 import os
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -170,9 +171,38 @@ class TpuShuffleBlockResolver:
         # failure-path audit counters
         self.fenced_commits = 0
         self.corrupt_outputs = 0
+        # tenancy (shuffle/tenancy.py): shuffle -> owning tenant, taught
+        # by the manager at writer/reader creation and by the driver's
+        # TenantMapMsg push; the disk ledger charges committed outputs,
+        # merged segments and overflow blobs to their owner so one
+        # tenant filling its spill quota fails ITS commit cleanly
+        # instead of ENOSPCing every co-hosted tenant's spill dir.
+        from sparkrdma_tpu.shuffle.tenancy import TenantLedger
+        self._tenant_map: Dict[int, int] = {}
+        self.disk_ledger = TenantLedger("spill", self.conf.tenant_spill_quota)
+        self._token_disk: Dict[int, Tuple[int, int]] = {}  # token -> (tenant, bytes)
         # native epoll server (runtime/blockserver.py): committed files are
         # registered there so peers fetch bytes without Python in the path
         self.block_server = block_server
+
+    # -- tenancy ---------------------------------------------------------
+
+    def note_tenant(self, shuffle_id: int, tenant: int) -> None:
+        """Record the shuffle's owning tenant (idempotent)."""
+        with self._lock:
+            self._tenant_map[shuffle_id] = int(tenant)
+
+    def tenant_of(self, shuffle_id: int) -> int:
+        """The shuffle's owning tenant (DEFAULT_TENANT when untaught —
+        a lost TenantMapMsg push degrades fairness, never correctness)."""
+        with self._lock:
+            return self._tenant_map.get(shuffle_id, 0)
+
+    def _release_disk(self, token: int) -> None:
+        with self._lock:
+            entry = self._token_disk.pop(token, None)
+        if entry is not None:
+            self.disk_ledger.release(*entry)
 
     # -- write side ------------------------------------------------------
 
@@ -275,12 +305,24 @@ class TpuShuffleBlockResolver:
                 tmp_path, lengths_arr.tolist())
         index = final + ".index"
         sidecar = integrity.sidecar_path(final)
+        # tenancy: the commit's disk bytes charge the owning tenant
+        # BEFORE anything durable happens — past the spill quota the
+        # attempt fails cleanly (tmp reaped, TenantQuotaError; NOT a
+        # transient disk error, so no retry envelope burns on it)
+        total_bytes = int(lengths_arr.sum())
+        tenant = self.tenant_of(shuffle_id)
+        try:
+            self.disk_ledger.charge(tenant, total_bytes)
+        except Exception:
+            self._reap_quietly(tmp_path)
+            raise
         with self._commit_lock:
             if fence is not None:
                 committed = self._map_fences.get((shuffle_id, map_id), 0)
                 if fence <= committed:
                     self.fenced_commits += 1
                     self._reap_quietly(tmp_path)
+                    self.disk_ledger.release(tenant, total_bytes)
                     raise StaleAttemptError(shuffle_id, map_id, fence,
                                             committed)
             fault_mod.storage_check("commit", final)
@@ -308,6 +350,7 @@ class TpuShuffleBlockResolver:
                 for p in (final, sidecar, sidecar + ".tmp",
                           index, index + ".tmp"):
                     self._reap_quietly(p)
+                self.disk_ledger.release(tenant, total_bytes)
                 raise
             if fence is not None:
                 self._map_fences[(shuffle_id, map_id)] = fence
@@ -320,7 +363,8 @@ class TpuShuffleBlockResolver:
             spill = SpillFile(final, lengths_arr.tolist(), file_token=token)
             if self.block_server is not None:
                 self.block_server.register_file(token, final,
-                                                crc_ranges=crc_ranges)
+                                                crc_ranges=crc_ranges,
+                                                tenant=tenant)
         except BaseException:
             # same invariant past the durable writes: a commit that can't
             # be mapped/served is no commit — a durable triplet that never
@@ -332,6 +376,7 @@ class TpuShuffleBlockResolver:
                 if (fence is not None and
                         self._map_fences.get((shuffle_id, map_id)) == fence):
                     del self._map_fences[(shuffle_id, map_id)]
+            self.disk_ledger.release(tenant, total_bytes)
             raise
         with self._lock:
             # speculative/retried map task: replace and dispose the old
@@ -339,6 +384,7 @@ class TpuShuffleBlockResolver:
             old = self._shuffles.setdefault(shuffle_id, {}).get(map_id)
             self._shuffles[shuffle_id][map_id] = spill
             self._by_token[token] = spill
+            self._token_disk[token] = (tenant, total_bytes)
             if crc_ranges:
                 self._crc_ranges[token] = crc_ranges
             self._integrity[token] = _SpillIntegrity(
@@ -356,6 +402,7 @@ class TpuShuffleBlockResolver:
                 self.block_server.unregister_file(old.file_token)
             old._delete = False  # the path now belongs to the new spill
             old.dispose()
+            self._release_disk(old.file_token)
         # at-rest corruption chaos hook: bit-rot of the COMMITTED bytes,
         # after the (clean) sidecar landed — exactly what verification
         # exists to catch
@@ -548,7 +595,8 @@ class TpuShuffleBlockResolver:
         spill = SpillFile(path, [length], file_token=token)
         if self.block_server is not None:
             self.block_server.register_file(token, path,
-                                            crc_ranges=crc_ranges)
+                                            crc_ranges=crc_ranges,
+                                            tenant=self.tenant_of(shuffle_id))
         with self._lock:
             self._by_token[token] = spill
             if crc_ranges:
@@ -601,6 +649,7 @@ class TpuShuffleBlockResolver:
             index = spill.path + ".index"
             sidecar = integrity.sidecar_path(spill.path)
             spill.dispose()
+            self._release_disk(spill.file_token)
             if os.path.exists(index):
                 os.unlink(index)
             if os.path.exists(sidecar):
@@ -611,6 +660,51 @@ class TpuShuffleBlockResolver:
         # externally-owned served files (merged segments, overflow
         # blobs) die with the shuffle too
         self.release_externals(shuffle_id)
+        with self._lock:
+            self._tenant_map.pop(shuffle_id, None)
+
+    def reap_orphans(self, live_shuffle_ids, min_age_s: float = 60.0
+                     ) -> int:
+        """Driver-driven GC sweep: delete committed triplets
+        (``shuffle_<id>_<map>.data`` + index + sidecar) whose shuffle is
+        neither in ``live_shuffle_ids`` (the driver's registered set)
+        nor registered in THIS resolver — the files a dead or wedged
+        process left behind that no unregister push will ever name.
+        ``min_age_s`` guards the snapshot race: a shuffle registering
+        (and a commit renaming its tmp durable) AFTER the caller took
+        the live set would otherwise look orphaned for a moment — only
+        files older than the guard are eligible. Returns the number of
+        data files reaped."""
+        import re
+        live = set(int(s) for s in live_shuffle_ids)
+        with self._lock:
+            local = set(self._shuffles)
+        pat = re.compile(r"^shuffle_(\d+)_\d+\.data$")
+        cutoff = time.time() - min_age_s
+        reaped = 0
+        for d in [self.spill_dir] + self.fallback_spill_dirs:
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                m = pat.match(name)
+                if m is None:
+                    continue
+                sid = int(m.group(1))
+                if sid in live or sid in local:
+                    continue
+                path = os.path.join(d, name)
+                try:
+                    if os.stat(path).st_mtime > cutoff:
+                        continue  # too fresh: may be a racing commit
+                except OSError:
+                    continue
+                self._reap_quietly(path)
+                self._reap_quietly(path + ".index")
+                self._reap_quietly(integrity.sidecar_path(path))
+                reaped += 1
+        return reaped
 
     def recover(self) -> Dict[int, list]:
         """Rebuild state from committed (data, index) pairs on disk.

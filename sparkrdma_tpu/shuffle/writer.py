@@ -242,6 +242,11 @@ class TpuShuffleWriter:
         self.combiner = combiner
         self.conf = conf or TpuShuffleConf()
         self.pool = pool
+        # tenancy: pool leases (and the commit's disk bytes, resolver-
+        # side) charge the shuffle's owning tenant; the manager teaches
+        # the resolver the mapping before building any writer
+        self.tenant = resolver.tenant_of(shuffle_id) \
+            if hasattr(resolver, "tenant_of") else 0
         self.metrics = WriteMetrics()
         self._tracer = tracer or trace_mod.NULL
         self._closed = False
@@ -392,7 +397,7 @@ class TpuShuffleWriter:
         n = len(keys)
         nbytes = n * self.row_bytes
         if self.pool is not None:
-            buf = self.pool.get(nbytes)
+            buf = self.pool.get(nbytes, tenant=self.tenant)
             view = buf.view[:nbytes]
         else:
             buf, view = None, np.empty(nbytes, dtype=np.uint8)

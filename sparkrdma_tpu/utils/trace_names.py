@@ -41,6 +41,10 @@ SPANS = frozenset({
 
 # Point-in-time instants (fault/decision markers).
 INSTANTS = frozenset({
+    "admit.accept",
+    "admit.expire",
+    "admit.queue",
+    "admit.reject",
     "commit.fenced",
     "exchange.degrade",
     "exchange.hierarchical",
@@ -61,6 +65,7 @@ INSTANTS = frozenset({
     "serve.pin",
     "serve.remap",
     "serve.zero_copy",
+    "tenant.serve",
     "write.cleanup_error",
     "write.spill_remote",
     "write.spill_retry",

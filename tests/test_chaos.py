@@ -65,6 +65,14 @@ SKEW = os.environ.get("CHAOS_SKEW", "0") not in ("0", "false")
 # push_merge=False — the dedicated merge scenarios below own those
 # assertions with deterministic coverage.
 MERGE = os.environ.get("CHAOS_MERGE", "0") not in ("0", "false")
+# tenancy under chaos: 1 runs the whole matrix with every shuffle
+# registered under a real tenant id (TenantMapMsg pushes, serve-path
+# DRR queueing, disk-ledger charging, admission gating with a
+# generous cap, and a live TTL sweeper that must expire NOTHING
+# mid-test) so the tenancy plumbing sees every injected fault;
+# run_chaos.sh sweeps both. The dedicated cross-tenant isolation
+# scenarios below assert the blast-radius invariants regardless.
+TENANT = os.environ.get("CHAOS_TENANT", "0") not in ("0", "false")
 # CHAOS_LOCKGRAPH=1: run every scenario under the lock-order shim
 # (sparkrdma_tpu/analysis/lockgraph.py) so the chaos matrix doubles as
 # race detection — faults drive the rare teardown/retry/suspect paths
@@ -101,6 +109,11 @@ def _conf(**kw):
                 adaptive_plan=SKEW,
                 push_merge=MERGE,
                 collect_shuffle_reader_stats=True)
+    if TENANT:
+        # the tenancy sweep dimension: a generous admission cap (the
+        # gate runs, nothing sheds) and a live TTL sweeper whose TTL no
+        # scenario can reach — expiry mid-fault would be its own bug
+        base.update(admission_max_inflight=16, shuffle_ttl_ms=120_000)
     base.update(kw)
     return TpuShuffleConf(**base)
 
@@ -108,6 +121,17 @@ def _conf(**kw):
 def _cluster(tmp_path, n=3, **kw):
     conf = _conf(**kw)
     driver = TpuShuffleManager(conf, is_driver=True)
+    if TENANT:
+        # every scenario's shuffles register under a real tenant id so
+        # TenantMapMsg pushes, DRR serve queues, and ledger charging
+        # cross every injected fault (explicit tenant= kwargs win)
+        orig_register = driver.register_shuffle
+
+        def register_with_tenant(*args, **kwargs):
+            kwargs.setdefault("tenant", 1)
+            return orig_register(*args, **kwargs)
+
+        driver.register_shuffle = register_with_tenant
     execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
                                executor_id=str(i),
                                spill_dir=str(tmp_path / f"e{i}"))
@@ -531,6 +555,155 @@ def test_chaos_merge_corrupt_segment_degrades_per_map(tmp_path):
         assert m.failed_fetches == 0, f"seed={SEED}: {m}"
         assert m.merged_reads >= 1, \
             f"seed={SEED}: clean partitions should still serve merged"
+    finally:
+        _shutdown(driver, execs)
+
+
+# -- cross-tenant isolation (the CHAOS_TENANT satellite) -----------------
+
+
+def _map_fn_t2(writer, map_id):
+    rng = np.random.default_rng(3000 + map_id)
+    writer.write_batch(rng.integers(0, 5000, size=500).astype(np.uint64))
+
+
+def _expected_t2(num_maps):
+    return np.sort(np.concatenate(
+        [np.random.default_rng(3000 + m).integers(0, 5000, 500)
+         for m in range(num_maps)]).astype(np.uint64))
+
+
+def test_chaos_tenant_executor_loss_isolated(tmp_path):
+    """An executor loss inside tenant 1's shuffle must not perturb
+    tenant 2: tenant 1 heals by recompute-on-survivors (its maps
+    re-execute), tenant 2's shuffle — whose outputs never touched the
+    dead slot — reads byte-identical with ZERO re-executions, zero
+    failed fetches, and its location epoch UNBUMPED (the tombstone
+    invalidates only shuffles naming the dead slot)."""
+    driver, execs = _cluster(tmp_path, read_ahead_depth=4,
+                             fetch_retry_budget=1, push_merge=False)
+    injector = FaultInjector(seed=SEED)
+    t1_reruns = []
+    try:
+        h1 = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                     partitioner=PartitionerSpec("modulo"),
+                                     tenant=1)
+        run_map_stage(execs, h1, _map_fn)  # tenant 1 spans every slot
+        # tenant 2's maps live ONLY on the survivors (execs 0 and 1)
+        h2 = driver.register_shuffle(2, num_maps=4, num_partitions=4,
+                                     partitioner=PartitionerSpec("modulo"),
+                                     tenant=2)
+        for m in range(4):
+            w = execs[m % 2].get_writer(h2, m)
+            _map_fn_t2(w, m)
+            w.close()
+        epoch2_before = driver.driver.epoch_of(2)
+
+        victim_addr = (execs[2].executor.manager_id.rpc_host,
+                       execs[2].executor.manager_id.rpc_port)
+        victim_slot = execs[2].executor.exec_index()
+        injector.install_endpoint(execs[0].executor)
+        injector.add(DISCONNECT, peer=victim_addr,
+                     msg_type=M.FetchBlocksResp)
+        injector.add(REFUSE_CONNECT, peer=victim_addr, after=1)
+        done = threading.Event()
+
+        def kill_on_disconnect():
+            while (injector.fired_count(DISCONNECT) == 0
+                   and not done.wait(0.005)):
+                pass
+            execs[2].executor.server.stop()
+
+        def counting_map_fn(writer, map_id):
+            t1_reruns.append(map_id)
+            _map_fn(writer, map_id)
+
+        killer = threading.Thread(target=kill_on_disconnect)
+        killer.start()
+        try:
+            got1 = run_reduce_with_retry(execs, h1, counting_map_fn,
+                                         _reduce_fn, reducer_index=0,
+                                         driver=driver)
+        finally:
+            done.set()
+            killer.join()
+        np.testing.assert_array_equal(got1, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        assert t1_reruns, f"seed={SEED}: the fault never landed"
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        assert driver.driver.members()[victim_slot] == TOMBSTONE, \
+            f"seed={SEED}"
+
+        # tenant 2: byte-identical, no retries, no re-executions (its
+        # read succeeding outside any retry loop IS the proof), and the
+        # tombstone did not bump its epoch — its warm caches survive
+        reader2 = execs[0].get_reader(h2, 0, 4)
+        keys2, _ = reader2.read_all()
+        np.testing.assert_array_equal(np.sort(keys2), _expected_t2(4),
+                                      err_msg=f"seed={SEED}")
+        m2 = reader2.metrics
+        assert m2.failed_fetches == 0, f"seed={SEED}: {m2}"
+        assert m2.retries == 0, f"seed={SEED}: {m2}"
+        assert driver.driver.epoch_of(2) == epoch2_before, \
+            f"seed={SEED}: tenant 2's epoch bumped by tenant 1's loss"
+    finally:
+        injector.uninstall()
+        _shutdown(driver, execs)
+
+
+def test_chaos_tenant_corrupt_segment_isolated(tmp_path):
+    """At-rest rot on tenant 1's merged segment: tenant 1's read
+    degrades that partition per-map (byte-identical, fallback counted);
+    tenant 2's shuffle on the same cluster still serves MERGED with
+    zero fallbacks, zero checksum failures, zero re-executions — the
+    corruption's blast radius is one tenant's one partition."""
+    import glob
+
+    driver, execs = _cluster(tmp_path, push_merge=True, merge_replicas=1,
+                             push_deadline_ms=8000)
+    try:
+        h1 = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                     partitioner=PartitionerSpec("modulo"),
+                                     tenant=1)
+        run_map_stage(execs, h1, _map_fn)
+        _wait_merge_ready(driver, execs, h1)
+        h2 = driver.register_shuffle(2, num_maps=6, num_partitions=4,
+                                     partitioner=PartitionerSpec("modulo"),
+                                     tenant=2)
+        run_map_stage(execs, h2, _map_fn_t2)
+        _wait_merge_ready(driver, execs, h2)
+
+        # rot the segment tenant 1's reducer WILL choose for partition 0
+        d = driver.driver.merged_directory(1)
+        chosen = d.entries(0)[0]
+        slot_dirs = {execs[i].executor.exec_index():
+                     str(tmp_path / f"e{i}") for i in range(len(execs))}
+        seg = os.path.join(slot_dirs[chosen.slot], "merge", "seg_1_0.bin")
+        assert glob.glob(seg), f"seed={SEED}: {seg} missing"
+        with open(seg, "r+b") as f:
+            first = f.read(1)
+            f.seek(0)
+            f.write(bytes([first[0] ^ 0xFF]))
+
+        reader1 = execs[0].get_reader(h1, 0, 4)
+        keys1, _ = reader1.read_all()
+        np.testing.assert_array_equal(np.sort(keys1), _expected(6),
+                                      err_msg=f"seed={SEED}")
+        m1 = reader1.metrics
+        assert m1.merged_fallbacks >= 1, f"seed={SEED}: {m1}"
+
+        # tenant 2 is untouched: all-merged serving, clean counters
+        reader2 = execs[0].get_reader(h2, 0, 4)
+        keys2, _ = reader2.read_all()
+        np.testing.assert_array_equal(np.sort(keys2), _expected_t2(6),
+                                      err_msg=f"seed={SEED}")
+        m2 = reader2.metrics
+        assert m2.merged_reads >= 1, f"seed={SEED}: {m2}"
+        assert m2.merged_fallbacks == 0, f"seed={SEED}: {m2}"
+        assert m2.checksum_failures == 0, f"seed={SEED}: {m2}"
+        assert m2.failed_fetches == 0, f"seed={SEED}: {m2}"
+        assert driver.driver.epoch_of(2) == 1, \
+            f"seed={SEED}: tenant 2 re-executed under tenant 1's rot"
     finally:
         _shutdown(driver, execs)
 
