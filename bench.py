@@ -470,6 +470,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("serve path done")
     _bench_tenant_isolation(detail)
     _progress("tenant isolation done")
+    _bench_elastic(detail)
+    _progress("elastic drain done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -757,6 +759,42 @@ def _bench_topo_exchange(detail: dict) -> None:
     except Exception as e:  # noqa: BLE001
         detail["hierarchical_exchange_error"] = \
             f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_elastic(detail: dict) -> None:
+    """Elastic membership's win, measured without hardware: the SAME
+    executor leaves the fleet by planned DRAIN (push-merge replication
+    verified, location entries re-point under a bumped epoch — zero
+    re-executions) vs by unplanned KILL on a replication-less fleet
+    (FetchFailed -> recovery recomputes every map it owned), same
+    seeded data, byte-identical gate (shuffle/elastic_bench.py).
+    ``drain_zero_reexec`` is the acceptance gate (must be 0);
+    ``drain_vs_kill_reexec`` and the makespan delta record what one
+    autoscaler shrink decision costs. Pure host path — identical on
+    TPU and CPU-fallback records."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.elastic_bench import (
+            run_elastic_microbench)
+
+        with tempfile.TemporaryDirectory(prefix="elasticbench_") as td:
+            res = run_elastic_microbench(td)
+        if not res["identical"]:
+            detail["elastic_drain_error"] = \
+                "drain/kill arms diverged from the ground truth"
+            return
+        if res["drain_status"] != "drained":
+            detail["elastic_drain_error"] = \
+                f"planned drain fell back: {res['drain_status']}"
+            return
+        detail["drain_zero_reexec"] = res["reexec_drain"]
+        detail["drain_vs_kill_reexec"] = res["reexec_kill"]
+        detail["drain_makespan_s"] = res["drain_makespan_s"]
+        detail["kill_makespan_s"] = res["kill_makespan_s"]
+        detail["drain_makespan_delta_s"] = res["makespan_delta_s"]
+    except Exception as e:  # noqa: BLE001
+        detail["elastic_drain_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _bench_tenant_isolation(detail: dict) -> None:

@@ -35,6 +35,15 @@
 #                               the whole fault matrix, byte-identical;
 #                               the dedicated merge scenarios force it
 #                               on regardless)
+#   CHAOS_ELASTIC_MODES="0 1"   elastic-membership modes to sweep
+#                               (default both: off, and CHAOS_ELASTIC=1
+#                               so the wide byte-identity matrices run
+#                               with a mid-reduce JOIN + graceful DRAIN
+#                               churning in the background — announce,
+#                               membership bump, health-watch, and
+#                               decommission cross every injected
+#                               fault; the dedicated 4->8->4 and
+#                               drainee-death scenarios run regardless)
 #   CHAOS_TENANT_MODES="0 1"    tenancy modes to sweep (default both:
 #                               off, and CHAOS_TENANT=1 so every
 #                               shuffle registers under a real tenant
@@ -59,8 +68,10 @@ WARM_MODES=${CHAOS_WARM_MODES:-"1 0"}
 SKEW_MODES=${CHAOS_SKEW_MODES:-"0 1"}
 MERGE_MODES=${CHAOS_MERGE_MODES:-"0 1"}
 TENANT_MODES=${CHAOS_TENANT_MODES:-"0 1"}
+ELASTIC_MODES=${CHAOS_ELASTIC_MODES:-"0 1"}
 DISK=${CHAOS_DISK:-1}
 failed=()
+for elastic in $ELASTIC_MODES; do
 for tenant in $TENANT_MODES; do
 for merge in $MERGE_MODES; do
 for skew in $SKEW_MODES; do
@@ -69,25 +80,26 @@ for coalesce in $MODES; do
   for seed in $SEEDS; do
     echo "=== chaos sweep: seed ${seed} coalesce=${coalesce}" \
          "warm=${warm} skew=${skew} merge=${merge}" \
-         "tenant=${tenant} disk=${DISK} ==="
+         "tenant=${tenant} elastic=${elastic} disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
          CHAOS_WARM="${warm}" CHAOS_SKEW="${skew}" \
          CHAOS_MERGE="${merge}" CHAOS_TENANT="${tenant}" \
-         CHAOS_DISK="${DISK}" \
+         CHAOS_ELASTIC="${elastic}" CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
            -p no:cacheprovider -p no:randomly; then
       echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm}" \
            "skew=${skew} merge=${merge} tenant=${tenant}" \
-           "FAILED — replay with:"
+           "elastic=${elastic} FAILED — replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
            "CHAOS_WARM=${warm} CHAOS_SKEW=${skew}" \
          "CHAOS_MERGE=${merge} CHAOS_TENANT=${tenant}" \
-           "CHAOS_DISK=${DISK}" \
+           "CHAOS_ELASTIC=${elastic} CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}t${tenant}")
+      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}t${tenant}e${elastic}")
     fi
   done
+done
 done
 done
 done
@@ -100,4 +112,4 @@ if [ "${#failed[@]}" -gt 0 ]; then
 fi
 echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
      "planes, both reduce-planning modes, both push-merge modes," \
-     "both tenancy modes (disk=${DISK})"
+     "both tenancy modes, both elastic-membership modes (disk=${DISK})"

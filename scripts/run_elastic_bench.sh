@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Elastic-membership sweep: the membership test matrix
+# (tests/test_membership.py — membership plane state machine, admission
+# fleet scaling, autoscaler policy, mid-job join + health watch,
+# graceful drain with zero re-executions, drainee-death fallback,
+# mixed-version degrade) across a set of seeds, then the drain-vs-kill
+# microbench with its acceptance gates: byte-identical both arms,
+# ZERO re-executions on the planned drain, a real re-execution bill on
+# the unplanned kill of the same slot. A red seed replays exactly:
+#
+#     ELASTIC_SEED=<seed> python -m pytest tests/test_membership.py
+#
+# Usage: scripts/run_elastic_bench.sh [seed ...]
+#   ELASTIC_SEEDS="0 1 2"   alternative way to pass the seed list
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SEEDS=${*:-${ELASTIC_SEEDS:-"0 7 42"}}
+failed=()
+for seed in $SEEDS; do
+  echo "=== elastic sweep: seed ${seed} ==="
+  if ! ELASTIC_SEED="${seed}" JAX_PLATFORMS=cpu \
+       python -m pytest tests/test_membership.py -q \
+         -p no:cacheprovider -p no:randomly; then
+    echo "!!! seed ${seed} FAILED — replay with:"
+    echo "    ELASTIC_SEED=${seed} python -m pytest tests/test_membership.py"
+    failed+=("${seed}")
+  fi
+done
+
+echo "=== drain-vs-kill microbench ==="
+for seed in $SEEDS; do
+  if ! JAX_PLATFORMS=cpu python - "$seed" <<'EOF'
+import json, sys, tempfile
+from sparkrdma_tpu.shuffle.elastic_bench import run_elastic_microbench
+
+seed = int(sys.argv[1])
+with tempfile.TemporaryDirectory(prefix="elasticbench_") as td:
+    res = run_elastic_microbench(td, seed=seed)
+print(json.dumps(res))
+ok = (res["identical"] and res["drain_status"] == "drained"
+      and res["reexec_drain"] == 0
+      and res["reexec_kill"] == res["victim_owned_maps"] > 0)
+sys.exit(0 if ok else 1)
+EOF
+  then
+    failed+=("microbench-${seed}")
+  fi
+done
+
+if [ "${#failed[@]}" -gt 0 ]; then
+  echo "elastic sweep: FAILED: ${failed[*]}"
+  exit 1
+fi
+echo "elastic sweep: all seeds green, drain-vs-kill gates met" \
+     "(re-executions 0 vs N, byte-identical)"

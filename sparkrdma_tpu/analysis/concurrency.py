@@ -40,6 +40,7 @@ PASS = "concurrency"
 # endpoints, writers with spill workers, pools, fetch pipelines, ...).
 THREADED_MODULES = [
     "sparkrdma_tpu/parallel/endpoints.py",
+    "sparkrdma_tpu/parallel/membership.py",
     "sparkrdma_tpu/parallel/transport.py",
     "sparkrdma_tpu/parallel/faults.py",
     "sparkrdma_tpu/parallel/exchange.py",

@@ -178,6 +178,11 @@ def _gen_arg(name: str, rng: random.Random):
                 for _ in range(rng.randrange(4))]
     if name in ("map_ids", "shard_slots"):
         return [rng.randrange(1 << 20) for _ in range(rng.randrange(6))]
+    if name == "slot_states":
+        # membership slot states pack one BYTE each (SLOT_LIVE=0 /
+        # SLOT_DRAINING=1 / SLOT_DEAD=2); fuzz the full byte domain so
+        # a future state value can't silently truncate
+        return [rng.randrange(256) for _ in range(rng.randrange(8))]
     if name == "lengths":
         return rng.choice([None,
                            [rng.randrange(1 << 31)
@@ -212,6 +217,12 @@ _EXTRA_CASES: Dict[str, List[Callable[[], "rpc_msg.RpcMsg"]]] = {
     "FetchMergedResp": [
         lambda: M.FetchMergedResp(1, M.STATUS_UNKNOWN_SHUFFLE,
                                   M.EPOCH_DEAD, b"")],
+    # elastic membership corners: an empty fleet's bump, the three real
+    # slot states together, and a failed drain's error response
+    "MembershipBumpMsg": [
+        lambda: M.MembershipBumpMsg(1, []),
+        lambda: M.MembershipBumpMsg(7, [0, 1, 2, 0])],
+    "DrainResp": [lambda: M.DrainResp(3, M.STATUS_ERROR, 0, 0)],
 }
 
 
@@ -282,6 +293,25 @@ def _legacy_cases() -> List[Tuple[type, bytes, Callable, str]]:
         (M.FetchTableResp, struct.pack("<qi", 5, 0),
          lambda m: m.epoch == 0 and m.table == b"",
          "header-only (empty, epoch-less) table response must decode"),
+    ]
+    # elastic-membership boundaries: a pre-elastic peer's hello payload
+    # shape (no flags) decoding as a JoinMsg, an epoch-only membership
+    # bump (no state vector = every announced slot LIVE), and a
+    # deadline-less drain request (receiver's configured default)
+    mid = M.JoinMsg(_mk_manager_id(random.Random(0))).payload()
+    cases += [
+        (M.JoinMsg, mid[:-4],
+         lambda m: m.flags == 0,
+         "flag-less join (a hello-shaped pre-elastic payload) must "
+         "decode with flags=0"),
+        (M.MembershipBumpMsg, struct.pack("<q", 11),
+         lambda m: m.epoch == 11 and m.slot_states == [],
+         "epoch-only membership bump (pre-elastic peer) must decode "
+         "with an empty state vector (= all slots LIVE)"),
+        (M.DrainReq, struct.pack("<qi", 4, 2),
+         lambda m: m.req_id == 4 and m.slot == 2 and m.deadline_ms == 0,
+         "deadline-less drain request must decode with deadline_ms=0 "
+         "(= the receiver's drain_deadline_ms)"),
     ]
     return cases
 

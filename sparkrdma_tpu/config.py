@@ -498,6 +498,28 @@ _KEYS = [
              "instead of static partitioning); nonzero pins each "
              "tenant's slice. Single-tenant stages see the full "
              "budget either way."),
+    # --- elastic membership (TPU-only: parallel/membership.py,
+    # docs/CONFIG.md "Membership")
+    _Key("min_executors", 0, "int", 0, 1 << 20,
+         doc="Autoscaler floor: the fleet never drains below this many "
+             "live executors (0 = floor of 1 — a fleet cannot scale to "
+             "zero while the driver holds registered shuffles)."),
+    _Key("max_executors", 0, "int", 0, 1 << 20,
+         doc="Autoscaler ceiling: scale-up never grows the fleet past "
+             "this many live executors. 0 = unbounded (the current "
+             "live count is its own ceiling until a backlog appears)."),
+    _Key("drain_deadline_ms", 30000, "int", 1, 3600_000,
+         doc="Graceful-drain budget per decommission: the drainee's "
+             "replication pass plus the driver's coverage wait must "
+             "finish within it, or the drain FALLS BACK to the "
+             "ordinary tombstone path (recovery re-executes what no "
+             "replica covers — byte-identical, just not free). Also "
+             "the default deadline a DrainReq without one carries."),
+    _Key("autoscale_interval_ms", 0, "int", 0, 3600_000,
+         doc="Autoscaler evaluation period. 0 = the loop never starts "
+             "(attach_autoscaler still works; call tick() manually). "
+             "Scale-down needs two consecutive idle ticks, so the "
+             "effective shrink latency is twice this."),
     # --- two-level topology (TPU-only: parallel/topology.py,
     # docs/CONFIG.md "Topology")
     _Key("slice_topology", "", "str",

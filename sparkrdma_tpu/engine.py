@@ -758,9 +758,25 @@ class DAGEngine:
         live = self._live()
         if avoid is not None and len(live) > 1:
             live = [ex for ex in live if ex is not avoid]
+        # elastic membership: DRAINING slots still serve reads but take
+        # no new tasks — placement steers around them unless they are
+        # all that remains (parallel/membership.py; pre-elastic drivers
+        # have an empty draining set, so this is a no-op there)
+        draining = self._draining_slots()
+        if draining and len(live) > 1:
+            placeable = [ex for ex in live
+                         if self._slot_of(ex) not in draining]
+            if placeable:
+                live = placeable
         if not live:
             raise RuntimeError("no live executors")
         return live[task_id % len(live)]
+
+    def _draining_slots(self) -> set:
+        drv = getattr(self.driver.native, "driver", None)
+        if drv is None or not hasattr(drv, "membership"):
+            return set()
+        return drv.membership.draining_slots()
 
     def _attempt_task(self, stage, task_id: int, target):
         from dataclasses import replace
@@ -1266,6 +1282,15 @@ class DAGEngine:
                 lost = [m for m in lost if m not in covered]
         live = [m for m in self._live()
                 if self._slot_of(m) not in (dead, -1)]
+        # a DRAINING slot must not adopt recomputed maps (it is about to
+        # leave and would immediately need to re-replicate them) unless
+        # it is all that remains
+        draining = self._draining_slots()
+        if draining:
+            placeable = [m for m in live
+                         if self._slot_of(m) not in draining]
+            if placeable:
+                live = placeable
         if not live:
             raise RuntimeError("no surviving executors to recompute on")
         log.warning("recovering shuffle %d: recomputing maps %s lost with "

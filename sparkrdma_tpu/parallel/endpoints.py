@@ -130,11 +130,19 @@ class DriverEndpoint:
     def __init__(self, conf: Optional[TpuShuffleConf] = None, host: str = ""):
         self.conf = conf or TpuShuffleConf()
         bind_host = host or self.conf.driver_host or "127.0.0.1"
-        self.server = ControlServer(bind_host, self.conf.driver_port, self.conf,
-                                    self._handle, name="driver")
-        self._members: List[ShuffleManagerId] = []
-        self._members_epoch = 0
-        self._members_lock = threading.Lock()
+        # elastic membership (parallel/membership.py): the epoch-versioned
+        # membership plane replaces the old static slot list — slots keep
+        # stable indices forever, but each carries a LIVE/DRAINING/DEAD
+        # state and every change bumps ONE monotone epoch, pushed to
+        # executors as a MembershipBumpMsg on the announce channel.
+        from sparkrdma_tpu.parallel.membership import MembershipPlane
+        self.membership = MembershipPlane(tombstone=TOMBSTONE)
+        # planned-drain accounting (membership.drain_slot): completed
+        # graceful retires (zero re-executions) vs deadline/death
+        # fallbacks into ordinary tombstone recovery
+        self.drains_completed = 0
+        self.drain_fallbacks = 0
+        self.autoscaler = None
         self._tables: Dict[int, DriverTable] = {}
         self._tables_lock = threading.Lock()
         # metadata plane (shuffle/location_plane.py): per-shuffle location
@@ -214,6 +222,12 @@ class DriverEndpoint:
         self._tenants: Dict[int, int] = {}
         self._register_times: Dict[int, float] = {}
         self.gc_expired = 0  # audit: TTL-expired shuffles unregistered
+        # the server LAST: its accept thread dispatches hellos/joins the
+        # moment the socket opens, and the handlers touch membership,
+        # admission and tracer state — every field above must exist
+        # before the first frame can arrive
+        self.server = ControlServer(bind_host, self.conf.driver_port,
+                                    self.conf, self._handle, name="driver")
         self._gc_thread: Optional[threading.Thread] = None
         if self.conf.shuffle_ttl_ms > 0:
             self._gc_thread = threading.Thread(
@@ -259,6 +273,11 @@ class DriverEndpoint:
                                     shuffle=shuffle_id, tenant=t,
                                     waited_ms=waited_ms)
 
+        # elastic capacity: the fleet present at the FIRST register is
+        # the baseline admission was sized for; from here every
+        # membership change rescales the cap/retry hints (set_fleet)
+        if self.membership.freeze_baseline():
+            self._update_admission_fleet()
         # may raise AdmissionRejected (retry-after hint attached); an
         # admitted-then-duplicate register releases its slot below
         self.admission.admit(tenant, shuffle_id, on_event=admit_event)
@@ -294,9 +313,9 @@ class DriverEndpoint:
                 self._size_hists[shuffle_id] = SizeHistogram(
                     num_maps, num_partitions)
             if self.conf.metadata_shards > 0:
-                with self._members_lock:
-                    live = [i for i, m in enumerate(self._members)
-                            if m != TOMBSTONE]
+                # shard hosts come from PLACEABLE membership: a draining
+                # slot is about to leave and must not adopt new replicas
+                live = self.membership.live_slots()
                 shard_map = ShardMap.assign(num_maps, live,
                                             self.conf.metadata_shards)
                 if shard_map is not None:
@@ -436,7 +455,11 @@ class DriverEndpoint:
             return self._plans.get(shuffle_id)
 
     def _plan_inputs(self, shuffle_id: int):
-        """(hist, owners, live_slots) for plan construction, or None."""
+        """(hist, owners, live_slots, avoid_slots) for plan
+        construction, or None. ``live_slots`` keeps DRAINING members —
+        their bytes still count for locality accounting and split
+        bounds — while ``avoid_slots`` names them so placement steers
+        new reduce work onto slots that will outlive the stage."""
         with self._tables_lock:
             hist = self._size_hists.get(shuffle_id)
             table = self._tables.get(shuffle_id)
@@ -447,10 +470,9 @@ class DriverEndpoint:
             entry = table.entry(m)
             if entry is not None:
                 owners[m] = entry[1]
-        with self._members_lock:
-            live = [i for i, mm in enumerate(self._members)
-                    if mm != TOMBSTONE]
-        return hist, owners, live
+        live = self.membership.live_slots(include_draining=True)
+        avoid = self.membership.draining_slots()
+        return hist, owners, live, avoid
 
     def build_reduce_plan(self, shuffle_id: int, tracer=None):
         """Build (or rebuild) the shuffle's ReducePlan from the size
@@ -465,7 +487,7 @@ class DriverEndpoint:
         inputs = self._plan_inputs(shuffle_id)
         if inputs is None:
             return None
-        hist, owners, live = inputs
+        hist, owners, live, avoid = inputs
         if hist.maps_recorded == 0 or hist.num_partitions == 0:
             return None
         with self._tables_lock:
@@ -473,7 +495,8 @@ class DriverEndpoint:
         epoch = prev.plan_epoch + 1 if prev is not None else 1
         plan = ReducePlanner(self.conf).plan(shuffle_id, hist, owners,
                                              live, plan_epoch=epoch,
-                                             tracer=tracer)
+                                             tracer=tracer,
+                                             avoid_slots=avoid)
         with self._tables_lock:
             if shuffle_id not in self._tables:
                 return None  # unregistered while planning
@@ -497,13 +520,14 @@ class DriverEndpoint:
         inputs = self._plan_inputs(shuffle_id)
         if inputs is None:
             return None
-        hist, owners, live = inputs
+        hist, owners, live, avoid = inputs
         if dead_slot >= 0:
             live = [s for s in live if s != dead_slot]
         if not live:
             return None
         new_plan = ReducePlanner(self.conf).replan(
-            plan, hist, owners, live, completed_task_ids, tracer=tracer)
+            plan, hist, owners, live, completed_task_ids, tracer=tracer,
+            avoid_slots=avoid)
         with self._tables_lock:
             if shuffle_id not in self._tables:
                 return None
@@ -596,8 +620,7 @@ class DriverEndpoint:
                          if live_dir is not None else None)
         if directory is None or parts <= 0:
             return set()
-        with self._members_lock:
-            members = list(self._members)
+        members = self.membership.members()
 
         def live(slot: int) -> bool:
             return (slot != exclude_slot and slot < len(members)
@@ -622,6 +645,22 @@ class DriverEndpoint:
                 return
             self._finalize_sent.add(shuffle_id)
         self._queue_push(None, M.FinalizeSegmentsReq(0, shuffle_id))
+
+    def refinalize_merge(self, shuffle_id: int) -> None:
+        """Re-broadcast the finalize trigger: drain re-pushes REOPEN
+        already-sealed segments on their targets, and the new rows only
+        publish into the merged directory on a fresh finalize. Only
+        shuffles whose map stage is COMPLETE re-finalize — sealing a
+        mid-stage shuffle early would shed every later background push
+        (membership.drain_slot documents the mid-map-stage fallback)."""
+        if not self.conf.push_merge:
+            return
+        with self._tables_lock:
+            table = self._tables.get(shuffle_id)
+            if table is None or table.num_published < table.num_maps:
+                return
+            self._finalize_sent.discard(shuffle_id)
+        self.finalize_merge(shuffle_id)
 
     def map_entry(self, shuffle_id: int, map_id: int):
         """Current (token, exec_index) for one map, or None (unpublished
@@ -650,8 +689,26 @@ class DriverEndpoint:
             self._broadcasts.pop(bcast_id, None)
 
     def members(self) -> List[ShuffleManagerId]:
-        with self._members_lock:
-            return list(self._members)
+        return self.membership.members()
+
+    def client_conn(self, peer: ShuffleManagerId) -> Connection:
+        """A cached control connection to one member (the drain
+        coordinator's DrainReq rides this)."""
+        return self._clients.get(peer.rpc_host, peer.rpc_port)
+
+    def publish_membership(self, snapshot: List[ShuffleManagerId],
+                           states: List[int], epoch: int) -> None:
+        """Broadcast one committed membership change: the full announce
+        (legacy peers understand exactly this much), the slot-state
+        bump (elastic peers recompute placement/targets/health from
+        it), and the admission capacity rescale."""
+        self._queue_announce(snapshot, epoch)
+        self._queue_push(None, M.MembershipBumpMsg(epoch, states))
+        self._update_admission_fleet()
+
+    def _update_admission_fleet(self) -> None:
+        self.admission.set_fleet(len(self.membership.live_slots()),
+                                 self.membership.baseline())
 
     def remove_member(self, manager_id: ShuffleManagerId) -> None:
         """Executor-loss cleanup (scala/RdmaShuffleManager.scala:155-165).
@@ -660,21 +717,21 @@ class DriverEndpoint:
         fetchers fail fast instead of contacting a dead peer. The tombstoned
         snapshot is re-announced so all executors converge.
         """
-        with self._members_lock:
-            if manager_id not in self._members:
-                return  # unknown or already tombstoned: nothing to do
-            dead_slot = self._members.index(manager_id)
-            self._members = [TOMBSTONE if m == manager_id else m
-                             for m in self._members]
-            self._members_epoch += 1
-            snapshot, epoch = list(self._members), self._members_epoch
-        self._queue_announce(snapshot, epoch)
-        # bump shuffles whose table actually NAMES the dead slot — their
-        # cached locations could route a fetch at a dead executor (the
-        # chaos matrix asserts none serves after this). Shuffles with no
-        # entry on the slot keep their epoch: invalidating them too
-        # would cold-restart every reducer's cache fleet-wide and queue
-        # O(shuffles x members) pushes for nothing.
+        res = self.membership.tombstone(manager_id)
+        if res is None:
+            return  # unknown or already tombstoned: nothing to do
+        snapshot, states, epoch, dead_slot = res
+        self.publish_membership(snapshot, states, epoch)
+        self.on_slot_dead(dead_slot)
+
+    def on_slot_dead(self, dead_slot: int) -> None:
+        """The location-plane half of losing a slot (failure tombstone
+        AND planned retire share it): bump shuffles whose table actually
+        NAMES the dead slot — their cached locations could route a fetch
+        at a dead executor (the chaos matrix asserts none serves after
+        this). Shuffles with no entry on the slot keep their epoch:
+        invalidating them too would cold-restart every reducer's cache
+        fleet-wide and queue O(shuffles x members) pushes for nothing."""
         with self._tables_lock:
             sids = [sid for sid, table in self._tables.items()
                     if any((e := table.entry(m)) is not None
@@ -688,11 +745,91 @@ class DriverEndpoint:
         for sid in sids:
             self.bump_epoch(sid, reason="executor lost")
 
+    # -- elastic membership (parallel/membership.py) ---------------------
+
+    def maps_owned_by(self, shuffle_id: int, slot: int) -> List[int]:
+        """Maps whose CURRENT table entry names ``slot`` (the drain
+        coordinator's re-point accounting)."""
+        with self._tables_lock:
+            table = self._tables.get(shuffle_id)
+        if table is None:
+            return []
+        return [m for m in range(table.num_maps)
+                if (e := table.entry(m)) is not None and e[1] == slot]
+
+    def unservable_without(self, shuffle_id: int, slot: int) -> List[int]:
+        """Maps that could NOT be served if ``slot`` retired right now:
+        no live owner elsewhere AND no merged replica the reducers'
+        merged-first resolution would select. Empty = retiring the slot
+        costs zero re-executions (the drain coordinator's safety
+        invariant; covers maps re-pointed to segments the drainee
+        HOSTS, not just maps it owns)."""
+        with self._tables_lock:
+            table = self._tables.get(shuffle_id)
+        if table is None:
+            return []
+        members = self.membership.members()
+
+        def owner_live(s: int) -> bool:
+            return (s != slot and 0 <= s < len(members)
+                    and members[s] != TOMBSTONE)
+
+        pending = []
+        for m in range(table.num_maps):
+            e = table.entry(m)
+            if e is not None and owner_live(e[1]):
+                continue
+            pending.append(m)
+        if not pending:
+            return []
+        covered = self.merged_covering(shuffle_id, pending,
+                                       exclude_slot=slot)
+        return [m for m in pending if m not in covered]
+
+    def abort_drain(self, slot: int) -> bool:
+        """Return a DRAINING slot to LIVE (the operator changed their
+        mind and the drainee is still healthy), broadcasting the state
+        change — without the publish, peers would treat the slot as
+        draining forever. No-op (False) unless the slot is DRAINING."""
+        reverted = self.membership.abort_drain(slot)
+        if reverted is None:
+            return False
+        self.publish_membership(*reverted)
+        log.info("driver: drain of slot %d aborted; slot is LIVE again",
+                 slot)
+        return True
+
+    def decommission_slot(self, slot: int,
+                          deadline_ms: Optional[int] = None) -> dict:
+        """Gracefully drain + retire one executor slot (see
+        :func:`sparkrdma_tpu.parallel.membership.drain_slot`)."""
+        from sparkrdma_tpu.parallel.membership import drain_slot
+        return drain_slot(self, slot, deadline_ms=deadline_ms)
+
+    def attach_autoscaler(self, scale_up=None, scale_down=None,
+                          load_fn=None):
+        """Create (and with ``autoscale_interval_ms`` > 0, start) the
+        membership autoscaler. ``scale_up(n)`` is the embedding
+        harness's spawn hook; ``scale_down(slot)`` defaults to
+        :meth:`decommission_slot`. Returns the
+        :class:`~sparkrdma_tpu.parallel.membership.Autoscaler`."""
+        from sparkrdma_tpu.parallel.membership import Autoscaler
+        if self.autoscaler is None:
+            self.autoscaler = Autoscaler(self, self.conf,
+                                         scale_up=scale_up,
+                                         scale_down=scale_down,
+                                         load_fn=load_fn)
+            self.autoscaler.start()
+        return self.autoscaler
+
     # -- message handling ------------------------------------------------
 
     def _handle(self, conn: Connection, msg: RpcMsg) -> Optional[RpcMsg]:
         if isinstance(msg, HelloMsg):
             self._on_hello(msg.manager_id)
+            return None
+        if isinstance(msg, M.JoinMsg):
+            self._on_hello(msg.manager_id, explicit_join=True)
             return None
         if isinstance(msg, M.PublishMsg):
             return self._on_publish(msg)
@@ -716,16 +853,24 @@ class DriverEndpoint:
         log.warning("driver: unexpected %s", type(msg).__name__)
         return None
 
-    def _on_hello(self, manager_id: ShuffleManagerId) -> None:
-        """(scala/RdmaShuffleManager.scala:76-115)."""
-        with self._members_lock:
-            if manager_id not in self._members:
-                self._members.append(manager_id)
-            self._members_epoch += 1
-            snapshot, epoch = list(self._members), self._members_epoch
+    def _on_hello(self, manager_id: ShuffleManagerId,
+                  explicit_join: bool = False) -> None:
+        """(scala/RdmaShuffleManager.scala:76-115). A JoinMsg routes
+        here too (``explicit_join``) — the membership plane treats every
+        hello as a join; the explicit frame just names the elastic
+        intent for tracing/audit."""
+        snapshot, states, epoch, is_new = self.membership.join(manager_id)
+        if is_new and (explicit_join or self.membership.joins):
+            self.tracer.instant("member.join", "member",
+                                slot=len(snapshot) - 1, epoch=epoch,
+                                explicit=int(explicit_join))
+            log.info("driver: executor %s:%s JOINED as slot %d "
+                     "(membership epoch %d)", manager_id.rpc_host,
+                     manager_id.rpc_port, len(snapshot) - 1, epoch)
         # Broadcast the full ordered membership to everyone, async — the
-        # driver connects out to each executor's control server.
-        self._queue_announce(snapshot, epoch)
+        # driver connects out to each executor's control server — plus
+        # the slot-state bump and the admission capacity rescale.
+        self.publish_membership(snapshot, states, epoch)
 
     def _queue_announce(self, snapshot: List[ShuffleManagerId],
                         epoch: int) -> None:
@@ -782,8 +927,7 @@ class DriverEndpoint:
 
     def _send_push(self, target: Optional[ShuffleManagerId],
                    msg: RpcMsg) -> None:
-        with self._members_lock:
-            members = list(self._members)
+        members = self.membership.members()
         targets = ([target] if target is not None
                    else [m for m in members if m != TOMBSTONE])
         for m in targets:
@@ -915,8 +1059,7 @@ class DriverEndpoint:
         with self._tables_lock:
             shard_map = self._shard_maps.get(msg.shuffle_id)
         if shard_map is not None:
-            with self._members_lock:
-                members = list(self._members)
+            members = self.membership.members()
             slot = shard_map.slot_of_map(msg.map_id)
             if slot < len(members) and members[slot] != TOMBSTONE:
                 self._queue_push(members[slot], M.ShardEntryMsg(
@@ -1015,6 +1158,8 @@ class DriverEndpoint:
                         req_id, count, table_bytes, epoch))
 
     def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         with self._announce_cond:
             self._announce_stop = True
             self._announce_cond.notify()
@@ -1211,6 +1356,10 @@ class ExecutorEndpoint:
         self._hb_suspects: set = set()
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_wake = threading.Event()
+        # mid-job joiners announced by a MembershipBumpMsg before their
+        # AnnounceMsg landed: slots to register with the monitor once
+        # the member list can resolve them (guarded by _hb_lock)
+        self._joiner_watch_pending: set = set()
         self.suspect_events = 0    # audit: peers declared suspect
         self.checksum_failures = 0  # audit: CRC32 mismatches on fetches
 
@@ -1219,6 +1368,14 @@ class ExecutorEndpoint:
     def start(self) -> None:
         """Hello to the driver (scala/RdmaShuffleManager.scala:204-226)."""
         self.driver_conn().send(HelloMsg(self.manager_id))
+
+    def join_cluster(self) -> None:
+        """Explicit mid-job JOIN (parallel/membership.py): same
+        membership append as the hello, but the driver traces the
+        elastic event. An old driver without the frame would tear the
+        connection — the hello already sent is the compatible greeting,
+        so a lost/ignored join degrades to static-membership behavior."""
+        self.driver_conn().send(M.JoinMsg(self.manager_id))
 
     def driver_conn(self) -> Connection:
         return self._clients.get(*self._driver_addr)
@@ -1414,6 +1571,244 @@ class ExecutorEndpoint:
                 exec_index, peer,
                 f"{n} consecutive missed heartbeats ({kind})")
 
+    # -- elastic membership (parallel/membership.py) ---------------------
+
+    def slot_draining(self, slot: int) -> bool:
+        """True when the driver's pushed state vector marks the slot
+        DRAINING: stop choosing it as a merge/overflow target. Unknown
+        slots read LIVE (pre-elastic drivers never push states)."""
+        return self.location_plane.slot_draining(slot)
+
+    def _on_membership_bump(self, msg: "M.MembershipBumpMsg") -> None:
+        """A pushed membership change: cache the slot-state vector
+        (epoch-ordered) and register newly-LIVE joiners with the
+        peer-health monitor — a mid-job joiner was otherwise never
+        health-watched until some fetch took interest, so its loss was
+        detected only by a failed fetch. The watch costs nothing until
+        a connection to the joiner exists (the monitor peeks, never
+        dials)."""
+        joined = self.location_plane.note_membership(msg.epoch,
+                                                     msg.slot_states)
+        if not joined or self.conf.heartbeat_interval_ms <= 0:
+            return
+        with self._hb_lock:
+            self._joiner_watch_pending.update(joined)
+        self._watch_pending_joiners()
+
+    def _watch_pending_joiners(self) -> None:
+        """Resolve stashed joiner slots against the (possibly
+        just-updated) member list and register them with the monitor.
+        A bump can beat its announce — unresolvable slots stay stashed
+        and the announce handler retries."""
+        if self.conf.heartbeat_interval_ms <= 0 or self._stopping:
+            return
+        with self._hb_lock:
+            pending = set(self._joiner_watch_pending)
+        if not pending:
+            return
+        with self._members_lock:
+            members = list(self._members)
+        for slot in sorted(pending):
+            if slot >= len(members):
+                continue  # announce not here yet; retried on arrival
+            peer = members[slot]
+            if peer == TOMBSTONE or peer == self.manager_id:
+                with self._hb_lock:
+                    self._joiner_watch_pending.discard(slot)
+                continue
+            with self._hb_lock:
+                self._joiner_watch_pending.discard(slot)
+            # monitor-owned watch (never unwatched: the refcount is held
+            # for the joiner's lifetime on this endpoint — suspects and
+            # teardown end it, exactly like a long-lived fetch interest)
+            self.watch_peer(slot, peer)
+
+    def _on_drain(self, conn: Connection, msg: "M.DrainReq") -> None:
+        """The drainee half of the graceful-drain protocol: make every
+        row this executor is the last holder of — its own committed map
+        outputs AND the merged-segment rows it hosts for other
+        executors' maps — land on a surviving peer, then answer with
+        the audit counts. Serving continues throughout — in-flight
+        reads quiesce naturally; the driver only retires the slot after
+        its coverage check passes."""
+        deadline_ms = msg.deadline_ms or self.conf.drain_deadline_ms
+        deadline = time.monotonic() + max(0.05, deadline_ms / 1000)
+        status = M.STATUS_OK
+        rows_pushed = 0
+        bytes_pushed = 0
+        try:
+            status, rows_pushed, bytes_pushed = \
+                self._drain_replicate(deadline)
+        except Exception:  # noqa: BLE001 — dedicated thread; a broken
+            # drain must still answer so the driver's deadline isn't
+            # burned waiting on silence
+            log.exception("drain replication pass failed")
+            status = M.STATUS_ERROR
+        log.info("%s: drain pass done (status %d, %d row(s) pushed, "
+                 "%d byte(s))", self.manager_id.executor_id.executor,
+                 status, rows_pushed, bytes_pushed)
+        try:
+            conn.send(M.DrainResp(msg.req_id, status, rows_pushed,
+                                  bytes_pushed))
+        except TransportError as e:
+            log.warning("drain response lost (driver gone?): %s", e)
+
+    def _drain_directory(self, shuffle_id: int, deadline: float,
+                         expect_entries: bool):
+        """The shuffle's merged directory for drain routing, waiting
+        briefly (bounded by ``deadline``) for the map-stage finalize to
+        land when this executor holds committed outputs but the
+        directory is still empty — a drain racing the ordinary finalize
+        would otherwise route rows blind and scatter coverage."""
+        wait_until = min(deadline, time.monotonic() + 2.0)
+        while True:
+            directory = self.get_merged_directory(shuffle_id, fresh=True)
+            if directory is not None and (len(directory)
+                                          or not expect_entries):
+                return directory
+            if time.monotonic() >= wait_until:
+                return directory
+            time.sleep(0.05)
+
+    def _drain_replicate(self, deadline: float) -> Tuple[int, int, int]:
+        """Replicate everything only this executor holds, routing each
+        (map, partition) row to the slot already holding that
+        partition's WIDEST live merged entry. The routing is the load-
+        bearing part: reducers (and recovery's ``merged_covering``)
+        consume at most ONE merged entry per partition — the widest —
+        so scattering drain rows across slots would build wide-but-
+        incomplete entries that SHADOW the rows' actual coverage.
+        Merging into the already-widest entry keeps one strictly
+        growing segment per partition. Rows the widest surviving entry
+        already covers are skipped outright, so a fleet whose
+        background replication kept up pushes ZERO bytes here.
+
+        Returns ``(status, rows_pushed, bytes_pushed)``."""
+        src = self.data_source
+        if (not self.conf.push_merge or src is None
+                or not hasattr(src, "committed_outputs")):
+            # nothing to replicate WITH: the driver's coverage check
+            # decides (it will fall back to tombstone recovery)
+            return M.STATUS_ERROR, 0, 0
+        try:
+            my = self.exec_index(timeout=1)
+        except KeyError:
+            my = -1
+        with self._members_lock:
+            members = list(self._members)
+        # consult BOTH membership views: the announce list (tombstones)
+        # and the pushed state vector (draining/dead) — back-to-back
+        # drains race their retire announces, and whichever signal
+        # arrives first must keep the just-retired slot out of the
+        # routing pool
+        _, states = self.location_plane.membership()
+        candidates = [i for i, m in enumerate(members)
+                      if m != TOMBSTONE and i != my
+                      and not (i < len(states) and states[i] != 0)]
+        if not candidates:
+            return M.STATUS_ERROR, 0, 0
+        cand_set = set(candidates)
+        directories: Dict[int, object] = {}
+
+        def preferred(sid: int, partition: int):
+            """(entry, slot): the widest surviving entry for the
+            partition and its slot, or (None, deterministic fallback)."""
+            directory = directories.get(sid)
+            if directory is not None:
+                for e in directory.entries(partition):
+                    if e.slot in cand_set:
+                        return e, e.slot
+            return None, candidates[partition % len(candidates)]
+
+        status = M.STATUS_OK
+        rows_pushed = 0
+        bytes_pushed = 0
+
+        def push_row(sid: int, partition: int, map_id: int, fence: int,
+                     data: bytes) -> bool:
+            nonlocal rows_pushed, bytes_pushed, status
+            for _attempt in range(3):
+                if not candidates:
+                    status = M.STATUS_ERROR
+                    return False
+                _, slot = preferred(sid, partition)
+                try:
+                    peer = self.member_at(slot)
+                    resp = self.push_blocks(peer, sid, map_id, fence,
+                                            M.PUSH_KIND_DRAIN, partition,
+                                            [len(data)], data)
+                except (DeadExecutorError, TransportError, TimeoutError,
+                        IndexError) as e:
+                    # the slot died since the candidate snapshot was
+                    # taken — back-to-back drains race their retire
+                    # announces against this pass. Drop it from the
+                    # routing pool and re-route the row; the driver's
+                    # coverage check still arbitrates the final truth.
+                    log.warning("drain push of shuffle %d map %d p%d to "
+                                "slot %d failed (%s); re-routing", sid,
+                                map_id, partition, slot, e)
+                    if slot in cand_set:
+                        cand_set.discard(slot)
+                        candidates.remove(slot)
+                    continue
+                if resp.status == M.STATUS_OK and any(resp.accepted
+                                                      or b"\x01"):
+                    rows_pushed += 1
+                    bytes_pushed += len(data)
+                return True
+            status = M.STATUS_ERROR
+            return False
+
+        own_sids = src.local_shuffles()
+        hosted_sids = (self.merge_store.hosted_shuffles()
+                       if self.merge_store is not None else [])
+        for sid in sorted(set(own_sids) | set(hosted_sids)):
+            directories[sid] = self._drain_directory(
+                sid, deadline, expect_entries=sid in own_sids)
+        # 1) own committed outputs: the rows that would RE-EXECUTE if
+        # this slot died unreplicated
+        for sid in own_sids:
+            for m, lengths in sorted(src.committed_outputs(sid).items()):
+                fence = src.committed_fence(sid, m)
+                for p in range(len(lengths)):
+                    if time.monotonic() > deadline:
+                        log.warning("drain replication hit its deadline "
+                                    "mid-pass (shuffle %d map %d p%d)",
+                                    sid, m, p)
+                        return M.STATUS_ERROR, rows_pushed, bytes_pushed
+                    entry, _ = preferred(sid, p)
+                    if entry is not None and entry.covers(m):
+                        continue  # a surviving replica already has it
+                    try:
+                        data = src.local_blocks(sid, m, p, p + 1)
+                    except Exception as e:  # noqa: BLE001 — corrupt/EIO:
+                        # never replicate rot; recovery owns this map
+                        log.warning("drain read of shuffle %d map %d "
+                                    "p%d failed: %s", sid, m, p, e)
+                        status = M.STATUS_ERROR
+                        break
+                    if data is None:
+                        break  # superseded/unregistered mid-drain
+                    push_row(sid, p, m, fence, data)
+        # 2) hosted merged rows: replicas OTHER maps depend on that
+        # would silently die with this slot. export_rows streams the
+        # payloads (one row in memory at a time) — a target hosting
+        # gigabytes of segments must not materialize them all at the
+        # exact moment it is being decommissioned.
+        if self.merge_store is not None:
+            for sid, partition, map_id, fence, data in \
+                    self.merge_store.export_rows():
+                if time.monotonic() > deadline:
+                    log.warning("drain handoff hit its deadline mid-pass "
+                                "(shuffle %d p%d map %d)", sid, partition,
+                                map_id)
+                    return M.STATUS_ERROR, rows_pushed, bytes_pushed
+                entry, _ = preferred(sid, partition)
+                if entry is not None and entry.covers(map_id):
+                    continue
+                push_row(sid, partition, map_id, fence, data)
+        return status, rows_pushed, bytes_pushed
+
     # -- connection pre-warming ------------------------------------------
 
     def _prewarm_peers(self) -> None:
@@ -1483,6 +1878,19 @@ class ExecutorEndpoint:
             self._members_event.set()
             if self.conf.pre_warm_connections:
                 self._prewarm_peers()
+            self._watch_pending_joiners()
+            return None
+        if isinstance(msg, M.MembershipBumpMsg):
+            self._on_membership_bump(msg)
+            return None
+        if isinstance(msg, M.DrainReq):
+            # NOT the serve pool: the replication pass can run for up to
+            # drain_deadline_ms and must not starve block serving —
+            # same contract as the finalize handler
+            threading.Thread(
+                target=self._on_drain, args=(conn, msg), daemon=True,
+                name=f"drain-{self.manager_id.executor_id.executor}"
+            ).start()
             return None
         if isinstance(msg, M.EpochBumpMsg):
             self._on_epoch_bump(msg)
@@ -1547,7 +1955,8 @@ class ExecutorEndpoint:
         if isinstance(msg, (M.FetchOutputResp, M.FetchOutputsResp,
                             M.FetchTableResp, M.FetchShardResp,
                             M.FetchPlanResp, M.PushBlocksResp,
-                            M.FinalizeSegmentsResp, M.FetchMergedResp)):
+                            M.FinalizeSegmentsResp, M.FetchMergedResp,
+                            M.DrainResp)):
             # orphan of a cancelled/timed-out request (the fetcher
             # cancels whole read-ahead windows on failure); unlike block
             # responses these carry no credits, so dropping is complete
@@ -2115,7 +2524,8 @@ class ExecutorEndpoint:
         else:
             status, accepted = store.push(
                 msg.shuffle_id, msg.map_id, msg.fence,
-                msg.start_partition, msg.sizes, msg.data)
+                msg.start_partition, msg.sizes, msg.data,
+                reopen=msg.kind == M.PUSH_KIND_DRAIN)
             resp = M.PushBlocksResp(msg.req_id, status, 0, accepted)
         try:
             conn.send(resp)
@@ -2183,7 +2593,8 @@ class ExecutorEndpoint:
         assert isinstance(resp, M.PushBlocksResp)
         return resp
 
-    def get_merged_directory(self, shuffle_id: int, metrics=None):
+    def get_merged_directory(self, shuffle_id: int, metrics=None,
+                             fresh: bool = False):
         """The shuffle's merged-segment directory, cache-first: the
         location plane's epoch-validated copy when current, else ONE
         pull from the driver (cached under the response epoch when
@@ -2193,7 +2604,7 @@ class ExecutorEndpoint:
         None (driver unreachable / shuffle unknown / feature off)."""
         if not self.conf.push_merge:
             return None
-        cached = self.location_plane.merged(shuffle_id)
+        cached = None if fresh else self.location_plane.merged(shuffle_id)
         if cached is not None:
             return cached
         from sparkrdma_tpu.shuffle.push_merge import MergedDirectory

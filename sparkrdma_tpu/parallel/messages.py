@@ -731,6 +731,9 @@ class FetchPlanResp(RpcMsg):
 
 PUSH_KIND_MERGE = 0     # per-partition blocks into merged segments
 PUSH_KIND_OVERFLOW = 1  # tiered-spill overflow blob (fetched back at merge)
+PUSH_KIND_DRAIN = 2     # drain re-push: like MERGE, but may REOPEN an
+#                         already-finalized segment (the driver
+#                         re-finalizes after the drainee's DrainResp)
 
 
 @register()
@@ -963,6 +966,132 @@ class TenantMapMsg(RpcMsg):
     def from_payload(cls, payload: bytes) -> "TenantMapMsg":
         shuffle_id, tenant, ttl_ms = struct.unpack_from("<iiq", payload, 0)
         return cls(shuffle_id, tenant, ttl_ms)
+
+
+# -- elastic membership (parallel/membership.py) ---------------------------
+#
+# The membership plane's wire half: explicit mid-job joins, the pushed
+# slot-state vector, and the graceful-drain request/response. All four
+# frames are ADDITIVE — a pre-elastic peer that never sends or receives
+# them sees exactly the static-membership protocol (announce-only), which
+# is the documented mixed-version degrade.
+
+@register()
+class JoinMsg(RpcMsg):
+    """Executor -> driver: an explicit mid-job JOIN. Same membership
+    append as a HelloMsg (which remains the startup greeting and the
+    legacy join), but names the intent so the driver traces the elastic
+    event and bumps capacity hints immediately. ``flags`` is reserved
+    (0); a pre-elastic payload without it decodes to 0."""
+
+    FLAGS_NONE = 0
+
+    def __init__(self, manager_id, flags: int = 0):
+        self.manager_id = manager_id
+        self.flags = flags
+
+    def payload(self) -> bytes:
+        return self.manager_id.serialize() + struct.pack("<I", self.flags)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "JoinMsg":
+        from sparkrdma_tpu.utils.ids import ShuffleManagerId
+        mid, off = ShuffleManagerId.deserialize(payload)
+        flags = 0
+        if len(payload) >= off + 4:
+            (flags,) = struct.unpack_from("<I", payload, off)
+        return cls(mid, flags)
+
+
+@register()
+class MembershipBumpMsg(RpcMsg):
+    """Driver -> all executors: the membership plane moved — epoch
+    ``epoch`` with per-slot states ``slot_states`` (``SLOT_LIVE`` /
+    ``SLOT_DRAINING`` / ``SLOT_DEAD``, one byte per announce slot).
+    Rides the same broadcast channel as announces; receivers keep the
+    highest epoch. Pushers stop choosing DRAINING slots as merge
+    targets, fetch planners stop placing work there, and the health
+    monitor registers newly-LIVE joiners. An epoch-only legacy payload
+    (or a peer that drops the frame entirely) decodes to an empty state
+    vector = every announced slot treated LIVE — the static-membership
+    behavior."""
+
+    def __init__(self, epoch: int, slot_states: List[int]):
+        self.epoch = epoch
+        self.slot_states = [int(s) for s in slot_states]
+
+    def payload(self) -> bytes:
+        return (_Q.pack(self.epoch)
+                + struct.pack("<I", len(self.slot_states))
+                + bytes(s & 0xFF for s in self.slot_states))
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "MembershipBumpMsg":
+        (epoch,) = _Q.unpack_from(payload, 0)
+        states: List[int] = []
+        if len(payload) >= _Q.size + 4:
+            (n,) = struct.unpack_from("<I", payload, _Q.size)
+            states = list(payload[_Q.size + 4:_Q.size + 4 + n])
+        return cls(epoch, states)
+
+
+@register()
+class DrainReq(RpcMsg):
+    """Driver -> drainee: replicate everything you own, you are being
+    decommissioned. The drainee re-pushes its committed map outputs
+    (``PUSH_KIND_DRAIN`` — ledger fences dedupe whatever background
+    push-merge already delivered) and hands off the merged-segment rows
+    it hosts for OTHER executors' maps, then answers ``DrainResp``.
+    ``deadline_ms`` bounds the drainee-side work; a pre-elastic payload
+    without it decodes to 0 = the receiver's configured
+    ``drain_deadline_ms``."""
+
+    def __init__(self, req_id: int, slot: int, deadline_ms: int = 0):
+        self.req_id = req_id
+        self.slot = slot
+        self.deadline_ms = deadline_ms
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.slot) + struct.pack(
+            "<q", self.deadline_ms)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "DrainReq":
+        req_id, slot = _QI.unpack_from(payload, 0)
+        deadline_ms = 0
+        if len(payload) >= _QI.size + 8:
+            (deadline_ms,) = struct.unpack_from("<q", payload, _QI.size)
+        return cls(req_id, slot, deadline_ms)
+
+
+@register()
+class DrainResp(RpcMsg):
+    """Drainee -> driver: the replication pass finished. ``STATUS_OK``
+    means every committed output was (re-)pushed and hosted segments
+    handed off within the deadline; ``STATUS_ERROR`` means a partial or
+    impossible drain (push-merge off, pusher dead) — the driver's
+    coverage check decides whether existing replicas suffice or the
+    drain falls back to tombstone recovery either way. ``maps_pushed``
+    and ``bytes_pushed`` are the audit counters the drain result
+    reports."""
+
+    def __init__(self, req_id: int, status: int, maps_pushed: int,
+                 bytes_pushed: int):
+        self.req_id = req_id
+        self.status = status
+        self.maps_pushed = maps_pushed
+        self.bytes_pushed = bytes_pushed
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.status) + struct.pack(
+            "<qq", self.maps_pushed, self.bytes_pushed)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "DrainResp":
+        req_id, status = _QI.unpack_from(payload, 0)
+        maps_pushed, bytes_pushed = struct.unpack_from(
+            "<qq", payload, _QI.size)
+        return cls(req_id, status, maps_pushed, bytes_pushed)
 
 
 # Status codes shared by responses.

@@ -69,6 +69,10 @@ WIRE_IDS: Dict[str, int] = {
     "FetchMergedReq": 33,
     "FetchMergedResp": 34,
     "TenantMapMsg": 35,
+    "JoinMsg": 36,
+    "MembershipBumpMsg": 37,
+    "DrainReq": 38,
+    "DrainResp": 39,
 }
 
 # Ids deliberately absent from the dense 1..max range, with the reason

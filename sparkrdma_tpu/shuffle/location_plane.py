@@ -239,12 +239,54 @@ class LocationPlane:
         # cached (endpoint policy), so pre-finalize stages keep pulling.
         self._merged: Dict[int, Tuple[object, int]] = {}
         self._max_ranges = max_ranges
+        # elastic membership (parallel/membership.py): the pushed
+        # slot-state vector under ITS epoch — highest epoch wins, same
+        # rule as announces. Empty until the first MembershipBumpMsg
+        # (pre-elastic drivers never send one): every slot then reads
+        # LIVE, the static-membership behavior.
+        self._member_epoch = -1
+        self._member_states: Tuple[int, ...] = ()
         # audit counters (surfaced via snapshot(); the warm-path test and
         # the iterative bench read these)
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
         self.stale_drops = 0
+
+    # -- membership states (parallel/membership.py) -----------------------
+
+    def note_membership(self, epoch: int, states) -> List[int]:
+        """Apply one pushed slot-state vector; stale (lower-epoch)
+        pushes are ignored. Returns the slots that BECAME live with this
+        bump (mid-job joiners — the health monitor registers them)."""
+        with self._lock:
+            if epoch <= self._member_epoch:
+                return []
+            old = self._member_states
+            new = tuple(int(s) for s in states)
+            self._member_epoch = epoch
+            self._member_states = new
+        joined = []
+        for i, s in enumerate(new):
+            was = old[i] if i < len(old) else None
+            if s == 0 and was != 0:  # SLOT_LIVE
+                joined.append(i)
+        return joined
+
+    def membership(self) -> Tuple[int, Tuple[int, ...]]:
+        """``(epoch, states)`` — ``(-1, ())`` before any bump."""
+        with self._lock:
+            return self._member_epoch, self._member_states
+
+    def slot_draining(self, slot: int) -> bool:
+        """True when the pushed state vector marks the slot DRAINING —
+        pushers stop choosing it as a merge target and planners stop
+        placing work there. Unknown slots (no bump yet, or a joiner
+        newer than the vector) read False = LIVE."""
+        with self._lock:
+            if not 0 <= slot < len(self._member_states):
+                return False
+            return self._member_states[slot] == 1  # SLOT_DRAINING
 
     # -- epoch observation ------------------------------------------------
 
@@ -463,6 +505,8 @@ class LocationPlane:
                 "shard_maps": len(self._shard_maps),
                 "plans": len(self._plans),
                 "merged": len(self._merged),
+                "member_epoch": self._member_epoch,
+                "member_states": list(self._member_states),
                 "hits": self.hits,
                 "misses": self.misses,
                 "invalidations": self.invalidations,

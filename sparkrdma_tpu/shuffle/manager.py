@@ -265,6 +265,25 @@ class TpuShuffleManager:
         return self.driver.build_reduce_plan(handle.shuffle_id,
                                              tracer=self.tracer)
 
+    def decommission_slot(self, slot: int,
+                          deadline_ms: Optional[int] = None) -> dict:
+        """Driver-role: gracefully drain + retire one executor slot
+        (parallel/membership.py) — push-merge replicates the drainee's
+        committed outputs, location entries re-point under a bumped
+        epoch, and the slot retires with zero re-executions; a drainee
+        death mid-drain falls back to ordinary tombstone recovery."""
+        if self.driver is None:
+            raise RuntimeError("decommission_slot is a driver-role call")
+        return self.driver.decommission_slot(slot, deadline_ms=deadline_ms)
+
+    def join_cluster(self) -> None:
+        """Executor-role: announce an explicit mid-job JOIN (the elastic
+        scale-up path; the startup hello already made this executor a
+        member — this names the intent so the driver traces it)."""
+        if self.executor is None:
+            raise RuntimeError("join_cluster is an executor-role call")
+        self.executor.join_cluster()
+
     def recover_and_republish(self) -> dict:
         """Elastic rejoin: recover committed spills from disk and
         re-publish them under this executor's (new) slot. The positional

@@ -369,13 +369,18 @@ class ReducePlanner:
 
     def plan(self, shuffle_id: int, hist: SizeHistogram,
              owners: Dict[int, int], live_slots: Sequence[int],
-             plan_epoch: int = 1, tracer=None) -> ReducePlan:
+             plan_epoch: int = 1, tracer=None,
+             avoid_slots: Sequence[int] = ()) -> ReducePlan:
         """Build the plan for one shuffle at map-stage completion.
 
         ``owners`` maps map_id -> executor slot (the driver table's
         entries); ``live_slots`` the non-tombstoned membership slots.
-        Emits ``plan.coalesce`` / ``plan.split`` trace instants per
-        decision so skew handling is visible per stage."""
+        ``avoid_slots`` names members that still SERVE but must take no
+        new reduce work (DRAINING under the elastic membership plane) —
+        their bytes keep counting for locality/balance accounting, the
+        placement just steers around them. Emits ``plan.coalesce`` /
+        ``plan.split`` trace instants per decision so skew handling is
+        visible per stage."""
         num_maps = hist.num_maps
         num_partitions = hist.num_partitions
         totals = hist.partition_totals()
@@ -431,7 +436,19 @@ class ReducePlanner:
                         start=t.start_partition, end=t.end_partition)
         plan = ReducePlan(shuffle_id, plan_epoch, num_maps,
                           num_partitions, tasks)
-        return self._place(plan, hist, owners, list(live_slots))
+        return self._place(plan, hist, owners,
+                           self._placeable(live_slots, avoid_slots))
+
+    @staticmethod
+    def _placeable(live_slots: Sequence[int],
+                   avoid_slots: Sequence[int]) -> List[int]:
+        """Placement candidates: live minus avoided (draining) slots —
+        unless that empties the list, in which case avoidance yields
+        (placing on a draining slot beats placing nowhere; the drain
+        coordinator's coverage wait still protects the bytes)."""
+        avoid = set(avoid_slots)
+        keep = [s for s in live_slots if s not in avoid]
+        return keep if keep else list(live_slots)
 
     # -- placement --------------------------------------------------------
 
@@ -512,7 +529,7 @@ class ReducePlanner:
     def replan(self, plan: ReducePlan, hist: SizeHistogram,
                owners: Dict[int, int], live_slots: Sequence[int],
                completed_task_ids: Iterable[int],
-               tracer=None) -> ReducePlan:
+               tracer=None, avoid_slots: Sequence[int] = ()) -> ReducePlan:
         """Re-assign ORPHANED tasks after an executor loss, under a
         bumped plan epoch. Task ranges never change — completed tasks
         keep their results, incomplete tasks keep their exact
@@ -520,8 +537,15 @@ class ReducePlanner:
         tasks whose slot is no longer live moves, to the live slot
         holding the largest share of their input (the lost executor's
         recomputed maps have new owners by now), least-loaded on ties.
-        Emits one ``plan.replan`` instant naming the orphan count."""
+        ``avoid_slots`` (DRAINING members) stay valid homes for tasks
+        already placed there — they still serve — but orphans never
+        re-home onto them. Emits one ``plan.replan`` instant naming the
+        orphan count."""
         live = list(live_slots)
+        # orphanhood is judged against EVERY live slot (a task on a
+        # draining member is not orphaned — the member still serves);
+        # re-homing candidates exclude the draining set
+        candidates = self._placeable(live_slots, avoid_slots)
         completed = set(completed_task_ids)
         assigned: Dict[int, int] = {s: 0 for s in live}
         orphans: List[PlanTask] = []
@@ -546,12 +570,12 @@ class ReducePlanner:
                 # link-cost scoring: orphans re-home to the cheapest
                 # slot under the two-level coefficients, same as _place
                 live_sorted = sorted(
-                    live, key=lambda s: (self._link_cost(
+                    candidates, key=lambda s: (self._link_cost(
                         per_slot, s, slot_slice, topo), assigned[s], s))
             else:
                 live_sorted = sorted(
-                    live, key=lambda s: (-per_slot.get(s, 0),
-                                         assigned[s], s))
+                    candidates, key=lambda s: (-per_slot.get(s, 0),
+                                               assigned[s], s))
             best = live_sorted[0] if live_sorted else -1
             new_place[t.task_id] = best
             if best in assigned:

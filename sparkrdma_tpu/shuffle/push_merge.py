@@ -336,6 +336,7 @@ class MergeStore:
         self.pushes_accepted = 0
         self.pushes_rejected = 0
         self.segments_finalized = 0
+        self.reopens = 0  # drain re-pushes that reopened a sealed shuffle
 
     # -- push side -------------------------------------------------------
 
@@ -344,9 +345,17 @@ class MergeStore:
 
     def push(self, shuffle_id: int, map_id: int, fence: int,
              start_partition: int, sizes: Sequence[int],
-             data: bytes) -> Tuple[int, bytes]:
+             data: bytes, reopen: bool = False) -> Tuple[int, bytes]:
         """Append one map's blocks for partitions [start, start+len);
         returns ``(status, accepted)`` — one byte per pushed partition.
+
+        ``reopen`` is the graceful-drain path (``PUSH_KIND_DRAIN``): a
+        drain re-push may land AFTER this target sealed the shuffle —
+        instead of the STATUS_FINALIZED rejection the segment REOPENS
+        (the driver re-broadcasts finalize once the drainee's pass
+        completes, so the new rows still publish). Ledger fences dedupe
+        as always, so re-pushing what background replication already
+        delivered appends nothing.
 
         Disk never happens under the store lock: the lock covers ledger
         bookkeeping only (fence checks, byte-range RESERVATION, row
@@ -370,8 +379,11 @@ class MergeStore:
                 state = _ShuffleSegments()
                 self._shuffles[shuffle_id] = state
             if state.finalized:
-                self.pushes_rejected += len(sizes)
-                return M.STATUS_FINALIZED, bytes(accepted)
+                if not reopen:
+                    self.pushes_rejected += len(sizes)
+                    return M.STATUS_FINALIZED, bytes(accepted)
+                state.finalized = False
+                self.reopens += 1
             state.last_push = time.monotonic()
             state.num_maps = max(state.num_maps, map_id + 1)
             for i, size in enumerate(sizes):
@@ -476,6 +488,47 @@ class MergeStore:
             state.charged[tenant] = state.charged.get(tenant, 0) \
                 + len(data)
         return M.STATUS_OK, token
+
+    def hosted_shuffles(self) -> List[int]:
+        """Shuffle ids with at least one non-empty ledger here — the
+        cheap metadata pass a drain uses to prefetch directories before
+        streaming :meth:`export_rows` (which reads file payloads and
+        must stay lazy)."""
+        with self._lock:
+            return sorted(sid for sid, state in self._shuffles.items()
+                          if any(ledger.rows
+                                 for ledger in state.ledgers.values()))
+
+    def export_rows(self):
+        """Yield every surviving ledger row as ``(shuffle_id, partition,
+        map_id, fence, bytes)`` — the graceful-drain HANDOFF source: a
+        retiring target re-pushes the rows it hosts for other
+        executors' maps to surviving peers, so replicas this fleet
+        already paid for don't silently die with the slot. Fence
+        supersession is resolved (``final_rows``), bookkeeping is
+        snapshotted under the lock, file reads happen outside it."""
+        with self._lock:
+            items = [(sid, p, ledger.path, ledger.final_rows())
+                     for sid, state in self._shuffles.items()
+                     for p, ledger in state.ledgers.items()]
+        for sid, partition, path, rows in sorted(
+                items, key=lambda it: (it[0], it[1])):
+            if not rows:
+                continue
+            try:
+                f = open(path, "rb")
+            except OSError as e:
+                log.warning("drain export of %s failed: %s", path, e)
+                continue
+            with f:
+                for map_id, fence, off, ln, _crc in rows:
+                    try:
+                        f.seek(off)
+                        data = f.read(ln)
+                    except OSError:
+                        continue
+                    if len(data) == ln:
+                        yield sid, partition, map_id, fence, data
 
     # -- finalize --------------------------------------------------------
 
@@ -740,7 +793,14 @@ class SegmentPusher:
     def _targets(self, task: _PushTask) -> Dict[int, List[Tuple[int, int]]]:
         from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
         members = self.endpoint.members()
-        live = [i for i, m in enumerate(members) if m != TOMBSTONE]
+        # live AND not draining: a slot the membership plane marked
+        # DRAINING is about to leave — replicas parked there would need
+        # an immediate handoff, so stop choosing it now (the drainee
+        # itself is excluded by my_slot as always). Pre-elastic drivers
+        # never push states, so slot_draining is uniformly False.
+        draining = getattr(self.endpoint, "slot_draining", None)
+        live = [i for i, m in enumerate(members) if m != TOMBSTONE
+                and not (draining is not None and draining(i))]
         try:
             my = self.endpoint.exec_index()
         except KeyError:
@@ -897,8 +957,10 @@ class MergeClient:
             my = self.endpoint.exec_index()
         except KeyError:
             my = -1
+        draining = getattr(self.endpoint, "slot_draining", None)
         candidates = [i for i, m in enumerate(members)
-                      if m != TOMBSTONE and i != my]
+                      if m != TOMBSTONE and i != my
+                      and not (draining is not None and draining(i))]
         for slot in candidates:
             try:
                 peer = self.endpoint.member_at(slot)

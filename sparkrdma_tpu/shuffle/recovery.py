@@ -48,7 +48,8 @@ def run_map_stage(executors: Sequence[TpuShuffleManager],
                   handle: ShuffleHandle, map_fn: MapTask,
                   map_ids: Sequence[int] = (),
                   placement: Dict[int, int] = None,
-                  slot_loads: Optional[Dict[int, float]] = None
+                  slot_loads: Optional[Dict[int, float]] = None,
+                  exclude_slots: Sequence[int] = ()
                   ) -> Dict[int, int]:
     """Run map tasks round-robin (or per ``placement``); returns the
     executor index that ran each map.
@@ -62,9 +63,23 @@ def run_map_stage(executors: Sequence[TpuShuffleManager],
     of bytes already owned per slot — recovery feeds the planner's size
     stats here) plus the bytes this call has placed so far, so a burst
     of re-placements spreads instead of piling onto one lucky
-    survivor."""
+    survivor. ``exclude_slots`` names MEMBERSHIP slots (not executor
+    list indexes) that must take no new maps — the elastic plane's
+    DRAINING members — unless excluding them would leave nobody."""
     live = [i for i, ex in enumerate(executors)
             if ex.executor is not None and not ex.executor.server.stopped]
+    if exclude_slots:
+        banned = set(exclude_slots)
+
+        def _member_slot(i: int) -> int:
+            try:
+                return executors[i].executor.exec_index(timeout=0.5)
+            except KeyError:
+                return -1
+
+        keep = [i for i in live if _member_slot(i) not in banned]
+        if keep:
+            live = keep
     loads: Dict[int, float] = {s: 0.0 for s in live}
     if slot_loads:
         for s, v in slot_loads.items():
@@ -252,15 +267,27 @@ def recover_lost_maps(executors: Sequence[TpuShuffleManager],
     # whole extra stage retry discovering it). For a corrupt
     # verdict the blamed slot is alive and eligible — a
     # re-execution there replaces the quarantined file in place.
+    # elastic membership: DRAINING slots are about to leave — they must
+    # not adopt recomputed maps (the drain would immediately have to
+    # re-replicate them), unless they are all that remains
+    draining: set = set()
+    drv_ep0 = getattr(driver, "driver", driver)
+    if drv_ep0 is not None and hasattr(drv_ep0, "membership"):
+        draining = drv_ep0.membership.draining_slots()
     survivors = []
+    draining_survivors = []
     for i, ex in enumerate(executors):
         if ex.executor is None or ex.executor.server.stopped:
             continue
         try:
-            if corrupt or ex.executor.exec_index(timeout=1) != dead_slot:
-                survivors.append(i)
+            slot = ex.executor.exec_index(timeout=1)
         except KeyError:
             continue
+        if corrupt or slot != dead_slot:
+            (draining_survivors if slot in draining
+             else survivors).append(i)
+    if not survivors:
+        survivors = draining_survivors
     if not survivors:
         raise failure
     placement = {m: survivors[k % len(survivors)]
@@ -274,7 +301,7 @@ def recover_lost_maps(executors: Sequence[TpuShuffleManager],
         hist = drv_ep.size_histogram(handle.shuffle_id)
     loads = _recovery_slot_loads(table, handle.num_maps, hist)
     run_map_stage(executors, handle, map_fn, lost_maps, placement,
-                  slot_loads=loads)
+                  slot_loads=loads, exclude_slots=draining)
     # publishes are one-sided (no ack) and a repair OVERWRITE
     # doesn't change the publish count, so the long-poll can't
     # sync on it: poll until the table visibly stops naming the

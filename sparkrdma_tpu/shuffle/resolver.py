@@ -568,6 +568,21 @@ class TpuShuffleBlockResolver:
         with self._lock:
             return sorted(self._shuffles.get(shuffle_id, {}).keys())
 
+    def local_shuffles(self):
+        """Shuffle ids with committed outputs on this resolver (the
+        graceful-drain replication pass enumerates from here)."""
+        with self._lock:
+            return sorted(self._shuffles)
+
+    def committed_outputs(self, shuffle_id: int) -> Dict[int, list]:
+        """``map_id -> per-partition byte lengths`` for every committed
+        output of the shuffle — exactly the vector a push-merge
+        ``SegmentPusher.submit`` needs, so a draining executor can
+        re-push everything it owns without re-reading index files."""
+        with self._lock:
+            return {m: [int(x) for x in s.partition_lengths]
+                    for m, s in self._shuffles.get(shuffle_id, {}).items()}
+
     def local_output_bytes(self, shuffle_id: int) -> Dict[int, int]:
         """``map_id -> committed data bytes`` this resolver holds for the
         shuffle (per-partition length sums from the in-memory index, no

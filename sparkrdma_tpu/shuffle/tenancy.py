@@ -233,6 +233,13 @@ class AdmissionController:
         self._queued: Dict[int, int] = {}      # tenant -> waiter count
         self._turn: Dict[int, int] = {}        # FIFO ticket being served
         self._next_ticket: Dict[int, int] = {}
+        # elastic fleet scaling (parallel/membership.py): capacity hints
+        # track LIVE membership, not the startup slot count — the cap
+        # and the retry-after hint scale by live/baseline, so a drained
+        # fleet sheds honestly and a grown fleet admits more. (0, 0) =
+        # no scaling (the static pre-elastic behavior).
+        self._fleet_live = 0
+        self._fleet_baseline = 0
         self.accepted = 0
         self.queued_total = 0
         self.rejected = 0
@@ -240,6 +247,49 @@ class AdmissionController:
     def inflight(self, tenant: int) -> int:
         with self._cond:
             return len(self._inflight.get(tenant, ()))
+
+    # -- elastic fleet capacity (parallel/membership.py) -----------------
+
+    def set_fleet(self, live: int, baseline: int) -> None:
+        """Teach the controller the current live executor count and the
+        startup baseline it was sized for. The driver calls this on
+        every membership change (join, drain begin, retire, tombstone);
+        queued waiters re-evaluate against the new cap immediately."""
+        with self._cond:
+            self._fleet_live = max(0, int(live))
+            self._fleet_baseline = max(0, int(baseline))
+            self._cond.notify_all()
+
+    def _fleet_scale_locked(self) -> float:
+        if self._fleet_baseline <= 0 or self._fleet_live <= 0:
+            return 1.0
+        return self._fleet_live / self._fleet_baseline
+
+    def effective_max_inflight(self) -> int:
+        """The per-tenant in-flight cap under CURRENT membership (0 =
+        admission off)."""
+        with self._cond:
+            return self._effective_cap_locked()
+
+    def _effective_cap_locked(self) -> int:
+        if self.max_inflight <= 0:
+            return 0
+        return max(1, int(round(self.max_inflight
+                                * self._fleet_scale_locked())))
+
+    def effective_retry_after_ms(self) -> int:
+        """The retry-after hint under CURRENT membership: a drained
+        fleet hands out proportionally LONGER backoff (capacity shrank,
+        so retries should too), a grown fleet keeps the configured
+        hint — shortening it would just synchronize retry storms."""
+        with self._cond:
+            return self._retry_after_locked()
+
+    def _retry_after_locked(self) -> int:
+        scale = self._fleet_scale_locked()
+        if scale >= 1.0:
+            return self.retry_after_ms
+        return max(1, int(round(self.retry_after_ms / max(scale, 1e-9))))
 
     def admit(self, tenant: int, shuffle_id: int,
               on_event: Optional[Callable[[str, int, int], None]] = None
@@ -259,7 +309,11 @@ class AdmissionController:
             mine = self._inflight.setdefault(tenant, set())
             if shuffle_id in mine:
                 return  # idempotent re-register
-            if len(mine) < self.max_inflight and \
+            # the cap tracks LIVE membership (set_fleet), not the
+            # startup slot count: a drained fleet admits less, a grown
+            # fleet more, and the rejection hint stretches as capacity
+            # shrinks
+            if len(mine) < self._effective_cap_locked() and \
                     self._queued.get(tenant, 0) == 0:
                 mine.add(shuffle_id)
                 self.accepted += 1
@@ -269,8 +323,8 @@ class AdmissionController:
                 self.rejected += 1
                 note("reject")
                 raise AdmissionRejected(tenant, len(mine),
-                                        self.max_inflight,
-                                        self.retry_after_ms)
+                                        self._effective_cap_locked(),
+                                        self._retry_after_locked())
             # park FIFO: tickets order same-tenant waiters
             ticket = self._next_ticket.get(tenant, 0)
             self._next_ticket[tenant] = ticket + 1
@@ -281,7 +335,7 @@ class AdmissionController:
             try:
                 while True:
                     mine = self._inflight.setdefault(tenant, set())
-                    if (len(mine) < self.max_inflight
+                    if (len(mine) < self._effective_cap_locked()
                             and self._turn.get(tenant, 0) == ticket):
                         mine.add(shuffle_id)
                         self.accepted += 1
@@ -292,10 +346,12 @@ class AdmissionController:
                     left = deadline - time.monotonic()
                     if left <= 0:
                         self.rejected += 1
-                        note("reject", self.retry_after_ms)
+                        # trace the SAME fleet-scaled hint the exception
+                        # carries, or dashboards disagree with clients
+                        note("reject", self._retry_after_locked())
                         raise AdmissionRejected(tenant, len(mine),
-                                                self.max_inflight,
-                                                self.retry_after_ms)
+                                                self._effective_cap_locked(),
+                                                self._retry_after_locked())
                     self._cond.wait(min(left, 0.5))
             finally:
                 self._queued[tenant] -= 1
@@ -323,6 +379,8 @@ class AdmissionController:
                 "accepted": self.accepted,
                 "queued_total": self.queued_total,
                 "rejected": self.rejected,
+                "fleet": (self._fleet_live, self._fleet_baseline),
+                "effective_cap": self._effective_cap_locked(),
             }
 
 

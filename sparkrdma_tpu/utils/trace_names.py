@@ -45,6 +45,7 @@ INSTANTS = frozenset({
     "admit.expire",
     "admit.queue",
     "admit.reject",
+    "autoscale.resize",
     "commit.fenced",
     "exchange.degrade",
     "exchange.hierarchical",
@@ -53,6 +54,10 @@ INSTANTS = frozenset({
     "fetch.coalesce_fallback",
     "fetch.merged_fallback",
     "fetch.retry",
+    "member.drain",
+    "member.drain_fallback",
+    "member.join",
+    "member.retire",
     "merge.finalize",
     "meta.epoch_bump",
     "peer.suspect",

@@ -73,6 +73,14 @@ MERGE = os.environ.get("CHAOS_MERGE", "0") not in ("0", "false")
 # run_chaos.sh sweeps both. The dedicated cross-tenant isolation
 # scenarios below assert the blast-radius invariants regardless.
 TENANT = os.environ.get("CHAOS_TENANT", "0") not in ("0", "false")
+# elastic membership under chaos: 1 runs the wide byte-identity
+# matrices with random join/drain CHURN in the background — a fresh
+# executor joins mid-reduce (announce + membership bump + health-watch
+# registration cross every injected fault) and is then gracefully
+# decommissioned — so the elastic control plane sees the whole fault
+# matrix; run_chaos.sh sweeps both. The dedicated scale-up/drain-down
+# acceptance scenarios below run regardless.
+ELASTIC = os.environ.get("CHAOS_ELASTIC", "0") not in ("0", "false")
 # CHAOS_LOCKGRAPH=1: run every scenario under the lock-order shim
 # (sparkrdma_tpu/analysis/lockgraph.py) so the chaos matrix doubles as
 # race detection — faults drive the rare teardown/retry/suspect paths
@@ -163,6 +171,42 @@ def _shutdown(driver, execs):
     for ex in execs:
         ex.stop()
     driver.stop()
+
+
+class _ElasticChurn:
+    """CHAOS_ELASTIC background churn: one executor JOINS mid-scenario
+    (announce, membership bump, health-watch registration, placement
+    recompute) and is then gracefully DECOMMISSIONED — so every fault
+    in the matrix also crosses the elastic control plane. The churner
+    owns no shuffle data, so the drain is coverage-trivial and the
+    scenario's byte-identity assertions are untouched."""
+
+    def __init__(self, conf, driver, tmp_path):
+        self._conf = conf
+        self._driver = driver
+        self._dir = str(tmp_path / "churn")
+        self._joiner = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="elastic-churn")
+        self._thread.start()
+
+    def _run(self):
+        try:
+            self._joiner = TpuShuffleManager(
+                self._conf, driver_addr=self._driver.driver_addr,
+                executor_id="churn", spill_dir=self._dir)
+            self._joiner.join_cluster()
+            slot = self._joiner.executor.exec_index(timeout=5)
+            time.sleep(0.15)  # let the scenario's reduce overlap the join
+            self._driver.driver.decommission_slot(slot, deadline_ms=5000)
+        except Exception:  # noqa: BLE001 — churn must never fail the
+            # scenario; its own assertions live in the dedicated tests
+            pass
+
+    def stop(self):
+        self._thread.join(timeout=10)
+        if self._joiner is not None:
+            self._joiner.stop()
 
 
 # -- tier-1 chaos scenarios (fast, deterministic counts) -----------------
@@ -1027,6 +1071,7 @@ def test_chaos_matrix(tmp_path, scenario):
     driver, execs = _cluster(tmp_path, shuffle_read_block_size=1024,
                              read_ahead_depth=4)
     injector = FaultInjector(seed=SEED)
+    churn = None
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=8,
                                          partitioner=PartitionerSpec("modulo"))
@@ -1035,6 +1080,8 @@ def test_chaos_matrix(tmp_path, scenario):
                        execs[2].executor.manager_id.rpc_port)
         injector.install_endpoint(execs[0].executor)
         _scenario_faults(scenario, injector, victim_addr)
+        if ELASTIC:
+            churn = _ElasticChurn(driver.conf, driver, tmp_path)
 
         got = run_reduce_with_retry(execs, handle, _map_fn_big, _reduce_fn,
                                     reducer_index=0, max_stage_retries=3,
@@ -1044,6 +1091,8 @@ def test_chaos_matrix(tmp_path, scenario):
             err_msg=f"scenario={scenario} seed={SEED}")
     finally:
         injector.uninstall()
+        if churn is not None:
+            churn.stop()
         _shutdown(driver, execs)
 
 
@@ -1095,6 +1144,7 @@ def test_chaos_disk_matrix(tmp_path, scenario):
         spill_retry_budget=2, at_rest_checksum=True)
     injector = StorageFaultInjector(seed=SEED)
     injector.install()
+    churn = None
     try:
         deterministic = _disk_faults(scenario, injector)
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
@@ -1102,6 +1152,8 @@ def test_chaos_disk_matrix(tmp_path, scenario):
         # the map stage runs UNDER the faults: spill retries, fallback
         # dirs, and WriteFailedError re-placement all exercise here
         run_map_stage(execs, handle, _map_fn)
+        if ELASTIC:
+            churn = _ElasticChurn(driver.conf, driver, tmp_path)
         got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
                                     reducer_index=0, max_stage_retries=3,
                                     driver=driver)
@@ -1118,6 +1170,8 @@ def test_chaos_disk_matrix(tmp_path, scenario):
             f"scenario={scenario} seed={SEED}: leaked {leftovers}"
     finally:
         injector.uninstall()
+        if churn is not None:
+            churn.stop()
         _shutdown(driver, execs)
 
 
@@ -1147,4 +1201,169 @@ def test_chaos_disk_total_failure_is_clean(tmp_path):
         assert leftovers == [], f"seed={SEED}: leaked {leftovers}"
     finally:
         injector.uninstall()
+        _shutdown(driver, execs)
+
+
+# -- elastic membership: the ROADMAP item 2 acceptance scenarios ----------
+#
+# A job starts on 4 executors, SCALES TO 8 mid-job (the planner places
+# new maps on the joiners), DRAINS BACK TO 4 mid-reduce-stage, and the
+# final output is byte-identical to the static-membership run with ZERO
+# map re-executions on the planned drains (recovery.repoint-style
+# accounting: the drained maps serve from merged replicas). A drainee
+# dying mid-drain falls back to ordinary tombstone recovery and still
+# completes byte-identically.
+
+
+def _elastic_map_fn(counter):
+    def map_fn(writer, map_id):
+        counter[map_id] = counter.get(map_id, 0) + 1
+        rng = np.random.default_rng(6000 + map_id)
+        writer.write_batch(rng.integers(0, 9000, 300).astype(np.uint64))
+    return map_fn
+
+
+def _elastic_expected(num_maps):
+    return np.sort(np.concatenate(
+        [np.random.default_rng(6000 + m).integers(0, 9000, 300)
+         for m in range(num_maps)]).astype(np.uint64))
+
+
+def test_chaos_elastic_scale_up_drain_down_byte_identical(tmp_path):
+    """4 -> 8 -> 4 with zero re-executions on the planned drains."""
+    conf = _conf(push_merge=True, merge_replicas=2,
+                 drain_deadline_ms=15000)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(4)]
+    for ex in execs:
+        ex.executor.wait_for_members(4)
+    joiners = []
+    try:
+        num_maps, num_parts = 8, 6
+        handle = driver.register_shuffle(
+            1, num_maps=num_maps, num_partitions=num_parts,
+            partitioner=PartitionerSpec("modulo"))
+        counter = {}
+        map_fn = _elastic_map_fn(counter)
+
+        # SCALE UP: 4 joiners announce mid-job; the map stage then
+        # places work across all 8 (joiners included)
+        for j in range(4):
+            joiner = TpuShuffleManager(
+                conf, driver_addr=driver.driver_addr,
+                executor_id=f"j{j}", spill_dir=str(tmp_path / f"j{j}"))
+            joiner.join_cluster()
+            joiners.append(joiner)
+        all_execs = execs + joiners
+        for ex in all_execs:
+            ex.executor.wait_for_members(8)
+        assert len(driver.driver.membership.live_slots()) == 8
+        ran = run_map_stage(all_execs, handle, map_fn)
+        joiner_slots = sorted(
+            j.executor.exec_index(timeout=2) for j in joiners)
+        placed_on_joiners = [m for m, i in ran.items() if i >= 4]
+        assert placed_on_joiners, "planner never placed on the joiners"
+        for ex in all_execs:
+            assert ex.pusher.drain(timeout=15)
+
+        # mid-reduce-stage: read HALF the partitions on the full fleet
+        first = _reduce_keys(all_execs[0], handle, 0, num_parts // 2)
+
+        # DRAIN DOWN: gracefully decommission all 4 joiners — planned
+        # retires, ZERO re-executions (the repoint accounting)
+        for slot in sorted(joiner_slots, reverse=True):
+            res = driver.driver.decommission_slot(slot)
+            assert res["status"] == "drained", \
+                f"seed={SEED} drain of slot {slot}: {res}"
+        assert driver.driver.drains_completed == 4
+        assert driver.driver.drain_fallbacks == 0
+        for j in joiners:
+            j.stop()
+        joiners_alive = []
+
+        # finish the stage on the shrunk fleet; retry envelope covers
+        # any straggler still holding pre-drain cached locations
+        def rest_fn(mgr, h):
+            return _reduce_keys(mgr, h, num_parts // 2, num_parts)
+
+        rest = run_reduce_with_retry(execs, handle, map_fn, rest_fn,
+                                     reducer_index=0,
+                                     max_stage_retries=3, driver=driver)
+        got = np.sort(np.concatenate([first, rest]))
+        np.testing.assert_array_equal(
+            got, _elastic_expected(num_maps),
+            err_msg=f"seed={SEED}: elastic run diverged from the "
+                    "static-membership ground truth")
+        assert sum(counter.values()) == num_maps, \
+            (f"seed={SEED}: planned drains re-executed maps: {counter} "
+             f"(joiner-placed: {placed_on_joiners})")
+        joiners = joiners_alive
+    finally:
+        for j in joiners:
+            j.stop()
+        _shutdown(driver, execs)
+
+
+def _reduce_keys(mgr, handle, start, end):
+    keys, _ = mgr.get_reader(handle, start, end).read_all()
+    return keys
+
+
+def test_chaos_elastic_drainee_death_mid_drain_falls_back(tmp_path):
+    """The drainee dies MID-drain (after DrainReq lands, before its
+    replication pass answers): the decommission falls back to ordinary
+    tombstone recovery, the reduce re-executes the lost maps, and the
+    output stays byte-identical."""
+    conf = _conf(push_merge=False)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(3)]
+    for ex in execs:
+        ex.executor.wait_for_members(3)
+    try:
+        num_maps = 6
+        handle = driver.register_shuffle(
+            1, num_maps=num_maps, num_partitions=4,
+            partitioner=PartitionerSpec("modulo"))
+        counter = {}
+        map_fn = _elastic_map_fn(counter)
+        ran = run_map_stage(execs, handle, map_fn)
+        victim = execs[2]
+        victim_slot = victim.executor.exec_index(timeout=2)
+        owned = [m for m, i in ran.items() if i == 2]
+        assert owned
+
+        # die mid-drain: the DrainReq handler kills the executor's
+        # servers instead of replicating, so no DrainResp ever arrives
+        orig = victim.executor._drain_replicate
+
+        def die_mid_drain(deadline):
+            victim.executor.stop()
+            if victim.block_server is not None:
+                victim.block_server.stop()
+            raise RuntimeError("drainee died mid-drain")
+
+        victim.executor._drain_replicate = die_mid_drain
+        res = driver.driver.decommission_slot(victim_slot,
+                                              deadline_ms=3000)
+        assert res["status"] == "fallback", f"seed={SEED}: {res}"
+        assert driver.driver.drain_fallbacks == 1
+        from sparkrdma_tpu.parallel.membership import SLOT_DEAD
+        assert driver.driver.membership.state_of(victim_slot) == SLOT_DEAD
+
+        got = run_reduce_with_retry(execs[:2], handle, map_fn, _reduce_fn,
+                                    reducer_index=0, max_stage_retries=3,
+                                    driver=driver)
+        np.testing.assert_array_equal(
+            got, _elastic_expected(num_maps),
+            err_msg=f"seed={SEED}: fallback run diverged")
+        # tombstone recovery re-executed exactly the drainee's maps
+        assert sum(counter.values()) == num_maps + len(owned), \
+            f"seed={SEED}: {counter}"
+    finally:
         _shutdown(driver, execs)

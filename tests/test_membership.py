@@ -1,0 +1,523 @@
+"""Elastic executor membership (parallel/membership.py): the
+epoch-versioned membership plane, mid-job join, graceful drain with
+zero re-executions, the autoscaler policy, admission capacity scaling,
+and the mixed-version degrade to static membership."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+# scripts/run_elastic_bench.sh sweeps this: it varies every map task's
+# data so drain/replication/coverage exercise across payloads
+SEED = int(os.environ.get("ELASTIC_SEED", "0"))
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.parallel.membership import (
+    SLOT_DEAD,
+    SLOT_DRAINING,
+    SLOT_LIVE,
+    Autoscaler,
+    MembershipPlane,
+)
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+from sparkrdma_tpu.shuffle.recovery import run_map_stage, run_reduce_with_retry
+from sparkrdma_tpu.shuffle.tenancy import AdmissionController
+
+CONF = dict(connect_timeout_ms=2000, max_connection_attempts=2,
+            pre_warm_connections=False)
+
+
+def _mk_conf(**kw):
+    base = dict(CONF)
+    base.update(kw)
+    return TpuShuffleConf(**base)
+
+
+def _cluster(tmp_path, n=3, tag="e", **kw):
+    conf = _mk_conf(**kw)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=f"{tag}{i}",
+                               spill_dir=str(tmp_path / f"{tag}{i}"))
+             for i in range(n)]
+    for ex in execs:
+        ex.executor.wait_for_members(n)
+    return conf, driver, execs
+
+
+def _shutdown(driver, execs):
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def _map_fn_for(counter):
+    def map_fn(writer, map_id):
+        counter[map_id] = counter.get(map_id, 0) + 1
+        rng = np.random.default_rng(4000 + SEED * 10007 + map_id)
+        writer.write_batch(rng.integers(0, 7000, 400).astype(np.uint64))
+    return map_fn
+
+
+def _expected(num_maps):
+    return np.sort(np.concatenate(
+        [np.random.default_rng(4000 + SEED * 10007 + m)
+         .integers(0, 7000, 400)
+         for m in range(num_maps)]).astype(np.uint64))
+
+
+def _reduce_fn(mgr, handle):
+    keys, _ = mgr.get_reader(handle, 0, handle.num_partitions).read_all()
+    return np.sort(keys)
+
+
+# -- the membership plane (unit) ------------------------------------------
+
+def test_membership_plane_state_machine():
+    from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId
+    plane = MembershipPlane()
+    mids = [ShuffleManagerId(ExecutorId(str(i), "h", 0), "h", 9000 + i, 0)
+            for i in range(3)]
+    epochs = []
+    for mid in mids:
+        *_, epoch, is_new = plane.join(mid)
+        assert is_new
+        epochs.append(epoch)
+    assert epochs == sorted(epochs) and len(set(epochs)) == 3
+    assert plane.live_slots() == [0, 1, 2]
+
+    # re-hello bumps the epoch but appends nothing
+    *_, e2, is_new = plane.join(mids[1])
+    assert not is_new and e2 > epochs[-1]
+    assert plane.live_slots() == [0, 1, 2]
+
+    # drain: live set shrinks, include_draining view doesn't
+    assert plane.begin_drain(1) is not None
+    assert plane.begin_drain(1) is None  # not LIVE anymore
+    assert plane.live_slots() == [0, 2]
+    assert plane.live_slots(include_draining=True) == [0, 1, 2]
+    assert plane.draining_slots() == {1}
+    assert plane.state_of(1) == SLOT_DRAINING
+
+    # abort returns it; retire kills it
+    assert plane.abort_drain(1) is not None
+    assert plane.state_of(1) == SLOT_LIVE
+    assert plane.begin_drain(1) is not None
+    members, states, _ = plane.retire(1)
+    assert states[1] == SLOT_DEAD
+    from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+    assert members[1] == TOMBSTONE
+    assert plane.retire(1) is None  # idempotent
+    # tombstone by identity converges too
+    assert plane.tombstone(mids[1]) is None
+    res = plane.tombstone(mids[0])
+    assert res is not None and res[3] == 0
+    assert plane.live_slots() == [2]
+    assert plane.state_of(99) == SLOT_DEAD  # unknown slot = dead
+
+
+def test_membership_plane_baseline_freezes_once():
+    from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId
+    plane = MembershipPlane()
+    for i in range(4):
+        plane.join(ShuffleManagerId(ExecutorId(str(i), "h", 0), "h",
+                                    9100 + i, 0))
+    assert plane.baseline() == 4  # unfrozen: tracks live
+    assert plane.freeze_baseline() == 4
+    plane.join(ShuffleManagerId(ExecutorId("j", "h", 0), "h", 9200, 0))
+    assert plane.baseline() == 4  # frozen: joins don't move it
+    assert plane.joins == 1      # post-baseline join counted
+
+
+# -- admission capacity from live membership (satellite) ------------------
+
+def test_admission_scales_with_live_membership():
+    adm = AdmissionController(max_inflight=4, queue_depth=0,
+                              retry_after_ms=1000)
+    assert adm.effective_max_inflight() == 4
+    # a drained fleet sheds honestly: cap halves, hint doubles
+    adm.set_fleet(live=2, baseline=4)
+    assert adm.effective_max_inflight() == 2
+    assert adm.effective_retry_after_ms() == 2000
+    # a grown fleet admits more; the hint never shrinks below configured
+    adm.set_fleet(live=8, baseline=4)
+    assert adm.effective_max_inflight() == 8
+    assert adm.effective_retry_after_ms() == 1000
+
+    adm.set_fleet(live=1, baseline=4)
+    for sid in range(1):
+        adm.admit(7, sid)
+    from sparkrdma_tpu.shuffle.tenancy import AdmissionRejected
+    with pytest.raises(AdmissionRejected) as ei:
+        adm.admit(7, 99)
+    assert ei.value.retry_after_ms == 4000
+    assert adm.snapshot()["effective_cap"] == 1
+    # disabled admission stays disabled under any fleet
+    off = AdmissionController(max_inflight=0)
+    off.set_fleet(1, 8)
+    assert off.effective_max_inflight() == 0
+    off.admit(1, 1)  # no-op, no raise
+
+
+# -- autoscaler policy (unit, injected gauges) ----------------------------
+
+class _StubDriver:
+    def __init__(self, conf, live=4):
+        from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId
+        from sparkrdma_tpu.utils import trace as trace_mod
+        self.conf = conf
+        self.membership = MembershipPlane()
+        for i in range(live):
+            self.membership.join(ShuffleManagerId(
+                ExecutorId(str(i), "h", 0), "h", 9300 + i, 0))
+        self.admission = AdmissionController()
+        self.tracer = trace_mod.NULL
+        self.actions = []
+
+    def live_shuffles(self):
+        return []
+
+    def decommission_slot(self, slot, deadline_ms=None):
+        self.actions.append(("drain", slot))
+        self.membership.retire(slot)
+        return {"status": "drained", "slot": slot}
+
+
+def test_autoscaler_policy_up_down_clamped():
+    conf = _mk_conf(min_executors=2, max_executors=6)
+    drv = _StubDriver(conf, live=4)
+    gauges = {"admission_backlog": 0, "queue_depth": 0.0,
+              "reduce_balance": 1.0}
+    spawned = []
+
+    def spawn(n):  # the harness's hook: really grow the fleet
+        from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId
+        spawned.append(n)
+        for k in range(n):
+            drv.membership.join(ShuffleManagerId(
+                ExecutorId(f"s{len(spawned)}-{k}", "h", 0), "h", 9400, 0))
+
+    scaler = Autoscaler(drv, conf, scale_up=spawn, load_fn=lambda: gauges)
+
+    # backlog-driven scale-up, clamped at max_executors
+    gauges["admission_backlog"] = 5
+    assert scaler.tick() == ("up", 2)  # 4 + 5 clamped to 6 => +2
+    assert spawned == [2]
+
+    # busy (deep queue) holds steady
+    gauges["admission_backlog"] = 0
+    gauges["queue_depth"] = 10.0
+    assert scaler.tick() is None
+
+    # idle needs TWO consecutive ticks before the first drain
+    gauges["queue_depth"] = 0.0
+    assert scaler.tick() is None
+    assert scaler.tick() == ("down", 5)  # highest live slot drains first
+    assert drv.actions == [("drain", 5)]
+
+    # skew (reduce_balance) is a scale-up signal
+    gauges["reduce_balance"] = 3.0
+    assert scaler.tick() == ("up", 1)
+    gauges["reduce_balance"] = 1.0
+
+    # the floor holds: drain down to min_executors, never below
+    for _ in range(10):
+        scaler.tick()
+        scaler.tick()
+    assert len(drv.membership.live_slots()) >= conf.min_executors
+
+
+def test_autoscaler_unbounded_ceiling_scales_up():
+    """max_executors=0 means UNBOUNDED (the config contract): a backlog
+    on the default config must still grow the fleet — the ceiling must
+    not collapse to the current live count."""
+    conf = _mk_conf()  # min_executors=0, max_executors=0 (defaults)
+    drv = _StubDriver(conf, live=3)
+    spawned = []
+    scaler = Autoscaler(drv, conf, scale_up=lambda n: spawned.append(n),
+                        load_fn=lambda: {"admission_backlog": 4})
+    assert scaler.tick() == ("up", 4)
+    assert spawned == [4]
+
+
+# -- wire messages (satellite: fuzz conventions + legacy decode) ----------
+
+def test_membership_wire_roundtrip_and_legacy():
+    m = M.MembershipBumpMsg(9, [SLOT_LIVE, SLOT_DRAINING, SLOT_DEAD])
+    m2 = M.MembershipBumpMsg.from_payload(m.payload())
+    assert (m2.epoch, m2.slot_states) == (9, [0, 1, 2])
+    # epoch-only legacy payload (pre-elastic peer): empty vector
+    import struct
+    legacy = M.MembershipBumpMsg.from_payload(struct.pack("<q", 9))
+    assert legacy.epoch == 9 and legacy.slot_states == []
+
+    d = M.DrainReq(5, 2, 1234)
+    d2 = M.DrainReq.from_payload(d.payload())
+    assert (d2.req_id, d2.slot, d2.deadline_ms) == (5, 2, 1234)
+    assert M.DrainReq.from_payload(
+        struct.pack("<qi", 5, 2)).deadline_ms == 0
+
+    r = M.DrainResp(5, M.STATUS_OK, 7, 4096)
+    r2 = M.DrainResp.from_payload(r.payload())
+    assert (r2.maps_pushed, r2.bytes_pushed) == (7, 4096)
+
+    from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId
+    mid = ShuffleManagerId(ExecutorId("x", "h", 1), "h", 9999, 7)
+    j = M.JoinMsg(mid, 0)
+    j2 = M.JoinMsg.from_payload(j.payload())
+    assert j2.manager_id == mid and j2.flags == 0
+    # the hello-shaped (flag-less) prefix decodes too
+    assert M.JoinMsg.from_payload(j.payload()[:-4]).manager_id == mid
+
+
+# -- mid-job join (e2e) ---------------------------------------------------
+
+def test_join_mid_job_bump_states_and_health_watch(tmp_path):
+    """A joiner announced mid-job: the membership bump teaches every
+    peer the slot-state vector AND registers the joiner with the
+    heartbeat monitor (satellite: previously a joiner was watched only
+    once a fetch took interest, so its silent death surfaced only as a
+    failed fetch)."""
+    conf, driver, execs = _cluster(tmp_path, n=2,
+                                   heartbeat_interval_ms=100)
+    joiner = None
+    try:
+        handle = driver.register_shuffle(
+            1, num_maps=2, num_partitions=2,
+            partitioner=PartitionerSpec("modulo"))
+        counter = {}
+        run_map_stage(execs, handle, _map_fn_for(counter))
+
+        joiner = TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                                   executor_id="j",
+                                   spill_dir=str(tmp_path / "j"))
+        joiner.join_cluster()
+        joiner.executor.wait_for_members(3)
+        assert len(driver.driver.members()) == 3
+        assert driver.driver.membership.live_slots() == [0, 1, 2]
+        assert driver.driver.membership.joins >= 0
+
+        # the bump reaches existing peers: state vector cached, joiner
+        # slot registered with the health monitor
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            epoch, states = execs[0].executor.location_plane.membership()
+            snap = execs[0].executor.health_snapshot()
+            if len(states) == 3 and 2 in snap["watched"]:
+                break
+            time.sleep(0.02)
+        epoch, states = execs[0].executor.location_plane.membership()
+        assert list(states) == [SLOT_LIVE] * 3
+        assert 2 in execs[0].executor.health_snapshot()["watched"]
+
+        # the joiner serves reads (stage completes across 3 members)
+        got = _reduce_fn(execs[0], handle)
+        np.testing.assert_array_equal(got, _expected(2))
+    finally:
+        if joiner is not None:
+            joiner.stop()
+        _shutdown(driver, execs)
+
+
+# -- graceful drain (e2e) -------------------------------------------------
+
+def test_drain_zero_reexecutions(tmp_path):
+    """Decommission an executor that owns committed maps: push-merge
+    replication + re-point means the reduce completes byte-identically
+    with ZERO map re-executions, and the drain result says 'drained'."""
+    conf, driver, execs = _cluster(tmp_path, n=3, push_merge=True,
+                                   merge_replicas=1)
+    try:
+        handle = driver.register_shuffle(
+            2, num_maps=6, num_partitions=4,
+            partitioner=PartitionerSpec("modulo"))
+        counter = {}
+        map_fn = _map_fn_for(counter)
+        ran = run_map_stage(execs, handle, map_fn)
+        assert sum(counter.values()) == 6
+        # background pushes land before the drain begins (determinism)
+        for ex in execs:
+            assert ex.pusher.drain(timeout=10)
+
+        victim_slot = execs[2].executor.exec_index(timeout=2)
+        res = driver.decommission_slot(victim_slot)
+        assert res["status"] == "drained", res
+        assert res["unservable"] == []
+        assert driver.driver.drains_completed == 1
+        assert driver.driver.drain_fallbacks == 0
+        # the drainee owned maps; they re-point, not re-execute
+        owned = [m for m, s in ran.items() if s == 2]
+        assert res["repointed"] >= len(owned) > 0
+        # membership: slot dead, announce converged
+        assert driver.driver.membership.state_of(victim_slot) == SLOT_DEAD
+
+        # the drainee may now be stopped entirely; reads stay complete
+        execs[2].stop()
+        got = run_reduce_with_retry(execs[:2], handle, map_fn, _reduce_fn,
+                                    reducer_index=0, max_stage_retries=2,
+                                    driver=driver)
+        np.testing.assert_array_equal(got, _expected(6))
+        assert sum(counter.values()) == 6, \
+            f"re-executions after a clean drain: {counter}"
+    finally:
+        _shutdown(driver, execs[:2])
+
+
+def test_drain_dead_drainee_falls_back_to_tombstone(tmp_path):
+    """The drainee dies before the drain: the decommission FALLS BACK
+    to ordinary tombstone recovery — the slot still retires, reducers
+    re-execute the lost maps, output stays byte-identical."""
+    conf, driver, execs = _cluster(tmp_path, n=3)  # push_merge OFF
+    try:
+        handle = driver.register_shuffle(
+            3, num_maps=6, num_partitions=4,
+            partitioner=PartitionerSpec("modulo"))
+        counter = {}
+        map_fn = _map_fn_for(counter)
+        ran = run_map_stage(execs, handle, map_fn)
+        owned = [m for m, s in ran.items() if s == 2]
+        assert owned
+
+        victim_slot = execs[2].executor.exec_index(timeout=2)
+        execs[2].stop()  # dies mid-drain (before the DrainReq lands)
+        res = driver.decommission_slot(victim_slot, deadline_ms=1500)
+        assert res["status"] == "fallback", res
+        assert driver.driver.drain_fallbacks == 1
+        assert driver.driver.membership.state_of(victim_slot) == SLOT_DEAD
+
+        got = run_reduce_with_retry(execs[:2], handle, map_fn, _reduce_fn,
+                                    reducer_index=0, max_stage_retries=2,
+                                    driver=driver)
+        np.testing.assert_array_equal(got, _expected(6))
+        # the fallback path re-executed exactly the drainee's maps
+        assert sum(counter.values()) == 6 + len(owned)
+    finally:
+        _shutdown(driver, execs[:2])
+
+
+def test_abort_drain_rebroadcasts_live_state(tmp_path):
+    """The operator-facing abort: DRAINING -> LIVE is BROADCAST (a
+    silent revert would leave peers treating the slot as draining
+    forever) and admission capacity is restored."""
+    conf, driver, execs = _cluster(tmp_path, n=3)
+    try:
+        drv = driver.driver
+        drv.membership.freeze_baseline()
+        assert drv.membership.begin_drain(2) is not None
+        drv.publish_membership(*drv.membership.snapshot())
+        assert drv.abort_drain(2)
+        assert not drv.abort_drain(2)  # not DRAINING anymore: no-op
+        assert drv.membership.live_slots() == [0, 1, 2]
+        assert drv.admission.snapshot()["fleet"] == (3, 3)
+        # peers converge back to an all-LIVE state vector
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            _, states = execs[0].executor.location_plane.membership()
+            if list(states) == [SLOT_LIVE] * 3:
+                break
+            time.sleep(0.02)
+        assert list(states) == [SLOT_LIVE] * 3
+        assert not execs[0].executor.slot_draining(2)
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_draining_slot_takes_no_new_maps(tmp_path):
+    """While a slot is DRAINING, run_map_stage steers new maps away
+    from it (the membership-aware exclude), and the driver's planner
+    inputs mark it avoided."""
+    conf, driver, execs = _cluster(tmp_path, n=3)
+    try:
+        assert driver.driver.membership.begin_drain(2) is not None
+        handle = driver.register_shuffle(
+            4, num_maps=6, num_partitions=3,
+            partitioner=PartitionerSpec("modulo"))
+        counter = {}
+        ran = run_map_stage(execs, handle, _map_fn_for(counter),
+                            exclude_slots=driver.driver.membership
+                            .draining_slots())
+        assert all(slot != 2 for slot in ran.values()), ran
+        got = _reduce_fn(execs[0], handle)
+        np.testing.assert_array_equal(got, _expected(6))
+    finally:
+        _shutdown(driver, execs)
+
+
+# -- bench acceptance -----------------------------------------------------
+
+def test_elastic_microbench_acceptance(tmp_path):
+    """The drain-vs-kill A/B's tier-1 gates (bench.py's
+    ``drain_zero_reexec`` secondary): byte-identical both arms, ZERO
+    re-executions on the planned drain, and a real re-execution bill on
+    the unplanned kill of the same slot."""
+    from sparkrdma_tpu.shuffle.elastic_bench import run_elastic_microbench
+
+    res = run_elastic_microbench(str(tmp_path), seed=SEED)
+    assert res["identical"]
+    assert res["drain_status"] == "drained", res
+    assert res["reexec_drain"] == 0, res
+    assert res["reexec_kill"] == res["victim_owned_maps"] > 0, res
+
+
+# -- mixed-version degrade ------------------------------------------------
+
+def test_old_peer_ignoring_elastic_frames_degrades_static(tmp_path):
+    """A pre-elastic peer drops the membership-bump/drain frames it
+    doesn't know (its transport would tear the connection; dropping is
+    the conservative stand-in). It keeps the announce-only static view
+    — no state vector, every slot LIVE — and jobs still complete:
+    elastic frames are strictly additive."""
+    conf, driver, execs = _cluster(tmp_path, n=2)
+    joiner = None
+    try:
+        old = execs[1].executor
+        orig_handle = old._handle
+
+        def dropping_handle(conn, msg):
+            if isinstance(msg, (M.MembershipBumpMsg, M.DrainReq)):
+                return None  # "unknown frame" on a pre-elastic peer
+            return orig_handle(conn, msg)
+
+        old._handle = dropping_handle
+        # re-point the live server dispatch at the wrapper — including
+        # connections the driver ALREADY accepted (the broadcast channel
+        # the bump rides was dialed at cluster start)
+        old.server._handler = dropping_handle
+        with old.server._conns_lock:
+            for c in old.server._conns:
+                c._on_message = dropping_handle
+        # forget any bump that raced in before the patch: a genuinely
+        # pre-elastic peer never held a state vector at all
+        with old.location_plane._lock:
+            old.location_plane._member_epoch = -1
+            old.location_plane._member_states = ()
+
+        handle = driver.register_shuffle(
+            5, num_maps=4, num_partitions=2,
+            partitioner=PartitionerSpec("modulo"))
+        counter = {}
+        run_map_stage(execs, handle, _map_fn_for(counter))
+
+        joiner = TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                                   executor_id="j2",
+                                   spill_dir=str(tmp_path / "j2"))
+        joiner.join_cluster()
+        joiner.executor.wait_for_members(3)
+        time.sleep(0.3)  # let the (dropped) bump traffic settle
+
+        # the old peer saw the ANNOUNCE (members grew) but no states
+        assert len(old.members()) == 3
+        _, states = old.location_plane.membership()
+        assert states == ()  # static view: everything reads LIVE
+        assert not old.slot_draining(0)
+
+        got = _reduce_fn(execs[1], handle)  # reads through the old peer
+        np.testing.assert_array_equal(got, _expected(4))
+    finally:
+        if joiner is not None:
+            joiner.stop()
+        _shutdown(driver, execs)
