@@ -2,32 +2,57 @@
 # Invariant-analyzer sweep (sparkrdma_tpu/analysis/ — see docs/ANALYSIS.md).
 #
 #   scripts/run_analysis.sh               static passes + analyzer tests
+#   scripts/run_analysis.sh --model-check ... with the distributed-invariant
+#                                         model checker (schedule enumeration;
+#                                         violating traces dump under
+#                                         .analysis_traces/ for --replay).
+#                                         Budget knobs: MODELCHECK_SCHEDULES
+#                                         (DFS cap per scenario, default 256),
+#                                         MODELCHECK_DEPTH, MODELCHECK_WALKS —
+#                                         the defaults fit the tier-1 time box;
+#                                         raise MODELCHECK_SCHEDULES for an
+#                                         exhaustive overnight sweep.
+#   scripts/run_analysis.sh --replay <trace.json>
+#                                         re-run one dumped violating schedule
+#                                         byte-identically (exit 1 = violation
+#                                         reproduced, 2 = trace diverged)
 #   scripts/run_analysis.sh --sanitize    ... + ASan/UBSan native harness
 #                                         (builds instrumented .so's)
 #   scripts/run_analysis.sh --lockgraph   ... + the WHOLE tier-1 suite under
 #                                         the lock-order shim (exit 3 on any
 #                                         lock-order cycle)
-#   scripts/run_analysis.sh --all         everything above
+#   scripts/run_analysis.sh --all         everything above (incl. model check)
 #
-# The fast subset (static passes + tests/test_analysis.py) is what tier-1
-# already runs; this script exists for the gated extras and for running
-# the sweep standalone in CI.
+# The fast subset (static passes + tests/test_analysis.py, which runs the
+# model-check catalog too) is what tier-1 already runs; this script exists
+# for the gated extras and for running the sweep standalone in CI.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-SANITIZE=0; LOCKGRAPH=0
-for arg in "$@"; do
-  case "$arg" in
+SANITIZE=0; LOCKGRAPH=0; MODELCHECK=0
+args=("$@")
+for i in "${!args[@]}"; do
+  case "${args[$i]}" in
     --sanitize) SANITIZE=1 ;;
     --lockgraph) LOCKGRAPH=1 ;;
-    --all) SANITIZE=1; LOCKGRAPH=1 ;;
-    *) echo "unknown arg: $arg" >&2; exit 2 ;;
+    --model-check) MODELCHECK=1 ;;
+    --replay)
+      trace="${args[$((i+1))]:?--replay needs a trace file}"
+      exec env JAX_PLATFORMS=cpu python -m sparkrdma_tpu.analysis \
+        --replay "$trace" ;;
+    --all) SANITIZE=1; LOCKGRAPH=1; MODELCHECK=1 ;;
+    *) echo "unknown arg: ${args[$i]}" >&2; exit 2 ;;
   esac
 done
 [[ "${RUN_SANITIZERS:-0}" == "1" ]] && SANITIZE=1
 
-echo "== static passes: wire / concurrency / drift =="
-JAX_PLATFORMS=cpu python -m sparkrdma_tpu.analysis
+if [[ "$MODELCHECK" == "1" ]]; then
+  echo "== static passes + model checker =="
+  JAX_PLATFORMS=cpu python -m sparkrdma_tpu.analysis --model-check
+else
+  echo "== static passes: wire / concurrency / drift / resources =="
+  JAX_PLATFORMS=cpu python -m sparkrdma_tpu.analysis
+fi
 
 echo "== analyzer self-tests (fixtures + lockgraph e2e) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q \

@@ -24,12 +24,27 @@ machine-checks as part of tier-1:
                     names vs the generated registry
                     (utils/trace_names.py), metrics fields read by tests
                     vs fields the stats classes declare.
+* ``resources``   — resource-contract lints: ledger charge/release
+                    pairing (all-paths release or a reasoned
+                    ``leak-ok`` ownership-transfer pragma) and the
+                    epoch/fence comparison discipline (monotone guards
+                    only; exact-match sites carry ``epoch-eq-ok``).
+                    Both audit their own pragmas for staleness.
+* ``modelcheck``  — distributed-invariant model checker: the protocol
+                    race scenarios (publish vs tombstone vs bump, fence
+                    loser-commits-late, finalize-beats-first-push,
+                    drain vs kill, TTL vs late fetch) run over the real
+                    protocol classes under systematically enumerated
+                    delivery orders (``scheduler.py``: DFS + partial-
+                    order reduction, seeded walks, exact ``--replay``),
+                    with safety invariants checked after every step.
 * ``native_harness`` — ASan/UBSan exercises for csrc (gated; see
                     ``make -C csrc asan ubsan`` + scripts/run_analysis.sh).
 
-Run everything (passes 1-3, the fast tier-1 subset) with::
+Run everything (the fast tier-1 subset) with::
 
     python -m sparkrdma_tpu.analysis
+    python -m sparkrdma_tpu.analysis --model-check   # + the scheduler sweep
 
 Findings print as ``path:line: [pass] message`` and exit non-zero.
 Heuristic passes honor suppression pragmas — see docs/ANALYSIS.md.
@@ -39,13 +54,14 @@ from sparkrdma_tpu.analysis.core import Finding, repo_root  # noqa: F401
 
 
 def run_all(root=None):
-    """Run the static passes (wire, concurrency lints, drift) over the
-    live tree; returns the combined finding list."""
-    from sparkrdma_tpu.analysis import concurrency, drift, wire
+    """Run the static passes (wire, concurrency lints, drift, resource
+    contracts) over the live tree; returns the combined finding list."""
+    from sparkrdma_tpu.analysis import concurrency, drift, resources, wire
 
     root = root or repo_root()
     findings = []
     findings += wire.run(root)
     findings += concurrency.run(root)
     findings += drift.run(root)
+    findings += resources.run(root)
     return findings

@@ -23,6 +23,11 @@ Two AST heuristics, each silenced per line by a reasoned pragma
 The module list is explicit (``THREADED_MODULES``) — these are the
 files where more than one thread runs; applying the heuristics to
 pure single-threaded modules would only breed pragmas.
+
+Pragmas are audited for staleness: an ``unguarded-ok``/``wait-ok`` on
+a line the lint no longer flags is itself a finding
+(``core.audit_stale_pragmas``) — dead pragmas drift behind refactors
+and then document hazards that no longer exist.
 """
 
 from __future__ import annotations
@@ -31,8 +36,9 @@ import ast
 import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from sparkrdma_tpu.analysis.core import (Finding, collect_pragmas, rel,
-                                         repo_root, suppressed)
+from sparkrdma_tpu.analysis.core import (Finding, audit_stale_pragmas,
+                                         collect_pragmas, rel, repo_root,
+                                         suppressed)
 
 PASS = "concurrency"
 
@@ -236,8 +242,12 @@ def _class_locks(cls: ast.ClassDef) -> Tuple[Set[str], Set[str]]:
 
 
 def scan_source(source: str, relpath: str) -> List[Finding]:
-    """All concurrency lints over one module's source."""
+    """All concurrency lints over one module's source. Pragma
+    suppressions are tracked: one that silences nothing is STALE and a
+    finding itself (dead pragmas drift behind refactors and then
+    document hazards that no longer exist)."""
     pragmas, findings = collect_pragmas(source, relpath)
+    used: set = set()
     tree = ast.parse(source)
     for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
         locks, conditions = _class_locks(cls)
@@ -251,6 +261,7 @@ def scan_source(source: str, relpath: str) -> List[Finding]:
             if in_init or attr not in shared:
                 continue
             if suppressed(pragmas, line, "unguarded"):
+                used.add((line, "unguarded"))
                 continue
             findings.append(Finding(
                 PASS, relpath, line,
@@ -258,20 +269,25 @@ def scan_source(source: str, relpath: str) -> List[Finding]:
                 f"here outside any 'with <lock>' block "
                 f"(# analysis: unguarded-ok(reason) if intentional)"))
         for cond, line, in_while, has_timeout in scan.waits:
+            if in_while and has_timeout:
+                continue  # compliant: a pragma here would be dead
             if suppressed(pragmas, line, "wait"):
+                used.add((line, "wait"))
                 continue
             if not in_while:
                 findings.append(Finding(
                     PASS, relpath, line,
                     f"{cls.name}: {cond}.wait() outside a 'while' "
                     f"predicate loop — spurious/stolen wakeups break it"))
-            elif not has_timeout:
+            else:
                 findings.append(Finding(
                     PASS, relpath, line,
                     f"{cls.name}: {cond}.wait() without a deadline — a "
                     f"lost notify hangs forever "
                     f"(# analysis: wait-ok(reason) if the wake is "
                     f"guaranteed)"))
+    findings += audit_stale_pragmas(source, relpath,
+                                    {"unguarded", "wait"}, used)
     return findings
 
 

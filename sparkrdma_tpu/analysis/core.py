@@ -90,6 +90,34 @@ def suppressed(pragmas: Dict[int, List[str]], line: int, rule: str) -> bool:
     return rule in pragmas.get(line, ())
 
 
+_PRAGMA_SITE_RE = re.compile(r"#\s*analysis:\s*([a-z-]+)-ok\(")
+
+
+def audit_stale_pragmas(source: str, path: str, rules,
+                        used) -> List[Finding]:
+    """A pragma for one of ``rules`` that suppressed nothing is itself
+    a finding: the refactor that made the lint stop firing should have
+    deleted the pragma with it (a stale pragma documents a hazard that
+    no longer exists — worse than no comment). ``used`` is the set of
+    ``(line, rule)`` suppressions the pass actually consumed; an
+    own-line pragma counts as used if either line it covers did."""
+    findings: List[Finding] = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        for m in _PRAGMA_SITE_RE.finditer(text):
+            rule = m.group(1)
+            if rule not in rules:
+                continue
+            own_line = text.lstrip().startswith("#")
+            lines = (i, i + 1) if own_line else (i,)
+            if not any((ln, rule) in used for ln in lines):
+                findings.append(Finding(
+                    "pragma", path, i,
+                    f"stale pragma '{rule}-ok': the lint no longer "
+                    f"flags this line — delete the pragma (it claims "
+                    f"a hazard that is gone)"))
+    return findings
+
+
 def format_report(findings: List[Finding]) -> str:
     if not findings:
         return "analysis: clean (0 findings)"

@@ -1,0 +1,647 @@
+"""Pass 5 — distributed-invariant model checker.
+
+The one-sided design's correctness lives in protocol invariants, not
+request/reply pairing (PAPER §0-1; "RPC Considered Harmful",
+PAPERS.md): epoch-stamped location/plan/membership state and fence-CAS
+commits must stay consistent under ANY message-delivery order. This
+pass runs the small cluster scenarios those invariants protect —
+publish vs tombstone vs epoch-bump, fence loser-commits-late,
+finalize-beats-first-push, drain vs concurrent kill, TTL-sweep vs late
+fetch — over the REAL protocol classes (``LocationPlane``,
+``DriverTable``, ``MembershipPlane``, ``MergedDirectory``,
+``TenantLedger``), under systematically enumerated schedules
+(``analysis/scheduler.py``: bounded DFS with partial-order reduction,
+plus seeded random walks), asserting the machine-checked safety
+invariants after every fired step:
+
+* **epoch-monotone** — per observer, the observed location / plan /
+  membership epochs never regress, and a DEAD shuffle stays dead: no
+  cached view (table, locations, merged directory, plan) may serve
+  at-or-after the observer processed its ``EPOCH_DEAD``.
+* **fence-winner** — the driver-table commit CAS admits one winner per
+  (map, executor): once fence f applied, no publish with fence < f
+  from the same executor may apply (zombie speculative attempts).
+* **no-dead-location** — no observer-cached table stamped at-or-after
+  a slot's tombstone epoch names the DEAD slot.
+* **merged-live** — the driver's merged directory holds at most one
+  entry per (partition, slot) and never an entry naming a tombstoned
+  slot (zombie finalize publishes).
+* **member-legal** — driver membership transitions follow
+  LIVE→DRAINING, DRAINING→LIVE, {LIVE,DRAINING}→DEAD only; DEAD is
+  terminal; the membership epoch strictly increases with every vector
+  change.
+* **ledger-conserve** — per tenant, TenantLedger usage equals charges
+  minus releases of live state exactly (a double-release or a leaked
+  charge breaks the equality) and is never negative.
+
+Driver-side glue that lives inside ``parallel/endpoints.py`` (tombstone
+→ directory prune + epoch bump; merged-publish admission) is mirrored
+here as small ``World`` methods with the mirrored call sites named, so
+the checked semantics track the production ones; everything below that
+glue is the production class itself.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from sparkrdma_tpu.analysis.core import Finding, rel, repo_root
+from sparkrdma_tpu.analysis.scheduler import (Run, VirtualScheduler,
+                                              explore_dfs, random_walks,
+                                              replay)
+from sparkrdma_tpu.shuffle.location_plane import EPOCH_DEAD, LocationPlane
+from sparkrdma_tpu.shuffle.map_output import DriverTable
+from sparkrdma_tpu.shuffle.push_merge import MergedDirectory, MergedEntry
+from sparkrdma_tpu.shuffle.tenancy import TenantLedger
+from sparkrdma_tpu.parallel.membership import (SLOT_DEAD, SLOT_DRAINING,
+                                               SLOT_LIVE, MembershipPlane)
+from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId
+
+PASS = "modelcheck"
+
+_LEGAL_MEMBER_STEPS = {
+    (SLOT_LIVE, SLOT_DRAINING),
+    (SLOT_DRAINING, SLOT_LIVE),
+    (SLOT_LIVE, SLOT_DEAD),
+    (SLOT_DRAINING, SLOT_DEAD),
+}
+
+
+def _mid(i: int) -> ShuffleManagerId:
+    return ShuffleManagerId(ExecutorId(str(i), f"mc{i}", 7000 + i),
+                            f"mc{i}", 9000 + i, i)
+
+
+class World:
+    """One scenario's cluster state: real protocol components plus the
+    bookkeeping the invariants compare against."""
+
+    def __init__(self, num_observers: int = 2, num_maps: int = 2,
+                 sid: int = 7):
+        self.sid = sid
+        self.num_maps = num_maps
+        self.table = DriverTable(num_maps)
+        self.epochs: Dict[int, int] = {sid: 1}
+        self.merged = MergedDirectory()
+        self.tombstone_sentinel = object()
+        self.membership = MembershipPlane(
+            tombstone=self.tombstone_sentinel)
+        self.observers = [LocationPlane() for _ in range(num_observers)]
+        self.ledger = TenantLedger("modelcheck", quota=0)
+        # -- invariant bookkeeping
+        self.applied_fences: Dict[Tuple[int, int], int] = {}
+        self.tombstoned: Dict[int, int] = {}   # slot -> location epoch
+        self.dead_shuffles: Dict[int, int] = {}
+        self.obs_dead: List[set] = [set() for _ in range(num_observers)]
+        self.obs_epochs: List[Dict[int, int]] = [
+            {} for _ in range(num_observers)]
+        self.obs_member_epoch: List[int] = [-1] * num_observers
+        self.expected_usage: Dict[int, int] = {}
+        self.member_history: List[Tuple[List[int], int]] = [
+            (self.membership.states(), self.membership.epoch())]
+        self.problem: Optional[str] = None
+
+    # -- driver glue mirrors ---------------------------------------------
+
+    def publish(self, map_id: int, token: int, exec_index: int,
+                fence: int) -> None:
+        """Fenced driver-table publish (endpoints._on_publish →
+        DriverTable.publish). Records the CAS outcome the fence-winner
+        invariant checks."""
+        applied = self.table.publish(map_id, token, exec_index, fence)
+        key = (map_id, exec_index)
+        prev = self.applied_fences.get(key)
+        if applied:
+            if prev is not None and fence < prev:
+                self.problem = (
+                    f"fence-winner: map {map_id} exec {exec_index} "
+                    f"applied fence {fence} after fence {prev}")
+            self.applied_fences[key] = max(prev or 0, fence)
+
+    def kill_slot(self, slot: int) -> None:
+        """Failure tombstone: membership DEAD + merged-directory prune +
+        location epoch bump (endpoints.remove_member/on_slot_dead)."""
+        members = self.membership.members()
+        if slot < len(members):
+            self.membership.tombstone(members[slot])
+        self.record_member_change()
+        self.merged.drop_slot(slot)
+        self.epochs[self.sid] = self.epochs.get(self.sid, 1) + 1
+        self.tombstoned[slot] = self.epochs[self.sid]
+
+    def apply_merged_publish(self, entry: MergedEntry) -> None:
+        """Merged-publish admission (endpoints._on_merged_publish):
+        publishes from a DEAD slot are dropped — a zombie finalize
+        landing after the tombstone prune must not resurrect the
+        entry."""
+        if entry.slot in self.tombstoned or \
+                self.membership.state_of(entry.slot) == SLOT_DEAD:
+            return
+        self.merged.apply(entry)
+
+    def unregister(self) -> None:
+        """TTL sweep / explicit unregister: the shuffle dies under a
+        terminal EPOCH_DEAD (endpoints._gc_sweep → bump_epoch DEAD)."""
+        self.dead_shuffles[self.sid] = self.epochs.get(self.sid, 1)
+
+    def record_member_change(self) -> None:
+        self.member_history.append(
+            (self.membership.states(), self.membership.epoch()))
+
+    # -- ledger bookkeeping (the conservation invariant's ground truth) --
+
+    def charge(self, tenant: int, nbytes: int) -> None:
+        self.ledger.charge(tenant, nbytes)
+        self.expected_usage[tenant] = \
+            self.expected_usage.get(tenant, 0) + nbytes
+
+    def release(self, tenant: int, nbytes: int) -> None:
+        self.ledger.release(tenant, nbytes)
+        self.expected_usage[tenant] = \
+            self.expected_usage.get(tenant, 0) - nbytes
+
+    # -- observer deliveries ---------------------------------------------
+
+    def deliver_dead(self, obs: int) -> None:
+        self.observers[obs].note_epoch(self.sid, EPOCH_DEAD)
+        self.obs_dead[obs].add(self.sid)
+
+
+class MergeTargetModel:
+    """One merge target's ledger discipline — the in-memory mirror of
+    ``push_merge.MergeStore`` push/finalize/drop semantics (fence
+    dedupe, finalized tombstone, dropped tombstone, charge-on-accept /
+    release-on-drop) with a real :class:`TenantLedger` underneath."""
+
+    def __init__(self, world: World, tenant: int = 0):
+        self.world = world
+        self.tenant = tenant
+        self.rows: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        self.finalized = False
+        self.dropped = False
+
+    def push(self, partition: int, map_id: int, fence: int,
+             nbytes: int, reopen: bool = False) -> bool:
+        if self.dropped:
+            # MergeStore keeps a dropped-shuffle tombstone so a push
+            # racing the unregister broadcast cannot re-charge disk
+            # nothing will ever release (push_merge.MergeStore.push)
+            return False
+        if self.finalized and not reopen:
+            return False
+        newest = self.rows.get((partition, map_id))
+        if newest is not None and fence <= newest[0]:
+            return False  # duplicate or stale attempt's push
+        self.world.charge(self.tenant, nbytes)
+        self.rows[(partition, map_id)] = (fence, nbytes)
+        return True
+
+    def finalize(self) -> None:
+        self.finalized = True
+
+    def drop(self) -> None:
+        if self.dropped:
+            return
+        self.dropped = True
+        for _fence, nbytes in self.rows.values():
+            self.world.release(self.tenant, nbytes)
+        self.rows.clear()
+
+
+# ------------------------------------------------------------- invariants
+
+def check_invariants(world: World,
+                     sched: VirtualScheduler) -> Optional[str]:
+    """All safety invariants over one world, called after every fired
+    step. Returns the first violation's description or None."""
+    del sched
+    if world.problem is not None:
+        return world.problem
+
+    # epoch-monotone: observed location epochs never regress; membership
+    # epoch never regresses
+    for i, plane in enumerate(world.observers):
+        for sid in list(world.obs_epochs[i]) + [world.sid]:
+            e = plane.known_epoch(sid)
+            prev = world.obs_epochs[i].get(sid)
+            if e is not None:
+                if prev is not None and e < prev:
+                    return (f"epoch-monotone: observer {i} regressed "
+                            f"shuffle {sid} epoch {prev} -> {e}")
+                world.obs_epochs[i][sid] = max(prev or 0, e)
+        me, _states = plane.membership()
+        if me < world.obs_member_epoch[i]:
+            return (f"epoch-monotone: observer {i} membership epoch "
+                    f"{world.obs_member_epoch[i]} -> {me}")
+        world.obs_member_epoch[i] = me
+
+    # dead shuffle stays dead: once the observer processed EPOCH_DEAD,
+    # no cached view may serve again
+    for i, plane in enumerate(world.observers):
+        for sid in world.obs_dead[i]:
+            if plane.table(sid) is not None:
+                return (f"epoch-monotone: observer {i} serves a cached "
+                        f"table for DEAD shuffle {sid}")
+            if plane.merged(sid) is not None:
+                return (f"epoch-monotone: observer {i} serves a merged "
+                        f"directory for DEAD shuffle {sid}")
+            if plane.plan(sid) is not None:
+                return (f"epoch-monotone: observer {i} serves a plan "
+                        f"for DEAD shuffle {sid}")
+            if plane.locations(sid, 0, 0, world.num_maps) is not None:
+                return (f"epoch-monotone: observer {i} serves cached "
+                        f"locations for DEAD shuffle {sid}")
+
+    # no-dead-location: a cached table stamped at-or-after a slot's
+    # tombstone epoch must not name the dead slot
+    for slot, tomb_epoch in world.tombstoned.items():
+        for i, plane in enumerate(world.observers):
+            cached = plane.table(world.sid)
+            if cached is None:
+                continue
+            table, epoch = cached
+            if epoch < tomb_epoch:
+                continue  # legitimately stale view, epoch says so
+            for m in range(table.num_maps):
+                e = table.entry(m)
+                if e is not None and e[1] == slot:
+                    return (f"no-dead-location: observer {i} resolves "
+                            f"map {m} to DEAD slot {slot} at epoch "
+                            f"{epoch} >= tombstone epoch {tomb_epoch}")
+
+    # merged-live: one entry per (partition, slot) is structural in
+    # MergedDirectory; what can break is a DEAD slot re-entering
+    for partition in world.merged.partitions():
+        for entry in world.merged.entries(partition):
+            if entry.slot in world.tombstoned:
+                return (f"merged-live: directory names DEAD slot "
+                        f"{entry.slot} for partition {partition}")
+
+    # member-legal: driver-side transitions + strictly increasing epoch.
+    # Every recorded commit pair is re-validated (the history is tiny);
+    # a mutation that skipped record_member_change is appended here so
+    # it can't hide.
+    states, epoch = (world.membership.states(),
+                     world.membership.epoch())
+    hist = world.member_history
+    if (states, epoch) != hist[-1]:
+        hist.append((states, epoch))
+    for (s0, e0), (s1, e1) in zip(hist, hist[1:]):
+        if s1 == s0 and e1 == e0:
+            continue
+        if e1 <= e0:
+            return (f"member-legal: vector changed without an epoch "
+                    f"bump ({e0} -> {e1})")
+        for slot, (a, b) in enumerate(zip(s0, s1)):
+            if a != b and (a, b) not in _LEGAL_MEMBER_STEPS:
+                return (f"member-legal: slot {slot} illegal transition "
+                        f"{a} -> {b}")
+        for slot in range(len(s0), len(s1)):
+            if s1[slot] != SLOT_LIVE:
+                return (f"member-legal: slot {slot} joined in state "
+                        f"{s1[slot]} (joiners must start LIVE)")
+
+    # ledger-conserve: usage == charges - releases of live state, >= 0
+    for tenant, expected in world.expected_usage.items():
+        usage = world.ledger.usage(tenant)
+        if expected < 0:
+            return (f"ledger-conserve: tenant {tenant} released more "
+                    f"than it charged ({expected})")
+        if usage != expected:
+            return (f"ledger-conserve: tenant {tenant} ledger usage "
+                    f"{usage} != live charges {expected} "
+                    f"(double-release or leaked charge)")
+    return None
+
+
+# --------------------------------------------------------------- scenarios
+
+@dataclass
+class Scenario:
+    name: str
+    build: Callable[[VirtualScheduler], World]
+    doc: str = ""
+
+
+_CATALOG: List[Scenario] = []
+
+
+def scenario(name: str, doc: str = ""):
+    def deco(fn):
+        _CATALOG.append(Scenario(name, fn, doc))
+        return fn
+    return deco
+
+
+def catalog() -> List[Scenario]:
+    return list(_CATALOG)
+
+
+def _push_bump(sched: VirtualScheduler, world: World,
+               epoch: int) -> None:
+    """Queue the driver's epoch-bump push to every observer — each on
+    its own push channel (FIFO with other pushes to that observer,
+    concurrent with its response stream)."""
+    for i in range(len(world.observers)):
+        def deliver(s, i=i, epoch=epoch):
+            del s
+            world.observers[i].note_epoch(world.sid, epoch)
+        sched.post(f"bump.e{epoch}->obs{i}", deliver,
+                   chan=f"obs{i}.push", touches={f"obs{i}"})
+
+
+@scenario("pub_tomb_bump",
+          "publish vs tombstone vs epoch bump: stale table responses "
+          "race the repair's bump push to two observers")
+def _build_pub_tomb_bump(sched: VirtualScheduler) -> World:
+    world = World(num_observers=2, num_maps=2)
+    sid = world.sid
+    # pre-history: both maps committed and published (slot0 owns map0)
+    world.publish(0, token=101, exec_index=0, fence=1)
+    world.publish(1, token=102, exec_index=1, fence=1)
+    stale = DriverTable.from_bytes(world.table.to_bytes())
+
+    # two stale epoch-1 table responses already in flight, one per
+    # observer's request/response stream
+    for i in range(2):
+        def resp(s, i=i, stale=stale):
+            del s
+            world.observers[i].put_table(sid, stale, 1)
+        sched.post(f"resp.e1->obs{i}", resp, chan=f"obs{i}.resp",
+                   touches={f"obs{i}"})
+
+    def tombstone(s):
+        # slot0 dies: repair republishes map0 from slot1 (recovery's
+        # re-execution), the directory prunes, the epoch bumps, and the
+        # bump pushes + a fresh post-repair response go out
+        world.kill_slot(0)
+        world.publish(0, token=201, exec_index=1, fence=2)
+        repaired = DriverTable.from_bytes(world.table.to_bytes())
+        epoch = world.epochs[sid]
+        _push_bump(s, world, epoch)
+        for i in range(2):
+            def resp2(s2, i=i, repaired=repaired, epoch=epoch):
+                del s2
+                world.observers[i].put_table(sid, repaired, epoch)
+            s.post(f"resp.e{epoch}->obs{i}", resp2,
+                   chan=f"obs{i}.resp", touches={f"obs{i}"})
+    # touches covers the bump/response follow-ups it posts (the POR
+    # contract): it must not be reduced against observer deliveries
+    sched.post("driver.tombstone0", tombstone,
+               touches={"driver", "obs0", "obs1"})
+    return world
+
+
+@scenario("fence_loser",
+          "fence loser commits late: a zombie speculative attempt's "
+          "publish races the winner's, plus a re-delivery")
+def _build_fence_loser(sched: VirtualScheduler) -> World:
+    world = World(num_observers=1, num_maps=2)
+    # speculative attempts of map0 on exec0 (fences 1 and 2), a zombie
+    # re-delivery, a cross-executor recovery publish, and map1's
+    # publishes — each rides its own task thread, so delivery order is
+    # unconstrained (all touch the one driver table: no reduction)
+    sched.post("pub.m0.exec0.f2",
+               lambda s: world.publish(0, 300, 0, fence=2),
+               touches={"table"})
+    sched.post("pub.m0.exec0.f1",
+               lambda s: world.publish(0, 299, 0, fence=1),
+               touches={"table"})
+    sched.post("repub.m0.exec0.f1",
+               lambda s: world.publish(0, 299, 0, fence=1),
+               touches={"table"})
+    sched.post("pub.m0.exec1.f1",
+               lambda s: world.publish(0, 400, 1, fence=1),
+               touches={"table"})
+    sched.post("pub.m1.exec1.f1",
+               lambda s: world.publish(1, 401, 1, fence=1),
+               touches={"table"})
+    sched.post("pub.m1.exec1.f2",
+               lambda s: world.publish(1, 402, 1, fence=2),
+               touches={"table"})
+    return world
+
+
+@scenario("finalize_vs_push",
+          "finalize beats first push: pushes race the finalize and "
+          "unregister broadcasts; the ledger must conserve")
+def _build_finalize_vs_push(sched: VirtualScheduler) -> World:
+    world = World(num_observers=1, num_maps=2)
+    target = MergeTargetModel(world, tenant=3)
+    # two pushers (their own connections), a duplicate re-push, and a
+    # superseding re-execution push
+    sched.post("push.m0.f1",
+               lambda s: target.push(0, 0, fence=1, nbytes=100),
+               chan="pusher0", touches={"target"})
+    sched.post("repush.m0.f1",
+               lambda s: target.push(0, 0, fence=1, nbytes=100),
+               chan="pusher0", touches={"target"})
+    sched.post("push.m1.f1.p0",
+               lambda s: target.push(1, 1, fence=1, nbytes=60),
+               chan="pusher0", touches={"target"})
+    sched.post("push.m0.f2",
+               lambda s: target.push(0, 0, fence=2, nbytes=120),
+               chan="pusher1", touches={"target"})
+    sched.post("push.m1.f1",
+               lambda s: target.push(1, 1, fence=1, nbytes=80),
+               chan="pusher1", touches={"target"})
+    # finalize then unregister ride the same driver broadcast channel
+    # (FIFO between themselves, concurrent with every pusher)
+    sched.post("bcast.finalize", lambda s: target.finalize(),
+               chan="drv.bcast", touches={"target"})
+    sched.post("bcast.drop", lambda s: target.drop(),
+               chan="drv.bcast", touches={"target"})
+    return world
+
+
+@scenario("drain_vs_kill",
+          "graceful drain races a concurrent failure kill of the same "
+          "slot; membership transitions must stay legal everywhere")
+def _build_drain_vs_kill(sched: VirtualScheduler) -> World:
+    world = World(num_observers=2, num_maps=2)
+    for i in range(3):
+        world.membership.join(_mid(i))
+    world.record_member_change()
+
+    def push_member(s) -> None:
+        states, epoch = (world.membership.states(),
+                         world.membership.epoch())
+        for i in range(len(world.observers)):
+            def deliver(s2, i=i, states=list(states), epoch=epoch):
+                del s2
+                world.observers[i].note_membership(epoch, states)
+            s.post(f"mbump.e{epoch}->obs{i}", deliver,
+                   chan=f"obs{i}.push", touches={f"obs{i}"})
+
+    def begin_drain(s):
+        if world.membership.begin_drain(1) is not None:
+            world.record_member_change()
+            push_member(s)
+    # driver ops fan out membership bumps: touches covers the
+    # observer follow-ups (the POR contract)
+    _mtouch = {"member", "obs0", "obs1"}
+    sched.post("drain.begin1", begin_drain, touches=_mtouch)
+
+    def kill(s):
+        world.kill_slot(1)
+        push_member(s)
+    sched.post("kill.slot1", kill, touches=_mtouch)
+
+    def abort(s):
+        if world.membership.abort_drain(1) is not None:
+            world.record_member_change()
+            push_member(s)
+    sched.post("drain.abort1", abort, chan="drain", touches=_mtouch)
+
+    def retire(s):
+        if world.membership.retire(1) is not None:
+            world.record_member_change()
+            push_member(s)
+    sched.post("drain.retire1", retire, chan="drain", touches=_mtouch)
+    return world
+
+
+@scenario("ttl_vs_late_fetch",
+          "TTL sweep unregisters while table responses are in flight; "
+          "nothing may resurrect a DEAD shuffle's cached views")
+def _build_ttl_vs_late_fetch(sched: VirtualScheduler) -> World:
+    world = World(num_observers=2, num_maps=2)
+    sid = world.sid
+    world.publish(0, 500, 0, fence=1)
+    world.publish(1, 501, 1, fence=1)
+    snap = DriverTable.from_bytes(world.table.to_bytes())
+    world.merged.apply(MergedEntry(0, 1, 600, 64, 0, b"\x03", [(0, 64)]))
+    merged_snap = MergedDirectory.from_bytes(world.merged.to_bytes())
+
+    # two in-flight responses per observer: the table and the merged
+    # directory, both stamped with the pre-death epoch
+    for i in range(2):
+        def resp_table(s, i=i):
+            del s
+            world.observers[i].put_table(sid, snap, 1)
+        sched.post(f"resp.table->obs{i}", resp_table,
+                   chan=f"obs{i}.resp", touches={f"obs{i}"})
+
+        def resp_merged(s, i=i):
+            del s
+            world.observers[i].put_merged(sid, merged_snap, 1)
+        sched.post(f"resp.merged->obs{i}", resp_merged,
+                   chan=f"obs{i}.resp", touches={f"obs{i}"})
+
+    def sweep(s):
+        world.unregister()
+        for i in range(len(world.observers)):
+            s.post(f"dead->obs{i}",
+                   lambda s2, i=i: world.deliver_dead(i),
+                   chan=f"obs{i}.push", touches={f"obs{i}"})
+    # touches covers the EPOCH_DEAD pushes it fans out (POR contract)
+    sched.post("ttl.sweep", sweep, touches={"driver", "obs0", "obs1"})
+    return world
+
+
+# ------------------------------------------------------------ entry points
+
+def _anchor_of(run: Run, build: Callable) -> Tuple[str, int]:
+    """Anchor a violation at the culprit step's function if it lives in
+    a real file, else at the scenario builder."""
+    fn = run.culprit.fn if run.culprit is not None else build
+    anchor = run.culprit.anchor if run.culprit is not None else None
+    if anchor is not None:
+        return anchor
+    code = getattr(fn, "__code__", None)
+    if code is not None and os.path.exists(code.co_filename):
+        return code.co_filename, code.co_firstlineno
+    return inspect.getsourcefile(build) or "<unknown>", 0
+
+
+@dataclass
+class ScenarioStats:
+    name: str
+    dfs_schedules: int   # distinct reduced schedules the DFS completed
+    walk_schedules: int  # seeded random walks on top
+    max_depth_seen: int
+    budget_hit: bool     # DFS stopped at max_schedules, not exhaustion
+
+
+def run_scenario(scn: Scenario, max_schedules: int = 256,
+                 max_depth: int = 64, walks: int = 16, seed: int = 0
+                 ) -> Tuple[List[Run], ScenarioStats]:
+    runs = explore_dfs(scn.build, check_invariants,
+                       max_schedules=max_schedules, max_depth=max_depth)
+    dfs_n = len(runs)
+    budget_hit = dfs_n >= max_schedules
+    if walks > 0 and not any(r.violation for r in runs):
+        runs += random_walks(scn.build, check_invariants, walks=walks,
+                             seed=seed, max_depth=max_depth * 4)
+    stats = ScenarioStats(scn.name, dfs_n, len(runs) - dfs_n,
+                          max((len(r.trace) for r in runs), default=0),
+                          budget_hit)
+    return runs, stats
+
+
+def run_catalog(max_schedules: Optional[int] = None,
+                max_depth: Optional[int] = None,
+                walks: Optional[int] = None, seed: int = 0,
+                trace_dir: Optional[str] = None,
+                root: Optional[str] = None
+                ) -> Tuple[List[Finding], List[ScenarioStats]]:
+    """Run every catalog scenario; violations become findings anchored
+    at the culprit step, with the violating trace dumped as a JSON
+    artifact for ``--replay`` when ``trace_dir`` is set.
+
+    Budgets default from the environment (``MODELCHECK_SCHEDULES`` /
+    ``MODELCHECK_DEPTH`` / ``MODELCHECK_WALKS``) so CI can widen the
+    sweep without a code change; the in-code defaults fit the tier-1
+    time box."""
+    root = root or repo_root()
+    max_schedules = max_schedules if max_schedules is not None else int(
+        os.environ.get("MODELCHECK_SCHEDULES", "256"))
+    max_depth = max_depth if max_depth is not None else int(
+        os.environ.get("MODELCHECK_DEPTH", "64"))
+    walks = walks if walks is not None else int(
+        os.environ.get("MODELCHECK_WALKS", "16"))
+    findings: List[Finding] = []
+    stats: List[ScenarioStats] = []
+    for scn in catalog():
+        runs, st = run_scenario(scn, max_schedules=max_schedules,
+                                max_depth=max_depth, walks=walks,
+                                seed=seed)
+        stats.append(st)
+        for run in runs:
+            if run.violation is None:
+                continue
+            path, line = _anchor_of(run, scn.build)
+            trace_note = " -> ".join(run.trace)
+            if trace_dir is not None:
+                os.makedirs(trace_dir, exist_ok=True)
+                artifact = os.path.join(trace_dir,
+                                        f"{scn.name}.trace.json")
+                with open(artifact, "w") as f:
+                    json.dump({"scenario": scn.name, "seed": seed,
+                               "trace": list(run.trace)}, f, indent=2)
+                trace_note += f" (trace dumped to {artifact})"
+            findings.append(Finding(
+                PASS, rel(root, path), line,
+                f"scenario {scn.name}: {run.violation}; "
+                f"schedule: {trace_note}"))
+            break  # one finding per scenario; the trace replays the rest
+    return findings, stats
+
+
+def replay_trace(path: str) -> Run:
+    """Replay one dumped trace artifact byte-identically; raises
+    AssertionError if the reproduction diverges."""
+    with open(path) as f:
+        doc = json.load(f)
+    scn = next((s for s in catalog() if s.name == doc["scenario"]), None)
+    if scn is None:
+        raise ValueError(f"unknown scenario {doc['scenario']!r} in {path}")
+    run = replay(scn.build, check_invariants, doc["trace"])
+    if list(run.trace) != list(doc["trace"])[:len(run.trace)]:
+        raise AssertionError(
+            f"replay diverged: {run.trace} != {doc['trace']}")
+    return run

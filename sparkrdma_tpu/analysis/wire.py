@@ -218,11 +218,27 @@ _EXTRA_CASES: Dict[str, List[Callable[[], "rpc_msg.RpcMsg"]]] = {
         lambda: M.FetchMergedResp(1, M.STATUS_UNKNOWN_SHUFFLE,
                                   M.EPOCH_DEAD, b"")],
     # elastic membership corners: an empty fleet's bump, the three real
-    # slot states together, and a failed drain's error response
+    # slot states together, and a failed drain's error response — plus
+    # the membership-epoch DOMAIN corners for msgs 36-39 (epoch 0, which
+    # a live driver never pushes but a mixed-version peer may replay;
+    # max-i64, the signed-pack boundary; an all-DRAINING state vector,
+    # the whole-fleet-decommission edge nothing on a healthy cluster
+    # ever emits)
     "MembershipBumpMsg": [
         lambda: M.MembershipBumpMsg(1, []),
-        lambda: M.MembershipBumpMsg(7, [0, 1, 2, 0])],
-    "DrainResp": [lambda: M.DrainResp(3, M.STATUS_ERROR, 0, 0)],
+        lambda: M.MembershipBumpMsg(7, [0, 1, 2, 0]),
+        lambda: M.MembershipBumpMsg(0, [1]),
+        lambda: M.MembershipBumpMsg((1 << 63) - 1, [1, 1, 1, 1])],
+    "JoinMsg": [
+        lambda: M.JoinMsg(_mk_manager_id(random.Random(7)),
+                          flags=(1 << 32) - 1)],
+    "DrainReq": [
+        lambda: M.DrainReq(1, 0, 0),
+        lambda: M.DrainReq((1 << 62) - 1, 0, (1 << 63) - 1)],
+    "DrainResp": [
+        lambda: M.DrainResp(3, M.STATUS_ERROR, 0, 0),
+        lambda: M.DrainResp(1, M.STATUS_OK, (1 << 63) - 1,
+                            (1 << 63) - 1)],
 }
 
 

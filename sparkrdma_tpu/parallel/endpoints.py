@@ -170,6 +170,7 @@ class DriverEndpoint:
         self._merged: Dict[int, object] = {}
         self._finalize_sent: set = set()
         self.merged_publishes = 0  # audit: directory entries applied
+        self.merged_zombie_drops = 0  # publishes from a DEAD slot dropped
         self._clients = ConnectionCache(self.conf)
         # One broadcaster thread + a coalescing slot instead of a thread per
         # membership event: N executors joining produce O(N) sends of the
@@ -557,6 +558,25 @@ class DriverEndpoint:
         from sparkrdma_tpu.shuffle.push_merge import (MergedDirectory,
                                                       MergedEntry)
         with self._tables_lock:
+            # zombie guard: a finalize publish from a slot tombstoned
+            # while the message was in flight must not re-enter the
+            # directory — on_slot_dead already pruned that slot, and a
+            # resurrected entry would serve to reducers stamped with
+            # the POST-bump epoch (the modelcheck merged-live
+            # invariant). Checked INSIDE _tables_lock: remove_member
+            # tombstones the slot before on_slot_dead takes this lock
+            # for the prune, so a publish that saw the slot live here
+            # applies before the prune, never after it. (The nesting
+            # _tables_lock -> membership._lock matches the register
+            # path; nothing nests the other way.)
+            members = self.membership.members()
+            if (0 <= msg.exec_index < len(members)
+                    and members[msg.exec_index] == TOMBSTONE):
+                self.merged_zombie_drops += 1
+                log.info("driver: dropped merged publish from DEAD "
+                         "slot %d for shuffle %d", msg.exec_index,
+                         msg.shuffle_id)
+                return
             table = self._tables.get(msg.shuffle_id)
             if table is None:
                 log.warning("driver: merged publish for unknown shuffle "
@@ -1902,12 +1922,22 @@ class ExecutorEndpoint:
             src = self.data_source
             if src is not None and hasattr(src, "note_tenant"):
                 src.note_tenant(msg.shuffle_id, msg.tenant)
+            if self.merge_store is not None:
+                # a fresh registration reusing a dropped id re-arms the
+                # merge target (same FIFO channel as the unregister)
+                self.merge_store.note_registered(msg.shuffle_id)
+            self.location_plane.note_registered(msg.shuffle_id)
             return None
         if isinstance(msg, M.ReducePlanMsg):
             self._on_reduce_plan(msg)
             return None
         if isinstance(msg, M.ShardMapMsg):
             from sparkrdma_tpu.shuffle.location_plane import ShardMap
+            # a pushed shard map is a registration signal: it re-arms a
+            # dead id (same FIFO channel as the unregister push)
+            self.location_plane.note_registered(msg.shuffle_id)
+            if self.merge_store is not None:
+                self.merge_store.note_registered(msg.shuffle_id)
             self.location_plane.put_shard_map(
                 msg.shuffle_id, ShardMap(msg.num_maps, msg.shard_slots),
                 msg.epoch)
@@ -2059,6 +2089,13 @@ class ExecutorEndpoint:
             log.warning("%s: undecodable reduce plan push: %s",
                         self.manager_id.executor_id.executor, e)
             return
+        # a pushed plan names a LIVE shuffle: like the other
+        # registration pushes it re-arms a dead/dropped reused id (same
+        # FIFO channel as the unregister push). Response-path plans
+        # (get_reduce_plan's pull) deliberately don't.
+        self.location_plane.note_registered(plan.shuffle_id)
+        if self.merge_store is not None:
+            self.merge_store.note_registered(plan.shuffle_id)
         accepted = self.location_plane.put_plan(plan.shuffle_id, plan)
         if not accepted:
             return  # stale reordered push: must not touch warm state

@@ -239,6 +239,7 @@ class BufferPool:
         # every co-hosted tenant shares. The charge is conservative
         # (requested size rounded to the bin) and re-trued below.
         bin_est = _round_up_pow2(max(size, 1), self.min_block)
+        # analysis: leak-ok(the lease transfers to the PoolBuffer on success; _release repays at free)
         self._tenant_leases.charge(tenant, bin_est)
         try:
             return self._get_charged(size, tenant, bin_est)
@@ -269,6 +270,7 @@ class BufferPool:
                                           self._leased_bytes)
         if int(bin_size) != bin_est:  # defensive: arenas bin identically
             self._tenant_leases.release(tenant, bin_est)
+            # analysis: leak-ok(re-true of the estimate; the corrected lease transfers to the PoolBuffer below)
             self._tenant_leases.charge(tenant, int(bin_size))
         return PoolBuffer(int(token), int(bin_size), view, self, tenant)
 

@@ -292,6 +292,7 @@ def get_range(shuffle_id: int, epoch: int, start: int, end: int,
         if entry is None:
             return None
         stored_epoch, keys, payload = entry
+        # analysis: epoch-eq-ok(warm reuse demands exactly the requested epoch; any other vintage is dead bytes)
         if stored_epoch != epoch:
             del ranges[key]
             _bytes[("warm", shuffle_id)] = max(
@@ -316,6 +317,7 @@ def on_plan_epoch(shuffle_id: int, plan_epoch: int) -> None:
     with _lock:
         prev = _plan_epochs.get(shuffle_id)
         _plan_epochs[shuffle_id] = plan_epoch
+        # analysis: epoch-eq-ok(idempotent re-delivery check; equality means the same plan, nothing to invalidate)
         if prev is None or prev == plan_epoch:
             return
         ranges = _ranges.pop(shuffle_id, None)
@@ -340,6 +342,7 @@ def on_epoch(shuffle_id: int, epoch: int) -> None:
         ranges = _ranges.get(shuffle_id)
         if not ranges:
             return
+        # analysis: epoch-eq-ok(warm reuse demands exactly the current epoch; every other vintage is stale)
         stale = [k for k, (e, _k, _p) in ranges.items() if e != epoch]
         freed = 0
         for k in stale:

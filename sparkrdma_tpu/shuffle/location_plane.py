@@ -224,6 +224,19 @@ class LocationPlane:
         self.enabled = enabled
         self._lock = threading.Lock()
         self._epochs: Dict[int, int] = {}
+        # shuffles observed DEAD: an EPOCH_DEAD push pops the epoch
+        # record, so without this marker a LATE response stamped with
+        # the pre-death epoch re-cached views for a dead shuffle (the
+        # modelcheck ttl_vs_late_fetch schedule). put_* paths drop for
+        # marked shuffles; a POSITIVE pushed bump or a push-delivered
+        # registration signal (note_registered) re-arms the id — both
+        # ride the driver's FIFO broadcast channel, so their arrival
+        # postdates the death. Count- and time-bounded (see
+        # utils/tombstones.py): zombie responses are bounded by request
+        # deadlines, so an aged marker has nothing left to reject and
+        # expires rather than keeping a reused id cold forever.
+        from sparkrdma_tpu.utils.tombstones import TombstoneCache
+        self._dead = TombstoneCache(ttl_s=60.0, cap=4096)
         self._tables: Dict[int, Tuple[DriverTable, int]] = {}
         self._locations: "OrderedDict[Tuple[int, int, int, int], Tuple[list, int]]" = OrderedDict()
         self._shard_maps: Dict[int, Tuple[ShardMap, int]] = {}
@@ -290,6 +303,23 @@ class LocationPlane:
 
     # -- epoch observation ------------------------------------------------
 
+    def note_registered(self, shuffle_id: int) -> None:
+        """Re-arm a DEAD id: called on push-delivered registration
+        signals (TenantMapMsg, ShardMapMsg, pushed ReducePlanMsg) —
+        they ride the same FIFO broadcast channel as the EPOCH_DEAD
+        that killed the id, so their arrival postdates the death and
+        names a NEW incarnation. Response-path put_* calls never clear
+        the marker (a late response is exactly what the marker exists
+        to reject). Residual window: a response from the OLD
+        incarnation still in flight when the id is re-registered and
+        re-armed can cache once — epochs restart per registration, so
+        without a wire-level registration generation no local guard
+        can tell the incarnations apart; the fetch-failure
+        invalidation backstop (module docstring) keeps that a latency
+        cost, never a correctness one."""
+        with self._lock:
+            self._dead.discard(shuffle_id)
+
     def known_epoch(self, shuffle_id: int) -> Optional[int]:
         with self._lock:
             return self._epochs.get(shuffle_id)
@@ -305,24 +335,32 @@ class LocationPlane:
                 self._shard_maps.pop(shuffle_id, None)
                 self._plans.pop(shuffle_id, None)
                 self._merged.pop(shuffle_id, None)
+                self._dead.add(shuffle_id)
                 dropped = self._drop_locations_locked(shuffle_id)
                 if had or dropped:
                     self.invalidations += 1
                 return had or dropped
+            # a positive PUSHED epoch re-arms a dead id: the broadcast
+            # channel is FIFO, so this bump postdates the death — the
+            # id was re-registered (engine shuffle ids are reused)
+            self._dead.discard(shuffle_id)
             prev = self._epochs.get(shuffle_id)
             if prev is not None and epoch <= prev:
                 return False
             self._epochs[shuffle_id] = epoch
             stale = False
             cached = self._tables.get(shuffle_id)
+            # analysis: epoch-eq-ok(validity is exact-epoch match; the monotone guard above ordered the observation)
             if cached is not None and cached[1] != epoch:
                 del self._tables[shuffle_id]
                 stale = True
             merged = self._merged.get(shuffle_id)
+            # analysis: epoch-eq-ok(validity is exact-epoch match; the monotone guard above ordered the observation)
             if merged is not None and merged[1] != epoch:
                 del self._merged[shuffle_id]
                 stale = True
             for key in [k for k in self._locations if k[0] == shuffle_id]:
+                # analysis: epoch-eq-ok(validity is exact-epoch match; the monotone guard above ordered the observation)
                 if self._locations[key][1] != epoch:
                     del self._locations[key]
                     stale = True
@@ -341,6 +379,11 @@ class LocationPlane:
         if not self.enabled or table.num_published < table.num_maps:
             return
         with self._lock:
+            if shuffle_id in self._dead:
+                # late response for a DEAD shuffle: the epoch record is
+                # gone, only the marker knows this would resurrect it
+                self.stale_drops += 1
+                return
             prev = self._epochs.get(shuffle_id)
             if prev is not None and epoch < prev:
                 # the response predates a pushed invalidation: stale
@@ -359,6 +402,7 @@ class LocationPlane:
                 self.misses += 1
                 return None
             known = self._epochs.get(shuffle_id)
+            # analysis: epoch-eq-ok(a cached view serves only at exactly the newest observed epoch; != means stale)
             if known is not None and cached[1] != known:
                 del self._tables[shuffle_id]
                 self.stale_drops += 1
@@ -374,6 +418,9 @@ class LocationPlane:
         if not self.enabled:
             return
         with self._lock:
+            if shuffle_id in self._dead:
+                self.stale_drops += 1
+                return
             prev = self._epochs.get(shuffle_id)
             if prev is not None and epoch < prev:
                 self.stale_drops += 1
@@ -396,6 +443,7 @@ class LocationPlane:
                 self.misses += 1
                 return None
             known = self._epochs.get(shuffle_id)
+            # analysis: epoch-eq-ok(a cached view serves only at exactly the newest observed epoch; != means stale)
             if known is not None and cached[1] != known:
                 del self._locations[key]
                 self.stale_drops += 1
@@ -425,6 +473,8 @@ class LocationPlane:
         plan or a newer epoch) — plan-keyed warm invalidation gates on
         this, so a rejected stale push can't wipe warm state either."""
         with self._lock:
+            if shuffle_id in self._dead:
+                return False  # a late plan response for a DEAD shuffle
             prev = self._plans.get(shuffle_id)
             if prev is not None and plan.plan_epoch <= prev.plan_epoch:
                 return False
@@ -446,6 +496,9 @@ class LocationPlane:
         if not self.enabled:
             return
         with self._lock:
+            if shuffle_id in self._dead:
+                self.stale_drops += 1
+                return
             prev = self._epochs.get(shuffle_id)
             if prev is not None and epoch < prev:
                 self.stale_drops += 1
@@ -463,6 +516,7 @@ class LocationPlane:
                 self.misses += 1
                 return None
             known = self._epochs.get(shuffle_id)
+            # analysis: epoch-eq-ok(a cached view serves only at exactly the newest observed epoch; != means stale)
             if known is not None and cached[1] != known:
                 del self._merged[shuffle_id]
                 self.stale_drops += 1
@@ -507,6 +561,7 @@ class LocationPlane:
                 "merged": len(self._merged),
                 "member_epoch": self._member_epoch,
                 "member_states": list(self._member_states),
+                "dead": len(self._dead),
                 "hits": self.hits,
                 "misses": self.misses,
                 "invalidations": self.invalidations,

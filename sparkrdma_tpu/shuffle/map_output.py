@@ -128,14 +128,19 @@ class DriverTable:
         self.num_maps = num_maps
         self._buf = bytearray(num_maps * MAP_ENTRY_SIZE)
         self._published = 0  # O(1) count for the poll-heavy fetch path
-        # commit-fencing state, driver-local (never serialized): last
-        # applied (fence, exec_index) per map. Fences are allocated by
+        # commit-fencing state, driver-local (never serialized): highest
+        # applied fence per (map, exec_index). Fences are allocated by
         # each executor's resolver, so they totally order attempts OF ONE
         # EXECUTOR; cross-executor overwrites always apply (recovery and
         # elastic rejoin depend on last-writer-wins across executors, and
         # a cross-executor late commit is a complete committed output of
-        # the same deterministic map — not a torn location).
-        self._fences: dict = {}
+        # the same deterministic map — not a torn location). Keyed per
+        # executor, not last-applied-only: with only the last (fence,
+        # exec) remembered, an intervening cross-executor publish reset
+        # the baseline and a zombie attempt's OLD-fence re-publish from
+        # the original executor applied again (modelcheck scenario
+        # fence_loser found the schedule).
+        self._fences: dict = {}  # map_id -> {exec_index: fence}
         for m in range(num_maps):
             _MAP_ENTRY.pack_into(self._buf, m * MAP_ENTRY_SIZE, 0, UNPUBLISHED)
 
@@ -147,13 +152,12 @@ class DriverTable:
         Equal fences re-apply (publishes are idempotent overwrites)."""
         if not 0 <= map_id < self.num_maps:
             raise IndexError(f"map_id {map_id} out of range [0, {self.num_maps})")
-        prev = self._fences.get(map_id)
-        if (prev is not None and exec_index == prev[1]
-                and fence < prev[0]):
+        prev = self._fences.setdefault(map_id, {})
+        if fence < prev.get(exec_index, 0):
             return False
         was = self.entry(map_id) is not None
         _MAP_ENTRY.pack_into(self._buf, map_id * MAP_ENTRY_SIZE, table_token, exec_index)
-        self._fences[map_id] = (fence, exec_index)
+        prev[exec_index] = fence
         if not was and self.entry(map_id) is not None:
             self._published += 1
         return True

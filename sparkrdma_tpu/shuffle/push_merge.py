@@ -329,6 +329,19 @@ class MergeStore:
         os.makedirs(self.dir, exist_ok=True)
         self._lock = threading.Lock()
         self._shuffles: Dict[int, _ShuffleSegments] = {}
+        # shuffles already dropped here (unregister processed): a push
+        # racing the unregister broadcast used to re-create state and
+        # charge disk bytes NOTHING would ever release (drop_shuffle had
+        # already run; reap_orphans deletes files, not ledger charges) —
+        # the modelcheck finalize_vs_push ledger-conserve invariant.
+        # Count- and time-bounded (utils/tombstones.py): zombie pushes
+        # are bounded by push deadlines, and engine shuffle ids are
+        # reused — an expiring marker restores push-merge for the new
+        # incarnation even in deployments with no registration push.
+        # A push-delivered registration signal re-arms immediately
+        # (note_registered: TenantMapMsg / ShardMapMsg / pushed plan).
+        from sparkrdma_tpu.utils.tombstones import TombstoneCache
+        self._dropped = TombstoneCache(ttl_s=30.0, cap=1024)
         self.max_segment = int(conf.merge_segment_max_bytes)
         self._ovf_seq = 0  # uniquifies overflow blob names (one map
         # attempt may overflow several spills — they must not collide)
@@ -374,6 +387,12 @@ class MergeStore:
             segs.append(view[pos:pos + size])
             pos += size
         with self._lock:
+            if shuffle_id in self._dropped:
+                # the unregister broadcast already dropped this shuffle
+                # here: accepting would charge disk bytes no drop will
+                # ever release. FINALIZED stops the pusher for good.
+                self.pushes_rejected += len(sizes)
+                return M.STATUS_FINALIZED, bytes(accepted)
             state = self._shuffles.get(shuffle_id)
             if state is None:
                 state = _ShuffleSegments()
@@ -405,6 +424,7 @@ class MergeStore:
                 # per-map-fetched, nothing breaks
                 tenant = self.resolver.tenant_of(shuffle_id)
                 try:
+                    # analysis: leak-ok(accepted rows transfer to state.charged; drop_shuffle repays per tenant)
                     self.resolver.disk_ledger.charge(tenant, size)
                 except Exception:
                     self.pushes_rejected += 1
@@ -460,11 +480,14 @@ class MergeStore:
         token). The blob is registered with the resolver so the writer
         fetches it back over the ordinary block dataplane."""
         with self._lock:
+            if shuffle_id in self._dropped:
+                return M.STATUS_FINALIZED, 0  # unregistered: no parking
             seq = self._ovf_seq
             self._ovf_seq += 1
         # tenancy: overflow blobs are disk the owning tenant parks here
         tenant = self.resolver.tenant_of(shuffle_id)
         try:
+            # analysis: leak-ok(stored blobs transfer to state.charged; drop_shuffle repays per tenant)
             self.resolver.disk_ledger.charge(tenant, len(data))
         except Exception:
             return M.STATUS_ERROR, 0
@@ -480,13 +503,29 @@ class MergeStore:
             self.resolver.disk_ledger.release(tenant, len(data))
             return M.STATUS_ERROR, 0
         with self._lock:
-            state = self._shuffles.get(shuffle_id)
-            if state is None:
-                state = _ShuffleSegments()
-                self._shuffles[shuffle_id] = state
-            state.overflow_tokens.append(token)
-            state.charged[tenant] = state.charged.get(tenant, 0) \
-                + len(data)
+            if shuffle_id in self._dropped:
+                # the unregister broadcast landed in the window between
+                # the entry check and here (disk + registration happen
+                # OUTSIDE the lock): unwind everything this call did —
+                # recording the charge in a re-created state would park
+                # bytes no drop will ever repay (push() is immune: its
+                # check, charge, and record share one lock block)
+                unwind = True
+            else:
+                unwind = False
+                state = self._shuffles.get(shuffle_id)
+                if state is None:
+                    state = _ShuffleSegments()
+                    self._shuffles[shuffle_id] = state
+                state.overflow_tokens.append(token)
+                state.charged[tenant] = state.charged.get(tenant, 0) \
+                    + len(data)
+        if unwind:
+            self.resolver.disk_ledger.release(tenant, len(data))
+            # the dropped shuffle's other externals are already gone;
+            # this releases (and deletes) only the blob just parked
+            self.resolver.release_externals(shuffle_id)
+            return M.STATUS_FINALIZED, 0
         return M.STATUS_OK, token
 
     def hosted_shuffles(self) -> List[int]:
@@ -627,9 +666,19 @@ class MergeStore:
 
     # -- lifecycle -------------------------------------------------------
 
+    def note_registered(self, shuffle_id: int) -> None:
+        """Re-arm a dropped id: the driver's registration pushes
+        (TenantMapMsg, ShardMapMsg, a pushed ReducePlanMsg) ride the
+        same broadcast channel as the unregister that dropped it, so
+        their arrival is authoritative evidence the id was reused for
+        a NEW shuffle."""
+        with self._lock:
+            self._dropped.discard(shuffle_id)
+
     def drop_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
             state = self._shuffles.pop(shuffle_id, None)
+            self._dropped.add(shuffle_id)
         if state is None:
             return
         for tenant, nbytes in state.charged.items():

@@ -312,6 +312,7 @@ class TpuShuffleBlockResolver:
         total_bytes = int(lengths_arr.sum())
         tenant = self.tenant_of(shuffle_id)
         try:
+            # analysis: leak-ok(ownership transfers to _token_disk on success; _release_disk repays at unregister)
             self.disk_ledger.charge(tenant, total_bytes)
         except Exception:
             self._reap_quietly(tmp_path)
@@ -373,8 +374,9 @@ class TpuShuffleBlockResolver:
             for p in (final, sidecar, index):
                 self._reap_quietly(p)
             with self._commit_lock:
-                if (fence is not None and
-                        self._map_fences.get((shuffle_id, map_id)) == fence):
+                recorded = self._map_fences.get((shuffle_id, map_id))
+                # analysis: epoch-eq-ok(identity check, not ordering: un-commit only the fence THIS attempt recorded)
+                if fence is not None and recorded == fence:
                     del self._map_fences[(shuffle_id, map_id)]
             self.disk_ledger.release(tenant, total_bytes)
             raise

@@ -34,3 +34,22 @@ def make_cluster(tmp_path, n: int = 3):
     for ex in execs:
         ex.native.executor.wait_for_members(n)
     return driver, execs
+
+
+def lockgraph_module_guard():
+    """Shared body of the CHAOS_LOCKGRAPH module fixtures
+    (tests/test_chaos.py, tests/test_membership.py): install the
+    lock-order shim, snapshot pre-existing cycles (a session-wide
+    ANALYSIS_LOCKGRAPH shim shares the graph — blame only cycles that
+    appear DURING the module), and on teardown fail on any new cycle.
+    Generator: fixtures drive it with ``yield from``."""
+    from sparkrdma_tpu.analysis import lockgraph
+
+    owned = lockgraph.current() is None
+    graph = lockgraph.install()
+    pre = {tuple(c) for c in graph.cycles()}
+    yield
+    if owned:
+        lockgraph.uninstall()
+    new = [c for c in graph.cycles() if tuple(c) not in pre]
+    assert not new, graph.format_cycles()

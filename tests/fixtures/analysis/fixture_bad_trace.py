@@ -1,0 +1,26 @@
+"""Seeded violation: a schedule step that regresses an observer's
+observed location epoch (modeling a buggy cache that re-applies a
+stale observation instead of dropping it).
+
+The model checker's epoch-monotone invariant must catch it, anchored
+at the regressing step's exact line (the marker comment below), and
+the recorded violating trace must replay byte-identically — this
+fixture doubles as the ``--replay`` contract test.
+"""
+
+from sparkrdma_tpu.analysis.modelcheck import World
+
+
+def build(sched):
+    world = World(num_observers=1)
+    sid = world.sid
+    world.observers[0].note_epoch(sid, 5)
+    # the seeded bug: a response handler that writes its stale observed
+    # epoch back instead of keeping the monotone maximum
+    sched.post("resp.stale_overwrite",
+               lambda s: world.observers[0]._epochs.__setitem__(sid, 2),  # seeded-violation
+               chan="obs0.resp", touches={"obs0"})
+    sched.post("bump.e6->obs0",
+               lambda s: world.observers[0].note_epoch(sid, 6),
+               chan="obs0.push", touches={"obs0"})
+    return world

@@ -1,0 +1,22 @@
+"""Seeded violation for the resources epoch-comparison lint: a raw
+``==`` between epoch-typed values (staleness decided by equality where
+only a monotone guard can tell newer from older). The sentinel check
+and the monotone guard below are the allowed forms — the lint must
+flag exactly the marker line."""
+
+EPOCH_DEAD = -1
+
+
+def serve(cached_epoch: int, epoch: int) -> bool:
+    if epoch == EPOCH_DEAD:          # allowed: declared sentinel
+        return False
+    if cached_epoch < epoch:         # allowed: monotone guard
+        return False
+    if cached_epoch == epoch - 1:    # seeded-violation
+        return False
+    return True
+
+
+def tainted(table) -> bool:
+    known = table.get_epoch()
+    return known != table.newest  # seeded-taint

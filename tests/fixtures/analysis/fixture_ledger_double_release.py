@@ -1,0 +1,26 @@
+"""Seeded violation: a TenantLedger double-release.
+
+Two racing teardown paths repay the SAME bytes — the classic
+drop-vs-failure-cleanup race. Because ``TenantLedger.release`` floors
+at zero, the double repayment silently erases ANOTHER tenant item's
+live charge (tenant B's 50 bytes below), which the model checker's
+ledger-conserve invariant catches: usage != live charges.
+
+The model checker must report the violation anchored at the
+dup-release step's exact line (the marker comment below).
+"""
+
+from sparkrdma_tpu.analysis.modelcheck import World
+
+
+def build(sched):
+    world = World(num_observers=1)
+    world.charge(0, 100)   # item A
+    world.charge(0, 50)    # item B (stays live throughout)
+    sched.post("teardown.release_a",
+               lambda s: world.release(0, 100), touches={"ledger"})
+    # the raced second teardown path repays item A AGAIN, straight at
+    # the ledger (no bookkeeping — that is the bug being seeded)
+    sched.post("teardown.dup_release_a",
+               lambda s: world.ledger.release(0, 100), touches={"ledger"})  # seeded-violation
+    return world

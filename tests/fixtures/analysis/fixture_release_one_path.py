@@ -1,0 +1,27 @@
+"""Seeded violation for the resources leak lint: a ledger charge whose
+release exists — but only on ONE path. The quota-rejection branch
+repays; the success path returns with the charge held and nothing
+recorded to repay it later (no ownership transfer, no pragma)."""
+
+
+class LeakyStore:
+    def __init__(self, ledger):
+        self.disk_ledger = ledger
+        self.size = 0
+
+    def keep(self, tenant: int, nbytes: int) -> bool:
+        self.disk_ledger.charge(tenant, nbytes)  # seeded-violation
+        if nbytes > 4096:
+            # oversize: shed and repay — the ONLY path that releases
+            self.disk_ledger.release(tenant, nbytes)
+            return False
+        self.size += nbytes
+        return True
+
+    def paired(self, tenant: int, nbytes: int) -> None:
+        """Control: all-paths release — the lint must stay quiet."""
+        self.disk_ledger.charge(tenant, nbytes)
+        try:
+            self.size += nbytes
+        finally:
+            self.disk_ledger.release(tenant, nbytes)

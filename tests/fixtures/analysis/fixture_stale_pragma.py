@@ -1,0 +1,20 @@
+"""Seeded violation for the stale-pragma audit: an ``unguarded-ok``
+pragma on a write the concurrency lint would no longer flag (the
+attribute is never shared under the class lock), left behind by an
+imaginary refactor. The audit must report the pragma's own line."""
+
+import threading
+
+
+class Refactored:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shared = 0
+        self._private = 0
+
+    def hot(self):
+        with self._lock:
+            self._shared += 1
+
+    def cold(self):
+        self._private = 2  # analysis: unguarded-ok(left behind by refactor)  # seeded-violation

@@ -30,7 +30,8 @@ import numpy as np
 import pytest
 
 import sparkrdma_tpu.analysis as analysis
-from sparkrdma_tpu.analysis import concurrency, core, drift, lockgraph, wire
+from sparkrdma_tpu.analysis import (concurrency, core, drift, lockgraph,
+                                    modelcheck, resources, scheduler, wire)
 
 ROOT = core.repo_root()
 FIXTURES = os.path.join(ROOT, "tests", "fixtures", "analysis")
@@ -68,6 +69,25 @@ def test_wire_registry_is_dense_and_unique():
     assert len(ids) == len(set(ids))
     assert set(ids) | set(wire.rpc_msg.RESERVED_WIRE_IDS) == set(
         range(1, max(ids) + 1))
+
+
+def test_wire_density_over_full_membership_range():
+    """Msgs 36-39 (JoinMsg..DrainResp) closed the id space at 39: the
+    registry + reservations must tile 1..39 exactly, and every
+    membership message must carry _EXTRA_CASES domain corners (epoch 0,
+    max-i64, DRAINING-only vectors) so the fuzzer exercises the signed
+    boundaries the name-based generator avoids."""
+    ids = [t for t, _ in wire.live_pairs()]
+    assert max(ids) == 39
+    assert set(ids) | set(wire.rpc_msg.RESERVED_WIRE_IDS) == set(
+        range(1, 40))
+    for name in ("JoinMsg", "MembershipBumpMsg", "DrainReq", "DrainResp"):
+        assert name in wire._EXTRA_CASES, name
+    corners = [c() for c in wire._EXTRA_CASES["MembershipBumpMsg"]]
+    assert any(m.epoch == 0 for m in corners)
+    assert any(m.epoch == (1 << 63) - 1 for m in corners)
+    assert any(m.slot_states and all(s == 1 for s in m.slot_states)
+               for m in corners)  # DRAINING-only fleet vector
 
 
 def test_wire_doc_table_matches_registry():
@@ -272,6 +292,200 @@ def test_shuffle_e2e_under_lockgraph_is_acyclic(tmp_path):
     assert graph.edges(), "shim recorded nothing — install() broken?"
     new = [c for c in graph.cycles() if tuple(c) not in pre]
     assert not new, graph.format_cycles()
+
+
+# ------------------------------------------------- model checker (pass 5)
+
+def test_modelcheck_catalog_clean_and_enumerates_500():
+    """THE model-check gate: every scenario in the catalog, under the
+    tier-1 default budgets, enumerates schedules with ZERO invariant
+    violations on the live tree — and the catalog covers >= 500
+    distinct DFS schedules, so the sweep is an enumeration, not a
+    sample."""
+    findings, stats = modelcheck.run_catalog()
+    assert not findings, "\n" + core.format_report(findings)
+    total = sum(s.dfs_schedules for s in stats)
+    assert total >= 500, f"only {total} schedules enumerated: {stats}"
+    assert {s.name for s in stats} >= {
+        "pub_tomb_bump", "fence_loser", "finalize_vs_push",
+        "drain_vs_kill", "ttl_vs_late_fetch"}
+
+
+def test_scheduler_fifo_channels_and_por():
+    """Scheduler semantics the checker's soundness rests on: same-chan
+    steps deliver FIFO (never reordered), commuting steps collapse to
+    one canonical schedule, conflicting steps explore both orders."""
+    order = []
+
+    def build_fifo(sched):
+        sched.post("a1", lambda s: order.append("a1"), chan="a")
+        sched.post("a2", lambda s: order.append("a2"), chan="a")
+        sched.post("b1", lambda s: order.append("b1"), chan="b")
+        return None
+
+    runs = scheduler.explore_dfs(build_fifo, lambda st, sc: None)
+    assert len(runs) == 3  # interleavings of [a1,a2] with [b1]
+    for run in runs:
+        assert run.trace.index("a1") < run.trace.index("a2")
+
+    def build_commute(sched):
+        sched.post("x", lambda s: None, touches={"x"})
+        sched.post("y", lambda s: None, touches={"y"})
+        return None
+
+    assert len(scheduler.explore_dfs(build_commute,
+                                     lambda st, sc: None)) == 1
+
+    def build_conflict(sched):
+        sched.post("x", lambda s: None, touches={"shared"})
+        sched.post("y", lambda s: None, touches={"shared"})
+        return None
+
+    assert len(scheduler.explore_dfs(build_conflict,
+                                     lambda st, sc: None)) == 2
+
+
+def test_fixture_ledger_double_release():
+    """The conservation invariant catches a double-release at the
+    seeded step's exact file:line (the floor-at-zero ledger would
+    otherwise silently erase ANOTHER tenant item's live bytes)."""
+    mod = _load_fixture("fixture_ledger_double_release")
+    runs = scheduler.explore_dfs(mod.build, modelcheck.check_invariants)
+    bad = [r for r in runs if r.violation is not None]
+    assert bad and "ledger-conserve" in bad[0].violation
+    path = os.path.join(FIXTURES, "fixture_ledger_double_release.py")
+    apath, aline = modelcheck._anchor_of(bad[0], mod.build)
+    assert apath.endswith("fixture_ledger_double_release.py")
+    assert aline == _marker_line(path)
+
+
+def test_fixture_bad_trace_caught_and_replays_byte_identically():
+    """An invariant-violating schedule is caught at the seeded step's
+    file:line, and its recorded trace replays BYTE-IDENTICALLY with
+    the same violation — the --replay contract."""
+    mod = _load_fixture("fixture_bad_trace")
+    runs = scheduler.explore_dfs(mod.build, modelcheck.check_invariants)
+    bad = [r for r in runs if r.violation is not None]
+    assert bad and "epoch-monotone" in bad[0].violation
+    path = os.path.join(FIXTURES, "fixture_bad_trace.py")
+    apath, aline = modelcheck._anchor_of(bad[0], mod.build)
+    assert apath.endswith("fixture_bad_trace.py")
+    assert aline == _marker_line(path)
+    replayed = scheduler.replay(mod.build, modelcheck.check_invariants,
+                                bad[0].trace)
+    assert replayed.trace == bad[0].trace  # byte-identical reproduction
+    assert replayed.violation == bad[0].violation
+
+
+def test_modelcheck_trace_artifact_roundtrip(tmp_path, monkeypatch):
+    """run_catalog dumps a violating trace artifact and replay_trace
+    re-runs it: seed a violating scenario into the catalog, then
+    replay the dumped JSON byte-identically."""
+    mod = _load_fixture("fixture_bad_trace")
+    scn = modelcheck.Scenario("fixture_bad_trace", mod.build)
+    monkeypatch.setattr(modelcheck, "_CATALOG",
+                        modelcheck._CATALOG + [scn])
+    findings, _stats = modelcheck.run_catalog(trace_dir=str(tmp_path))
+    assert findings and "fixture_bad_trace" in findings[-1].message
+    artifact = tmp_path / "fixture_bad_trace.trace.json"
+    assert artifact.exists()
+    run = modelcheck.replay_trace(str(artifact))
+    assert run.violation is not None and "epoch-monotone" in run.violation
+
+
+# --------------------------------------------- resource contracts (pass 6)
+
+def test_fixture_release_on_one_path_only():
+    path = os.path.join(FIXTURES, "fixture_release_one_path.py")
+    with open(path) as f:
+        findings, _used = resources.scan_leaks(f.read(), path)
+    leaks = [f for f in findings if "not released on every path"
+             in f.message]
+    assert leaks, core.format_report(findings)
+    assert leaks[0].line == _marker_line(path)
+    assert len(leaks) == 1  # the all-paths control stays quiet
+
+
+def test_fixture_raw_epoch_equality():
+    path = os.path.join(FIXTURES, "fixture_epoch_eq.py")
+    with open(path) as f:
+        findings, _used = resources.scan_epoch_compares(f.read(), path)
+    hits = [f for f in findings if "raw ==/!=" in f.message]
+    assert hits, core.format_report(findings)
+    assert hits[0].line == _marker_line(path)
+    # one-hop taint: `known = table.get_epoch()` makes `known` epoch-
+    # typed, so the later != is flagged too — and nothing else is
+    assert hits[1].line == _marker_line(path, "seeded-taint")
+    assert len(hits) == 2
+
+
+def test_fixture_stale_pragma():
+    """A pragma the lint no longer needs is itself a finding at the
+    pragma's own line (dead pragmas claim hazards that are gone)."""
+    path = os.path.join(FIXTURES, "fixture_stale_pragma.py")
+    with open(path) as f:
+        findings = concurrency.scan_source(f.read(), path)
+    stale = [f for f in findings if "stale pragma" in f.message]
+    assert stale, core.format_report(findings)
+    assert stale[0].line == _marker_line(path)
+    assert len(findings) == 1  # the live pragma on hot() doesn't exist
+
+
+def test_leak_lint_structural_coverage():
+    """All-paths analysis unit corners: try/finally release is clean;
+    release in only the except arm is a leak; release before every
+    return/raise is clean."""
+    clean_finally = (
+        "class C:\n"
+        "    def f(self, ledger, n):\n"
+        "        ledger.charge(0, n)\n"
+        "        try:\n"
+        "            work()\n"
+        "        finally:\n"
+        "            ledger.release(0, n)\n")
+    findings, _ = resources.scan_leaks(clean_finally, "<mem>")
+    assert not findings, core.format_report(findings)
+
+    leak_except_only = (
+        "class C:\n"
+        "    def f(self, ledger, n):\n"
+        "        ledger.charge(0, n)\n"
+        "        try:\n"
+        "            return work()\n"
+        "        except Exception:\n"
+        "            ledger.release(0, n)\n"
+        "            raise\n")
+    findings, _ = resources.scan_leaks(leak_except_only, "<mem>")
+    assert len(findings) == 1 and findings[0].line == 3
+
+    clean_both_arms = (
+        "class C:\n"
+        "    def f(self, ledger, n, ok):\n"
+        "        ledger.charge(0, n)\n"
+        "        if ok:\n"
+        "            ledger.release(0, n)\n"
+        "            return True\n"
+        "        ledger.release(0, n)\n"
+        "        return False\n")
+    findings, _ = resources.scan_leaks(clean_both_arms, "<mem>")
+    assert not findings, core.format_report(findings)
+
+
+def test_epoch_lint_monotone_and_sentinel_allowed():
+    src = ("EPOCH_DEAD = -1\n"
+           "def f(epoch, prev_epoch):\n"
+           "    if epoch == EPOCH_DEAD:\n"
+           "        return None\n"
+           "    if epoch <= prev_epoch:\n"
+           "        return False\n"
+           "    return True\n")
+    findings, _ = resources.scan_epoch_compares(src, "<mem>")
+    assert not findings, core.format_report(findings)
+    src_eq = ("class M:\n"
+              "    def __eq__(self, other):\n"
+              "        return self.epoch == other.epoch\n")
+    findings, _ = resources.scan_epoch_compares(src_eq, "<mem>")
+    assert not findings, core.format_report(findings)
 
 
 # ------------------------------------------------------------ CLI + gated

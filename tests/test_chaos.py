@@ -93,18 +93,8 @@ def _chaos_lockgraph():
     if not LOCKGRAPH:
         yield
         return
-    from sparkrdma_tpu.analysis import lockgraph
-
-    owned = lockgraph.current() is None  # ANALYSIS_LOCKGRAPH may own it
-    graph = lockgraph.install()
-    # under a session-wide shim the graph is shared: blame only cycles
-    # that appear DURING this module (pre-existing ones fail elsewhere)
-    pre = {tuple(c) for c in graph.cycles()}
-    yield
-    if owned:
-        lockgraph.uninstall()
-    new = [c for c in graph.cycles() if tuple(c) not in pre]
-    assert not new, graph.format_cycles()
+    from engine_helpers import lockgraph_module_guard
+    yield from lockgraph_module_guard()
 
 
 def _conf(**kw):

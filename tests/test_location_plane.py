@@ -78,6 +78,43 @@ def test_plane_epoch_validity_rules():
     assert p.locations(7, 0, 0, 4) == ["locs"]
     assert p.note_epoch(7, 3) is True
     assert p.locations(7, 0, 0, 4) is None
+
+
+def test_dead_shuffle_stays_dead_against_late_responses():
+    """The modelcheck ttl_vs_late_fetch fix: after the EPOCH_DEAD push
+    is processed, a LATE response stamped with the pre-death epoch must
+    not resurrect any cached view — the epoch record is gone, so only
+    the dead marker can recognize the staleness. A pushed registration
+    signal (note_registered) or a pushed positive bump re-arms the id
+    for reuse; responses never do."""
+    p = LocationPlane()
+    t = DriverTable(1)
+    t.publish(0, 5, 0)
+    p.put_table(7, t, 1)
+    p.note_epoch(7, EPOCH_DEAD)
+    assert p.table(7) is None
+    # late responses from before the death: all dropped as stale
+    p.put_table(7, t, 1)
+    assert p.table(7) is None
+    p.put_locations(7, 0, 0, 1, ["locs"], 1)
+    assert p.locations(7, 0, 0, 1) is None
+    p.put_merged(7, object(), 1)
+    assert p.merged(7) is None
+
+    class _Plan:
+        plan_epoch = 1
+    assert p.put_plan(7, _Plan()) is False
+    assert p.plan(7) is None
+    assert p.snapshot()["dead"] == 1
+    # a pushed registration signal re-arms the reused id
+    p.note_registered(7)
+    p.put_table(7, t, 1)
+    assert p.table(7) is not None
+    # ... and so does a pushed positive bump (FIFO: it postdates death)
+    p.note_epoch(7, EPOCH_DEAD)
+    assert p.note_epoch(7, 1) is False  # fresh incarnation, nothing cached
+    p.put_table(7, t, 1)
+    assert p.table(7) is not None
     # EPOCH_DEAD drops everything including the observation
     p.put_locations(7, 0, 0, 4, ["locs"], 3)
     p.note_epoch(7, EPOCH_DEAD)

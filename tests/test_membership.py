@@ -30,6 +30,22 @@ from sparkrdma_tpu.shuffle.tenancy import AdmissionController
 CONF = dict(connect_timeout_ms=2000, max_connection_attempts=2,
             pre_warm_connections=False)
 
+# CHAOS_LOCKGRAPH=1: run the elastic-churn suite under the lock-order
+# shim (sparkrdma_tpu/analysis/lockgraph.py), mirroring the
+# tests/test_chaos.py hook — join/drain/retire/autoscale drive the
+# membership plane's rare teardown paths, exactly where lock-order
+# inversions hide. Any cycle fails the module.
+LOCKGRAPH = os.environ.get("CHAOS_LOCKGRAPH", "0") not in ("0", "false")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _membership_lockgraph():
+    if not LOCKGRAPH:
+        yield
+        return
+    from engine_helpers import lockgraph_module_guard
+    yield from lockgraph_module_guard()
+
 
 def _mk_conf(**kw):
     base = dict(CONF)

@@ -160,6 +160,41 @@ def test_merge_store_ledger_fencing_and_finalize(tmp_path):
         store.drop_shuffle(1)
         store.drop_shuffle(2)
         assert not list((tmp_path / "s" / "merge").glob("seg_*"))
+        # the modelcheck finalize_vs_push fix: a push racing the
+        # unregister broadcast lands AFTER drop_shuffle — it must be
+        # refused (FINALIZED), not re-create state and charge disk
+        # bytes nothing will ever release
+        status, acc = store.push(1, 4, fence=9, start_partition=0,
+                                 sizes=[2], data=b"zz")
+        assert status == M.STATUS_FINALIZED and acc == b"\x00"
+        status, token = store.push_overflow(1, 4, 9, b"blob")
+        assert status == M.STATUS_FINALIZED and token == 0
+        assert resolver.disk_ledger.usage(0) == 0
+        assert not list((tmp_path / "s" / "merge").glob("seg_1_*"))
+        # a pushed registration signal re-arms the reused id
+        store.note_registered(1)
+        status, acc = store.push(1, 0, fence=1, start_partition=0,
+                                 sizes=[2], data=b"ok")
+        assert (status, acc) == (M.STATUS_OK, b"\x01")
+        store.drop_shuffle(1)
+        # push_overflow's drop window: the unregister lands BETWEEN the
+        # entry check and the final record (blob written + registered
+        # outside the lock) — the call must unwind its charge, its
+        # external registration, and the blob, not park zombie bytes
+        orig_register = resolver.register_external
+
+        def register_then_drop(sid, path, length, **kw):
+            token = orig_register(sid, path, length, **kw)
+            store.drop_shuffle(sid)  # the broadcast wins the window
+            return token
+        resolver.register_external = register_then_drop
+        try:
+            status, token = store.push_overflow(5, 0, 1, b"blob")
+        finally:
+            resolver.register_external = orig_register
+        assert status == M.STATUS_FINALIZED and token == 0
+        assert resolver.disk_ledger.usage(0) == 0
+        assert not list((tmp_path / "s" / "merge").glob("ovf_5_*"))
     finally:
         store.stop()
         resolver.stop()
