@@ -472,6 +472,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("tenant isolation done")
     _bench_elastic(detail)
     _progress("elastic drain done")
+    _bench_pushplan(detail)
+    _progress("planned push done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -795,6 +797,42 @@ def _bench_elastic(detail: dict) -> None:
         detail["drain_makespan_delta_s"] = res["makespan_delta_s"]
     except Exception as e:  # noqa: BLE001
         detail["elastic_drain_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_pushplan(detail: dict) -> None:
+    """The sender-driven planned shuffle's win, measured without
+    hardware: the same reduce partitions drained at their PLANNED slots
+    twice under a fixed per-frame service delay standing in for wire
+    latency — once pulling (driver-table RPC + per-map block fetches)
+    and once from the pushed staging landed during the map stage
+    (shuffle/pushplan_bench.py). Gates: byte-identical output and ZERO
+    metadata + ZERO data RPCs for the fully-pushed read, counted
+    server-side across the whole cluster. ``pushplan_speedup`` is
+    reduce-stage start-to-first-row, the latency the push moved off the
+    reduce critical path. Pure host path — identical on TPU and
+    CPU-fallback records."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.pushplan_bench import (
+            run_pushplan_microbench)
+
+        with tempfile.TemporaryDirectory(prefix="pushplanbench_") as td:
+            res = run_pushplan_microbench(td, reps=2)
+        if not res["identical"]:
+            detail["pushplan_error"] = \
+                "push and pull reads fetched different bytes"
+            return
+        if res["rpcs"]["push"]["meta"] or res["rpcs"]["push"]["data"]:
+            detail["pushplan_error"] = (
+                f"fully-pushed read still hit the wire: {res['rpcs']['push']}")
+            return
+        detail["pushplan_speedup"] = res["pushplan_speedup"]
+        detail["pushplan_makespan_speedup"] = res["makespan_speedup"]
+        detail["pushplan_first_row_s"] = res["first_row_s"]
+        detail["pushplan_rpcs"] = res["rpcs"]
+    except Exception as e:  # noqa: BLE001
+        detail["pushplan_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _bench_tenant_isolation(detail: dict) -> None:

@@ -44,6 +44,16 @@
 #                               decommission cross every injected
 #                               fault; the dedicated 4->8->4 and
 #                               drainee-death scenarios run regardless)
+#   CHAOS_PUSHPLAN_MODES="0 1"  planned-push modes to sweep (default
+#                               both: off, and CHAOS_PUSHPLAN=1 so the
+#                               byte-identity matrices run with
+#                               sender-driven planned pushes racing the
+#                               faulted reduce in the background —
+#                               plan publish, push fences, staged-first
+#                               resolution, and hole fallback cross
+#                               every injected fault; the dedicated
+#                               kill-the-planned-reducer scenario runs
+#                               regardless)
 #   CHAOS_TENANT_MODES="0 1"    tenancy modes to sweep (default both:
 #                               off, and CHAOS_TENANT=1 so every
 #                               shuffle registers under a real tenant
@@ -67,12 +77,14 @@ MODES=${CHAOS_COALESCE_MODES:-"1 0"}
 WARM_MODES=${CHAOS_WARM_MODES:-"1 0"}
 SKEW_MODES=${CHAOS_SKEW_MODES:-"0 1"}
 MERGE_MODES=${CHAOS_MERGE_MODES:-"0 1"}
+PUSHPLAN_MODES=${CHAOS_PUSHPLAN_MODES:-"0 1"}
 TENANT_MODES=${CHAOS_TENANT_MODES:-"0 1"}
 ELASTIC_MODES=${CHAOS_ELASTIC_MODES:-"0 1"}
 DISK=${CHAOS_DISK:-1}
 failed=()
 for elastic in $ELASTIC_MODES; do
 for tenant in $TENANT_MODES; do
+for pushplan in $PUSHPLAN_MODES; do
 for merge in $MERGE_MODES; do
 for skew in $SKEW_MODES; do
 for warm in $WARM_MODES; do
@@ -80,25 +92,29 @@ for coalesce in $MODES; do
   for seed in $SEEDS; do
     echo "=== chaos sweep: seed ${seed} coalesce=${coalesce}" \
          "warm=${warm} skew=${skew} merge=${merge}" \
-         "tenant=${tenant} elastic=${elastic} disk=${DISK} ==="
+         "pushplan=${pushplan} tenant=${tenant} elastic=${elastic}" \
+         "disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
          CHAOS_WARM="${warm}" CHAOS_SKEW="${skew}" \
-         CHAOS_MERGE="${merge}" CHAOS_TENANT="${tenant}" \
+         CHAOS_MERGE="${merge}" CHAOS_PUSHPLAN="${pushplan}" \
+         CHAOS_TENANT="${tenant}" \
          CHAOS_ELASTIC="${elastic}" CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
            -p no:cacheprovider -p no:randomly; then
       echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm}" \
-           "skew=${skew} merge=${merge} tenant=${tenant}" \
-           "elastic=${elastic} FAILED — replay with:"
+           "skew=${skew} merge=${merge} pushplan=${pushplan}" \
+           "tenant=${tenant} elastic=${elastic} FAILED — replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
            "CHAOS_WARM=${warm} CHAOS_SKEW=${skew}" \
-         "CHAOS_MERGE=${merge} CHAOS_TENANT=${tenant}" \
+         "CHAOS_MERGE=${merge} CHAOS_PUSHPLAN=${pushplan}" \
+           "CHAOS_TENANT=${tenant}" \
            "CHAOS_ELASTIC=${elastic} CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}t${tenant}e${elastic}")
+      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}")
     fi
   done
+done
 done
 done
 done
@@ -112,4 +128,5 @@ if [ "${#failed[@]}" -gt 0 ]; then
 fi
 echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
      "planes, both reduce-planning modes, both push-merge modes," \
-     "both tenancy modes, both elastic-membership modes (disk=${DISK})"
+     "both planned-push modes, both tenancy modes, both" \
+     "elastic-membership modes (disk=${DISK})"

@@ -212,6 +212,88 @@ class MergeTargetModel:
         self.rows.clear()
 
 
+class PushedStoreModel:
+    """One planned-push target's staging discipline — the in-memory
+    mirror of ``pushed_store.PushedInputStore`` semantics (plan-epoch
+    fence acceptance, per-(partition, map) attempt-fence dedupe,
+    charge-on-accept / release-on-supersede / release-on-drop, dropped
+    tombstone) with a real :class:`TenantLedger` underneath.
+
+    The two safety properties the ``push_vs_*`` scenarios enumerate
+    schedules against:
+
+    * a push stamped with a plan epoch OLDER than one the store has
+      adopted is rejected (and once a newer epoch is adopted, every
+      staged range of an older epoch is superseded — released and
+      unavailable), so a reducer can NEVER consume a stale-plan range;
+    * a push racing the drop broadcast must not leak a ledger charge
+      nothing will ever release (checked by ledger-conserve).
+    """
+
+    def __init__(self, world: World, tenant: int = 0):
+        self.world = world
+        self.tenant = tenant
+        self.plan_epoch = 0
+        # (partition, map) -> (fence, plan_epoch, nbytes)
+        self.rows: Dict[Tuple[int, int], Tuple[int, int, int]] = {}
+        self.dropped = False
+
+    def push(self, partition: int, map_id: int, fence: int,
+             plan_epoch: int, nbytes: int) -> bool:
+        if self.dropped:
+            # dropped tombstone: a push racing the unregister broadcast
+            # must not re-charge staging nothing will ever release
+            return False
+        if plan_epoch < self.plan_epoch:
+            return False  # stale-plan push: the re-plan superseded it
+        if plan_epoch > self.plan_epoch:
+            self.on_plan(plan_epoch)  # pushes may beat the broadcast
+        prev = self.rows.get((partition, map_id))
+        if prev is not None:
+            if fence <= prev[0]:
+                return False  # duplicate or stale attempt's push
+            self.world.release(self.tenant, prev[2])
+        self.world.charge(self.tenant, nbytes)
+        self.rows[(partition, map_id)] = (fence, plan_epoch, nbytes)
+        return True
+
+    def on_plan(self, plan_epoch: int) -> None:
+        """A (re-)plan landed: adopt the newer epoch and supersede every
+        staged range of an older one — released exactly once."""
+        if self.dropped or plan_epoch <= self.plan_epoch:
+            return
+        self.plan_epoch = plan_epoch
+        stale = [k for k, v in self.rows.items() if v[1] < plan_epoch]
+        for k in stale:
+            self.world.release(self.tenant, self.rows.pop(k)[2])
+
+    def consume(self, partition: int) -> Dict[int, int]:
+        """The reducer's pushed-first read: every served range must be
+        stamped with the store's CURRENT plan epoch — anything else is
+        the stale-push consumption the plan fence exists to prevent."""
+        if self.dropped:
+            return {}
+        out = {}
+        for (p, m), (_fence, epoch, nbytes) in self.rows.items():
+            if p != partition:
+                continue
+            if epoch != self.plan_epoch:
+                self.world.problem = (
+                    f"pushed-fence: consumed partition {p} map {m} "
+                    f"range at plan epoch {epoch} != store epoch "
+                    f"{self.plan_epoch} (stale-plan push served)")
+            out[m] = nbytes
+        return out
+
+    def drop(self) -> None:
+        if self.dropped:
+            return
+        self.dropped = True
+        for _fence, _epoch, nbytes in self.rows.values():
+            self.world.release(self.tenant, nbytes)
+        self.rows.clear()
+
+
 # ------------------------------------------------------------- invariants
 
 def check_invariants(world: World,
@@ -540,6 +622,95 @@ def _build_ttl_vs_late_fetch(sched: VirtualScheduler) -> World:
                    chan=f"obs{i}.push", touches={f"obs{i}"})
     # touches covers the EPOCH_DEAD pushes it fans out (POR contract)
     sched.post("ttl.sweep", sweep, touches={"driver", "obs0", "obs1"})
+    return world
+
+
+@scenario("push_vs_replan",
+          "planned pushes race a mid-stage re-plan: a stale-plan-epoch "
+          "push must never be consumed, and supersession must release "
+          "staged charges exactly once")
+def _build_push_vs_replan(sched: VirtualScheduler) -> World:
+    world = World(num_observers=1, num_maps=2)
+    store = PushedStoreModel(world, tenant=5)
+    store.on_plan(1)
+    # epoch-1 planned pushes from two map executors (own connections),
+    # including a duplicate re-delivery and a late straggler that can
+    # land after the re-plan broadcast
+    sched.post("push.m0.e1",
+               lambda s: store.push(0, 0, fence=1, plan_epoch=1,
+                                    nbytes=100),
+               chan="pusher0", touches={"pushed"})
+    sched.post("repush.m0.e1",
+               lambda s: store.push(0, 0, fence=1, plan_epoch=1,
+                                    nbytes=100),
+               chan="pusher0", touches={"pushed"})
+    sched.post("push.m1.e1",
+               lambda s: store.push(0, 1, fence=1, plan_epoch=1,
+                                    nbytes=60),
+               chan="pusher1", touches={"pushed"})
+    # the driver's re-plan rides the broadcast channel; the re-pushed
+    # epoch-2 ranges ride the pushers' own channels and may arrive
+    # BEFORE the broadcast (the store adopts the newer epoch either way)
+    sched.post("bcast.replan.e2", lambda s: store.on_plan(2),
+               chan="drv.bcast", touches={"pushed"})
+    sched.post("push.m0.e2",
+               lambda s: store.push(0, 0, fence=2, plan_epoch=2,
+                                    nbytes=120),
+               chan="pusher0", touches={"pushed"})
+    sched.post("push.m1.e2",
+               lambda s: store.push(0, 1, fence=2, plan_epoch=2,
+                                    nbytes=80),
+               chan="pusher1", touches={"pushed"})
+    # the reducer's pushed-first resolution can fire at any point in the
+    # race; whatever it sees must be stamped with the store's current
+    # plan epoch (the consume() check sets world.problem otherwise)
+    sched.post("reduce.consume.p0", lambda s: store.consume(0),
+               chan="reducer", touches={"pushed"})
+    return world
+
+
+@scenario("push_vs_tombstone",
+          "planned pushes race the shuffle's drop broadcast: when the "
+          "drop wins, a late push must not leak a staging charge, and "
+          "nothing may serve from the dropped store")
+def _build_push_vs_tombstone(sched: VirtualScheduler) -> World:
+    world = World(num_observers=1, num_maps=2)
+    store = PushedStoreModel(world, tenant=6)
+    store.on_plan(1)
+    sched.post("push.m0.f1",
+               lambda s: store.push(0, 0, fence=1, plan_epoch=1,
+                                    nbytes=100),
+               chan="pusher0", touches={"pushed"})
+    sched.post("push.m1.f1",
+               lambda s: store.push(0, 1, fence=1, plan_epoch=1,
+                                    nbytes=60),
+               chan="pusher0", touches={"pushed"})
+    # a re-executed attempt's superseding push on its own connection
+    sched.post("push.m0.f2",
+               lambda s: store.push(0, 0, fence=2, plan_epoch=1,
+                                    nbytes=120),
+               chan="pusher1", touches={"pushed"})
+    # TTL sweep / unregister: the drop broadcast then the EPOCH_DEAD
+    # delivery ride the driver's FIFO broadcast channel
+    def drop(s):
+        world.unregister()
+        store.drop()
+        s.post("dead->obs0", lambda s2: world.deliver_dead(0),
+               chan="obs0.push", touches={"obs0"})
+    sched.post("bcast.drop", drop, chan="drv.bcast",
+               touches={"pushed", "obs0"})
+    # a straggler push that can land AFTER the drop (must not charge)
+    # and a post-drop consume (must serve nothing)
+    sched.post("push.m1.f1.late",
+               lambda s: store.push(0, 1, fence=1, plan_epoch=1,
+                                    nbytes=60),
+               chan="pusher1", touches={"pushed"})
+    def consume(s):
+        if store.consume(0) and store.dropped:
+            world.problem = ("pushed-fence: dropped store served "
+                            "staged ranges")
+    sched.post("reduce.consume.p0", consume, chan="reducer",
+               touches={"pushed"})
     return world
 
 

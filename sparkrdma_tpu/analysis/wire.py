@@ -239,6 +239,18 @@ _EXTRA_CASES: Dict[str, List[Callable[[], "rpc_msg.RpcMsg"]]] = {
         lambda: M.DrainResp(3, M.STATUS_ERROR, 0, 0),
         lambda: M.DrainResp(1, M.STATUS_OK, (1 << 63) - 1,
                             (1 << 63) - 1)],
+    # planned-push corners: plan epoch 0 (the identity plan — a sender
+    # that pushed before any broadcast landed), max-i64 plan epoch and
+    # attempt fence together (both ride signed <q packs), a zero-size
+    # range entry inside a run (empty partition still holds its slot in
+    # the accept vector), and an all-rejected verdict
+    "PushPlannedReq": [
+        lambda: M.PushPlannedReq(1, 2, 3, 0, 0, 0, [], b""),
+        lambda: M.PushPlannedReq(1, 2, 3, (1 << 63) - 1, (1 << 63) - 1,
+                                 5, [4, 0, 8], b"x" * 12)],
+    "PushPlannedResp": [
+        lambda: M.PushPlannedResp(1, M.STATUS_UNKNOWN_SHUFFLE, b""),
+        lambda: M.PushPlannedResp(1, M.STATUS_OK, b"\x00\x00\x00")],
 }
 
 

@@ -390,6 +390,28 @@ _KEYS = [
              "pushed blocks that would grow a segment past this are "
              "rejected (their maps stay per-map-fetched for that "
              "partition), bounding merge-target disk per partition."),
+    # --- planned push (TPU-only: shuffle/pushed_store.py,
+    # docs/CONFIG.md "Planned push")
+    _Key("planned_push", False, "bool",
+         doc="Sender-driven planned shuffle: once the ReducePlan lands "
+             "(requires adaptive_plan), each committed map's bytes are "
+             "pushed during the map stage to the PLANNED reducer slot "
+             "for every unsplit partition (PushPlannedReq, double-"
+             "fenced: attempt fence + plan epoch). The receiving "
+             "PushedInputStore stages the ranges and the fetcher "
+             "resolves them FIRST — a reducer whose inputs all arrived "
+             "starts with zero metadata and zero data RPCs; any hole "
+             "(dropped push, re-plan, over-budget shed) falls back to "
+             "the merged/per-map dataplanes byte-identically. Off by "
+             "default: pushes cost one extra copy of the shuffle's "
+             "bytes on the wire."),
+    _Key("push_staging_budget", "64m", "bytes", 0, 1 << 44,
+         doc="Per-executor budget for planned-push staging held in "
+             "BufferPool leases: pushed ranges past it spill to disk "
+             "under <spill_dir>/pushed/, charged to the owning tenant's "
+             "spill quota (tenant_spill_quota) — a range neither budget "
+             "admits is shed, and its partitions stay pull-fetched. "
+             "0 sends every pushed range straight to disk."),
     # --- device exchange dataplane (TPU-only: parallel/device_plane.py,
     # docs/CONFIG.md "Device exchange")
     _Key("device_plane", "auto", "str",

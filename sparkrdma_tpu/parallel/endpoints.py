@@ -1320,6 +1320,14 @@ class ExecutorEndpoint:
         # MergeStore here when push_merge is on; pushes/finalizes run on
         # the serve pool (disk appends must never block a reader thread)
         self.merge_store = None
+        # planned push (shuffle/pushed_store.py): the manager installs a
+        # PushedInputStore here when planned_push is on; the fetcher
+        # resolves it FIRST, before merged segments and per-map pull
+        self.pushed_store = None
+        # the planned pusher's plan hook (SegmentPusher.on_plan): called
+        # when a ReducePlanMsg lands so submitted maps whose plan
+        # arrived late (or re-planned) re-push to their planned slots
+        self.on_plan_cb = None
         # tenancy (shuffle/tenancy.py): shuffle -> owning tenant, taught
         # by the driver's TenantMapMsg push and locally by the manager's
         # handle path; keys the serve loop's fair-share queue. The DRR
@@ -1926,6 +1934,8 @@ class ExecutorEndpoint:
                 # a fresh registration reusing a dropped id re-arms the
                 # merge target (same FIFO channel as the unregister)
                 self.merge_store.note_registered(msg.shuffle_id)
+            if self.pushed_store is not None:
+                self.pushed_store.note_registered(msg.shuffle_id)
             self.location_plane.note_registered(msg.shuffle_id)
             return None
         if isinstance(msg, M.ReducePlanMsg):
@@ -1938,6 +1948,8 @@ class ExecutorEndpoint:
             self.location_plane.note_registered(msg.shuffle_id)
             if self.merge_store is not None:
                 self.merge_store.note_registered(msg.shuffle_id)
+            if self.pushed_store is not None:
+                self.pushed_store.note_registered(msg.shuffle_id)
             self.location_plane.put_shard_map(
                 msg.shuffle_id, ShardMap(msg.num_maps, msg.shard_slots),
                 msg.epoch)
@@ -1958,6 +1970,9 @@ class ExecutorEndpoint:
             return None
         if isinstance(msg, M.PushBlocksReq):
             self._serve_async(self._on_push_blocks, conn, msg)
+            return None
+        if isinstance(msg, M.PushPlannedReq):
+            self._serve_async(self._on_push_planned, conn, msg)
             return None
         if isinstance(msg, M.FinalizeSegmentsReq):
             # NOT the serve pool: the quiesce wait can hold a worker for
@@ -1985,8 +2000,8 @@ class ExecutorEndpoint:
         if isinstance(msg, (M.FetchOutputResp, M.FetchOutputsResp,
                             M.FetchTableResp, M.FetchShardResp,
                             M.FetchPlanResp, M.PushBlocksResp,
-                            M.FinalizeSegmentsResp, M.FetchMergedResp,
-                            M.DrainResp)):
+                            M.PushPlannedResp, M.FinalizeSegmentsResp,
+                            M.FetchMergedResp, M.DrainResp)):
             # orphan of a cancelled/timed-out request (the fetcher
             # cancels whole read-ahead windows on failure); unlike block
             # responses these carry no credits, so dropping is complete
@@ -2043,12 +2058,20 @@ class ExecutorEndpoint:
         instead of serving a dead executor's locations."""
         invalidated = self.location_plane.note_epoch(msg.shuffle_id,
                                                      msg.epoch)
+        if self.pushed_store is not None and msg.epoch != M.EPOCH_DEAD:
+            # a location-epoch ADVANCE names a recovery event: staged
+            # pushed ranges conservatively drop (a corrupt-output repair
+            # may rewrite bytes; re-pushes re-stage under new fences)
+            self.pushed_store.on_location_epoch(msg.shuffle_id, msg.epoch)
         if msg.epoch == M.EPOCH_DEAD:
             self.shard_store.drop(msg.shuffle_id)
             self._expire_shard_waiters(msg.shuffle_id)
             if self.merge_store is not None:
                 # merged segments + overflow blobs die with the shuffle
                 self.merge_store.drop_shuffle(msg.shuffle_id)
+            if self.pushed_store is not None:
+                # staged pushed ranges die with the shuffle too
+                self.pushed_store.drop_shuffle(msg.shuffle_id)
             src = self.data_source
             if src is not None and hasattr(src, "remove_shuffle"):
                 # shuffle TTL/GC: a driver-side unregister (explicit or
@@ -2099,6 +2122,19 @@ class ExecutorEndpoint:
         accepted = self.location_plane.put_plan(plan.shuffle_id, plan)
         if not accepted:
             return  # stale reordered push: must not touch warm state
+        if self.pushed_store is not None:
+            # adopt the plan epoch: staged ranges a re-plan orphaned are
+            # released here (their new slots get the replayed pushes)
+            self.pushed_store.on_plan(plan.shuffle_id, plan.plan_epoch)
+        if self.on_plan_cb is not None:
+            # the planned pusher replays submitted maps against the
+            # fresh plan (late-arriving plan, or re-plan re-routing)
+            try:
+                self.on_plan_cb(plan.shuffle_id)
+            except Exception:  # noqa: BLE001 — a replay failure must
+                # not drop the plan push (maps stay pull-fetched)
+                log.exception("planned-push replay for shuffle %d failed",
+                              plan.shuffle_id)
         from sparkrdma_tpu.shuffle import dist_cache
         dist_cache.on_plan_epoch(plan.shuffle_id, plan.plan_epoch)
         if plan.plan_epoch > 1:
@@ -2568,6 +2604,37 @@ class ExecutorEndpoint:
             conn.send(resp)
         except TransportError as e:
             log.debug("push response lost: %s", e)
+
+    def _on_push_planned(self, conn: Connection,
+                         msg: "M.PushPlannedReq") -> None:
+        store = self.pushed_store
+        if store is None:
+            # feature off here: FINALIZED stops the sender for good (a
+            # mixed-version fleet degrades to pull, never errors)
+            resp = M.PushPlannedResp(msg.req_id, M.STATUS_FINALIZED, b"")
+        else:
+            status, accepted = store.push(
+                msg.shuffle_id, msg.map_id, msg.fence, msg.plan_epoch,
+                msg.start_partition, msg.sizes, msg.data)
+            resp = M.PushPlannedResp(msg.req_id, status, accepted)
+        try:
+            conn.send(resp)
+        except TransportError as e:
+            log.debug("planned-push response lost: %s", e)
+
+    def push_planned(self, peer: ShuffleManagerId, shuffle_id: int,
+                     map_id: int, fence: int, plan_epoch: int,
+                     start_partition: int, sizes, data: bytes
+                     ) -> "M.PushPlannedResp":
+        """Client half of the planned-push protocol (SegmentPusher)."""
+        conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+        resp = conn.request(
+            M.PushPlannedReq(conn.next_req_id(), shuffle_id, map_id,
+                             fence, plan_epoch, start_partition,
+                             list(sizes), data),
+            timeout=self.conf.resolved_request_deadline_s())
+        assert isinstance(resp, M.PushPlannedResp)
+        return resp
 
     def _on_finalize_segments(self, conn: Connection,
                               msg: "M.FinalizeSegmentsReq") -> None:

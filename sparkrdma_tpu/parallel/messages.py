@@ -734,6 +734,9 @@ PUSH_KIND_OVERFLOW = 1  # tiered-spill overflow blob (fetched back at merge)
 PUSH_KIND_DRAIN = 2     # drain re-push: like MERGE, but may REOPEN an
 #                         already-finalized segment (the driver
 #                         re-finalizes after the drainee's DrainResp)
+PUSH_KIND_PLANNED = 3   # planned push: reduce inputs to their PLANNED
+#                         reducer slot (PushPlannedReq, plan-epoch
+#                         fenced), not to a merge-range peer
 
 
 @register()
@@ -803,6 +806,74 @@ class PushBlocksResp(RpcMsg):
     def from_payload(cls, payload: bytes) -> "PushBlocksResp":
         req_id, status, token = struct.unpack_from("<qiq", payload, 0)
         return cls(req_id, status, token, payload[20:])
+
+
+@register()
+class PushPlannedReq(RpcMsg):
+    """Executor -> PLANNED reducer slot: one committed map's bytes for
+    the contiguous partition range the receiver's plan task owns, pushed
+    during the map stage so the reduce stage starts with the inputs
+    already local. Double-fenced: ``fence`` is the committing attempt's
+    fencing token (a newer attempt's push supersedes a stale one for the
+    same ``(partition, map)``, exactly the merge-ledger discipline) and
+    ``plan_epoch`` stamps the ReducePlan the sender routed by — the
+    receiving PushedInputStore rejects pushes older than its plan epoch
+    and releases every staged range stamped older when a re-plan lands,
+    so a mid-stage re-plan supersedes stale pushes and orphaned tasks
+    re-pull. ``data`` is the concatenation of the ``sizes`` segments in
+    partition order starting at ``start_partition``."""
+
+    def __init__(self, req_id: int, shuffle_id: int, map_id: int,
+                 fence: int, plan_epoch: int, start_partition: int,
+                 sizes: List[int], data: bytes):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.fence = fence
+        self.plan_epoch = plan_epoch
+        self.start_partition = start_partition
+        self.sizes = list(sizes)
+        self.data = data
+
+    def payload(self) -> bytes:
+        head = (struct.pack("<qiiqq", self.req_id, self.shuffle_id,
+                            self.map_id, self.fence, self.plan_epoch)
+                + struct.pack("<iI", self.start_partition,
+                              len(self.sizes))
+                + struct.pack(f"<{len(self.sizes)}I", *self.sizes))
+        return head + self.data
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "PushPlannedReq":
+        (req_id, shuffle_id, map_id, fence,
+         plan_epoch) = struct.unpack_from("<qiiqq", payload, 0)
+        start, n = struct.unpack_from("<iI", payload, 32)
+        sizes = list(struct.unpack_from(f"<{n}I", payload, 40))
+        return cls(req_id, shuffle_id, map_id, fence, plan_epoch, start,
+                   sizes, payload[40 + 4 * n:])
+
+
+@register()
+class PushPlannedResp(RpcMsg):
+    """Planned-push verdict: ``accepted`` is one byte per pushed
+    partition (1 = staged in the PushedInputStore, 0 = rejected — stale
+    plan epoch, stale attempt fence, over-budget shed, or dead/unknown
+    shuffle). Rejection is never an error for the sender: the range
+    simply stays a hole the reducer fills over the merged/per-map
+    dataplanes."""
+
+    def __init__(self, req_id: int, status: int, accepted: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.accepted = accepted
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.status) + self.accepted
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "PushPlannedResp":
+        req_id, status = _QI.unpack_from(payload, 0)
+        return cls(req_id, status, payload[_QI.size:])
 
 
 @register()

@@ -73,6 +73,8 @@ WIRE_IDS: Dict[str, int] = {
     "MembershipBumpMsg": 37,
     "DrainReq": 38,
     "DrainResp": 39,
+    "PushPlannedReq": 40,
+    "PushPlannedResp": 41,
 }
 
 # Ids deliberately absent from the dense 1..max range, with the reason

@@ -164,6 +164,12 @@ class ReadMetrics:
     merged_reads: int = 0
     merged_bytes: int = 0
     merged_fallbacks: int = 0
+    # planned-push dataplane: (map, partition) ranges served from the
+    # local PushedInputStore — zero metadata RPCs, zero data RPCs — and
+    # the bytes they carried. A fully-pushed reducer's whole input reads
+    # as pushed here (the pushplan bench and the zero-RPC test assert it).
+    pushed_reads: int = 0
+    pushed_bytes: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_remote(self, nbytes: int, latency_s: float) -> None:
@@ -197,6 +203,11 @@ class ReadMetrics:
     def record_merged_fallback(self) -> None:
         with self._lock:
             self.merged_fallbacks += 1
+
+    def record_pushed(self, nbytes: int) -> None:
+        with self._lock:
+            self.pushed_reads += 1
+            self.pushed_bytes += nbytes
 
     def record_retry(self) -> None:
         with self._lock:
@@ -303,12 +314,30 @@ class ShuffleFetcher:
         # them so every (map, partition) is served EXACTLY once; the
         # driver table is kept for the merged threads' per-map fallback
         self._skip: Dict[int, set] = {}
+        # planned push: partitions with at least one staged pushed range
+        # — merged resolution skips them entirely (a merged segment
+        # cannot be sliced around the pushed maps; the leftover maps of
+        # a partially-pushed partition ride the per-map plane instead)
+        self._pushed_parts: set = set()
         self._table = None
 
     # -- setup: plan + launch (initialize/startAsyncRemoteFetches) -------
 
     def start(self) -> "ShuffleFetcher":
         self._started = True
+        # planned push: resolve staged pushed ranges FIRST — before the
+        # driver-table sync, before merged segments, before per-map
+        # pull. A reducer whose inputs ALL arrived serves entirely from
+        # the local PushedInputStore and returns here with ZERO metadata
+        # RPCs and ZERO data RPCs; any hole falls through to the
+        # ordinary dataplanes below, byte-identically.
+        self._resolve_pushed()
+        all_parts = set(range(self.start_partition, self.end_partition))
+        if all(self._skip.get(m, set()) >= all_parts
+               for m in range(self.map_start, self.map_end)):
+            self._peer_threads_left = 0
+            self._results.put(FetchResult(is_sentinel=True))
+            return self
         with self.tracer.span("fetch.driver_table", "fetch",
                               shuffle=self.shuffle_id):
             table, self.epoch = self.endpoint.get_driver_table_v(
@@ -448,6 +477,46 @@ class ShuffleFetcher:
         except KeyError:
             return -1
 
+    # -- pushed-first resolution (planned-push dataplane) ----------------
+
+    def _resolve_pushed(self) -> None:
+        """Serve every (map, partition) range the local PushedInputStore
+        staged under the CACHED plan's exact epoch — no wire traffic of
+        any kind. Served pairs join ``_skip`` (the same dedupe contract
+        as merged segments: every pair is served exactly once) and their
+        partitions are excluded from merged resolution. Cache-only plan
+        lookup: no cached plan means no pushes were routed here under
+        it, so there is nothing to consume — the ordinary dataplanes own
+        the stage."""
+        store = getattr(self.endpoint, "pushed_store", None)
+        if store is None or not self.conf.planned_push:
+            return
+        plane = getattr(self.endpoint, "location_plane", None)
+        plan = plane.plan(self.shuffle_id) if plane is not None else None
+        if plan is None:
+            return
+        epoch = plan.plan_epoch
+        need = set(range(self.map_start, self.map_end))
+        served = bytes_total = 0
+        for p in range(self.start_partition, self.end_partition):
+            blobs = store.take(self.shuffle_id, p, epoch)
+            if not blobs:
+                continue
+            self._pushed_parts.add(p)
+            for m in sorted(need & set(blobs)):
+                data = blobs[m]
+                self.metrics.record_pushed(len(data))
+                self._expected_results += 1
+                self._results.put(FetchResult(m, p, p + 1, data,
+                                              is_local=True))
+                self._skip.setdefault(m, set()).add(p)
+                served += 1
+                bytes_total += len(data)
+        if served:
+            self.tracer.instant("fetch.pushed", "fetch",
+                                shuffle=self.shuffle_id, epoch=epoch,
+                                ranges=served, bytes=bytes_total)
+
     # -- merged-segment-first resolution (push-merge dataplane) ----------
 
     def _resolve_merged(self, my_index: int) -> Dict[int, list]:
@@ -469,6 +538,11 @@ class ShuffleFetcher:
         members = self.endpoint.members()
         by_slot: Dict[int, list] = {}
         for p in range(self.start_partition, self.end_partition):
+            if p in self._pushed_parts:
+                # planned push already serves (some of) this partition;
+                # a merged segment cannot be sliced around the pushed
+                # maps, so the leftovers ride the per-map plane
+                continue
             for entry in directory.entries(p):
                 s = entry.slot
                 if (s != my_index

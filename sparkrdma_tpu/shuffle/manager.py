@@ -146,19 +146,37 @@ class TpuShuffleManager:
                 conf=self.conf,
                 block_port=self.block_server.port if self.block_server else 0,
                 tracer=self.tracer)
+            planned = (self.conf.planned_push and self.conf.adaptive_plan)
             if self.conf.push_merge:
                 # push-merge dataplane (shuffle/push_merge.py): this
                 # executor is a merge TARGET (store served through the
-                # endpoint), a PUSHER of its own committed maps, and an
-                # overflow client for the writer's ENOSPC ladder
+                # endpoint) and an overflow client for the writer's
+                # ENOSPC ladder
                 from sparkrdma_tpu.shuffle.push_merge import (
-                    MergeClient, MergeStore, SegmentPusher)
+                    MergeClient, MergeStore)
                 self.executor.merge_store = MergeStore(self.resolver,
                                                        self.conf)
-                self.pusher = SegmentPusher(self.executor, self.resolver,
-                                            self.conf, pool=self.pool,
-                                            tracer=self.tracer)
                 self.merge_client = MergeClient(self.executor, self.conf)
+            if planned:
+                # planned push (shuffle/pushed_store.py): this executor
+                # is a planned-push TARGET — staged reduce inputs the
+                # fetcher resolves first
+                from sparkrdma_tpu.shuffle.pushed_store import (
+                    PushedInputStore)
+                self.executor.pushed_store = PushedInputStore(
+                    self.resolver, self.conf, pool=self.pool,
+                    tracer=self.tracer)
+            if self.conf.push_merge or planned:
+                # one background pusher serves both dataplanes: merge
+                # replicas at commit, planned reducer slots once the
+                # plan is in hand (replayed via on_plan when it lands
+                # after the commit)
+                from sparkrdma_tpu.shuffle.push_merge import SegmentPusher
+                self.pusher = SegmentPusher(
+                    self.executor, self.resolver, self.conf,
+                    pool=self.pool, tracer=self.tracer,
+                    pushed_store=self.executor.pushed_store)
+                self.executor.on_plan_cb = self.pusher.on_plan
             self.executor.start()
             if num_executors_hint:
                 self.executor.wait_for_members(num_executors_hint)
@@ -315,6 +333,10 @@ class TpuShuffleManager:
             self.executor.invalidate_shuffle(shuffle_id)
             if self.executor.merge_store is not None:
                 self.executor.merge_store.drop_shuffle(shuffle_id)
+            if self.executor.pushed_store is not None:
+                self.executor.pushed_store.drop_shuffle(shuffle_id)
+        if self.pusher is not None:
+            self.pusher.forget(shuffle_id)
         if self.resolver is not None:
             self.resolver.remove_shuffle(shuffle_id)
         with self._lock:
@@ -345,6 +367,10 @@ class TpuShuffleManager:
             log.info("merge store at stop: %s",
                      self.executor.merge_store.snapshot())
             self.executor.merge_store.stop()
+        if self.executor is not None and self.executor.pushed_store is not None:
+            log.info("pushed store at stop: %s",
+                     self.executor.pushed_store.snapshot())
+            self.executor.pushed_store.stop()
         if self.executor is not None:
             if self.executor.suspect_events or self.executor.checksum_failures:
                 log.warning("peer health at stop: %s (checksum failures: %d)",

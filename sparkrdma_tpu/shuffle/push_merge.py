@@ -749,16 +749,21 @@ class MergeStore:
 
 class _PushTask:
     __slots__ = ("shuffle_id", "map_id", "fence", "partition_lengths",
-                 "num_partitions", "submitted")
+                 "num_partitions", "submitted", "planned_only")
 
     def __init__(self, shuffle_id: int, map_id: int, fence: int,
-                 partition_lengths: Sequence[int]):
+                 partition_lengths: Sequence[int],
+                 planned_only: bool = False):
         self.shuffle_id = shuffle_id
         self.map_id = map_id
         self.fence = fence
         self.partition_lengths = [int(n) for n in partition_lengths]
         self.num_partitions = len(self.partition_lengths)
         self.submitted = time.monotonic()
+        # replay entries (a plan landed after the map committed, or a
+        # re-plan re-routed it) redo ONLY the planned push — the merge
+        # push already happened at commit time
+        self.planned_only = planned_only
 
 
 class SegmentPusher:
@@ -772,38 +777,96 @@ class SegmentPusher:
     partition-range). Queue entries are descriptors, not bytes — memory
     is bounded by one staged range at a time."""
 
-    def __init__(self, endpoint, resolver, conf, pool=None, tracer=None):
+    def __init__(self, endpoint, resolver, conf, pool=None, tracer=None,
+                 pushed_store=None):
         from sparkrdma_tpu.utils import trace as trace_mod
         self.endpoint = endpoint
         self.resolver = resolver
         self.conf = conf
         self.pool = pool
         self.tracer = tracer or trace_mod.NULL
+        # the LOCAL PushedInputStore: a planned range whose destination
+        # is this executor lands directly (no RPC, no wire copy)
+        self.pushed_store = pushed_store
         self._q: "queue.Queue[Optional[_PushTask]]" = queue.Queue()
         self._idle = threading.Condition()
         self._inflight = 0
         self._stopped = False
         self._worker: Optional[threading.Thread] = None
+        # planned push: submitted maps logged per shuffle so a plan that
+        # lands (or re-plans) AFTER the commit replays them against the
+        # fresh placements; (sid, map) -> plan epoch already pushed at,
+        # so the eager path and the replay never double-push one epoch
+        self._planned_log: Dict[int, List[Tuple[int, int, List[int]]]] = {}
+        self._planned_done: Dict[Tuple[int, int], int] = {}
         # audit counters
         self.pushes_sent = 0
         self.push_bytes = 0
         self.pushes_dropped = 0
         self.push_failures = 0
+        self.planned_sent = 0
+        self.planned_bytes = 0
+        self.planned_local = 0
+        self.planned_failures = 0
+
+    def _planned_on(self) -> bool:
+        # planned routing needs a ReducePlan, which needs adaptive_plan
+        return bool(self.conf.planned_push) and bool(self.conf.adaptive_plan)
+
+    def _merge_on(self) -> bool:
+        return bool(self.conf.push_merge) \
+            and int(self.conf.merge_replicas) > 0
 
     def submit(self, shuffle_id: int, map_id: int, fence: int,
                partition_lengths: Sequence[int]) -> None:
-        if int(self.conf.merge_replicas) <= 0:
+        if not self._merge_on() and not self._planned_on():
             return
+        task = _PushTask(shuffle_id, map_id, fence, partition_lengths)
         with self._idle:
             if self._stopped:
                 return
+            if self._planned_on():
+                self._planned_log.setdefault(shuffle_id, []).append(
+                    (map_id, fence, task.partition_lengths))
             self._inflight += 1
             if self._worker is None:
                 self._worker = threading.Thread(
                     target=self._run, daemon=True, name="merge-pusher")
                 self._worker.start()
-        self._q.put(_PushTask(shuffle_id, map_id, fence,
-                              partition_lengths))
+        self._q.put(task)
+
+    def on_plan(self, shuffle_id: int) -> None:
+        """A ReducePlan landed for ``shuffle_id`` (initial publish or
+        re-plan): replay every committed map's PLANNED push against the
+        fresh placements. Replay entries carry a fresh deadline clock —
+        the plan's arrival, not the original commit, started their
+        usefulness window — and the per-epoch dedupe in
+        :meth:`_push_planned` makes an already-eager-pushed epoch a
+        no-op."""
+        if not self._planned_on():
+            return
+        with self._idle:
+            if self._stopped:
+                return
+            entries = list(self._planned_log.get(shuffle_id, ()))
+            if not entries:
+                return
+            self._inflight += len(entries)
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._run, daemon=True, name="merge-pusher")
+                self._worker.start()
+        for map_id, fence, lengths in entries:
+            self._q.put(_PushTask(shuffle_id, map_id, fence, lengths,
+                                  planned_only=True))
+
+    def forget(self, shuffle_id: int) -> None:
+        """Drop the shuffle's replay log (unregister / epoch death)."""
+        with self._idle:
+            self._planned_log.pop(shuffle_id, None)
+            for key in [k for k in self._planned_done
+                        if k[0] == shuffle_id]:
+                del self._planned_done[key]
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Wait until every submitted push has been sent or dropped
@@ -876,6 +939,10 @@ class SegmentPusher:
         return None
 
     def _push_map(self, task: _PushTask) -> None:
+        if self._planned_on():
+            self._push_planned(task)
+        if task.planned_only or not self._merge_on():
+            return
         deadline_s = self.conf.push_deadline_ms / 1000
         targets = self._targets(task)
         for slot, p_ranges in sorted(targets.items()):
@@ -941,6 +1008,99 @@ class SegmentPusher:
             return False
         self.pushes_sent += 1
         self.push_bytes += len(data)
+        return True
+
+    # -- planned push (shuffle/pushed_store.py receive path) -------------
+
+    def _push_planned(self, task: _PushTask) -> None:
+        """Push this committed map's bytes to the PLANNED reducer slot
+        of every plan task whose map range covers it (split tasks
+        included — their map slices tile the map space). Cache-only plan
+        resolution: no plan yet means no push now — :meth:`on_plan`
+        replays this map when the broadcast lands. One epoch pushes at
+        most once per map (the receive-side fence dedupe backstops the
+        race between the eager path and a replay)."""
+        plane = getattr(self.endpoint, "location_plane", None)
+        plan = plane.plan(task.shuffle_id) if plane is not None else None
+        if plan is None:
+            return
+        done_key = (task.shuffle_id, task.map_id)
+        with self._idle:
+            if self._planned_done.get(done_key, 0) >= plan.plan_epoch:
+                return
+            self._planned_done[done_key] = plan.plan_epoch
+        try:
+            my = self.endpoint.exec_index()
+        except Exception:  # noqa: BLE001 — not yet joined
+            my = -1
+        deadline_s = self.conf.push_deadline_ms / 1000
+        for t in plan.tasks:
+            if t.placement < 0:
+                continue  # no planned destination: stays pull-fetched
+            if not (t.map_start <= task.map_id < t.map_end):
+                continue  # a split sibling owns this map's slice
+            if time.monotonic() - task.submitted > deadline_s:
+                self.pushes_dropped += 1
+                self.tracer.instant("push.drop", "merge",
+                                    shuffle=task.shuffle_id,
+                                    map=task.map_id, target=t.placement)
+                return
+            lo, hi = t.start_partition, t.end_partition
+            sizes = task.partition_lengths[lo:hi]
+            try:
+                data = self.resolver.local_blocks(
+                    task.shuffle_id, task.map_id, lo, hi)
+            except Exception as e:  # noqa: BLE001 — corrupt/EIO: local
+                # rot must not replicate; the range stays pull-fetched
+                self.planned_failures += 1
+                log.warning("planned push read of shuffle %d map %d "
+                            "[%d,%d) failed: %s", task.shuffle_id,
+                            task.map_id, lo, hi, e)
+                return
+            if data is None:
+                return  # output gone (unregistered/superseded)
+            if t.placement == my:
+                # destination is THIS executor: land directly in the
+                # local store — zero RPCs, zero wire copies
+                if self.pushed_store is not None:
+                    self.pushed_store.push(
+                        task.shuffle_id, task.map_id, task.fence,
+                        plan.plan_epoch, lo, sizes, data)
+                    self.planned_local += 1
+                continue
+            lease = self._stage(len(data),
+                                tenant=self.resolver.tenant_of(
+                                    task.shuffle_id))
+            try:
+                self._send_planned(t.placement, task, plan.plan_epoch,
+                                   lo, sizes, data)
+            finally:
+                if lease is not None:
+                    lease.free()
+
+    def _send_planned(self, slot: int, task: _PushTask, plan_epoch: int,
+                      lo: int, sizes: List[int], data: bytes) -> bool:
+        try:
+            peer = self.endpoint.member_at(slot)
+        except Exception:  # noqa: BLE001 — tombstoned mid-push: the
+            # range stays a hole the reducer pull-fills
+            return False
+        try:
+            with self.tracer.span("push.planned", "push",
+                                  shuffle=task.shuffle_id,
+                                  map=task.map_id, target=slot,
+                                  epoch=plan_epoch, bytes=len(data)):
+                resp = self.endpoint.push_planned(
+                    peer, task.shuffle_id, task.map_id, task.fence,
+                    plan_epoch, lo, sizes, data)
+        except (TransportError, TimeoutError) as e:
+            self.planned_failures += 1
+            log.debug("planned push to slot %d failed: %s", slot, e)
+            return False
+        if resp.status != M.STATUS_OK:
+            return False
+        self.planned_sent += 1
+        self.planned_bytes += len(data)
         return True
 
 

@@ -32,6 +32,7 @@ SPANS = frozenset({
     "fetch.refetch_range",
     "fetch.vectored",
     "push.map",
+    "push.planned",
     "write.merge",
     "write.scatter",
     "write.spill",
@@ -53,6 +54,7 @@ INSTANTS = frozenset({
     "exchange.select",
     "fetch.coalesce_fallback",
     "fetch.merged_fallback",
+    "fetch.pushed",
     "fetch.retry",
     "member.drain",
     "member.drain_fallback",
@@ -62,6 +64,7 @@ INSTANTS = frozenset({
     "meta.epoch_bump",
     "peer.suspect",
     "push.drop",
+    "push.superseded",
     "recovery.repoint",
     "plan.coalesce",
     "plan.replan",

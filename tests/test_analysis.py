@@ -72,15 +72,15 @@ def test_wire_registry_is_dense_and_unique():
 
 
 def test_wire_density_over_full_membership_range():
-    """Msgs 36-39 (JoinMsg..DrainResp) closed the id space at 39: the
-    registry + reservations must tile 1..39 exactly, and every
+    """Msgs 40-41 (PushPlannedReq/Resp) closed the id space at 41: the
+    registry + reservations must tile 1..41 exactly, and every
     membership message must carry _EXTRA_CASES domain corners (epoch 0,
     max-i64, DRAINING-only vectors) so the fuzzer exercises the signed
     boundaries the name-based generator avoids."""
     ids = [t for t, _ in wire.live_pairs()]
-    assert max(ids) == 39
+    assert max(ids) == 41
     assert set(ids) | set(wire.rpc_msg.RESERVED_WIRE_IDS) == set(
-        range(1, 40))
+        range(1, 42))
     for name in ("JoinMsg", "MembershipBumpMsg", "DrainReq", "DrainResp"):
         assert name in wire._EXTRA_CASES, name
     corners = [c() for c in wire._EXTRA_CASES["MembershipBumpMsg"]]

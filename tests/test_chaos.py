@@ -65,6 +65,14 @@ SKEW = os.environ.get("CHAOS_SKEW", "0") not in ("0", "false")
 # push_merge=False — the dedicated merge scenarios below own those
 # assertions with deterministic coverage.
 MERGE = os.environ.get("CHAOS_MERGE", "0") not in ("0", "false")
+# planned push under chaos: 1 runs the whole byte-identity matrix with
+# sender-driven planned pushes active in the BACKGROUND of the faulted
+# reduce (adaptive_plan forced on, the driver publishes a ReducePlan
+# right after the map stage, pushers race the reducer, staged ranges
+# resolve first at their planned slots) so the pushed dataplane and its
+# fences cross every injected fault; run_chaos.sh sweeps both. The
+# dedicated kill-the-planned-reducer scenario below runs regardless.
+PUSHPLAN = os.environ.get("CHAOS_PUSHPLAN", "0") not in ("0", "false")
 # tenancy under chaos: 1 runs the whole matrix with every shuffle
 # registered under a real tenant id (TenantMapMsg pushes, serve-path
 # DRR queueing, disk-ledger charging, admission gating with a
@@ -104,7 +112,8 @@ def _conf(**kw):
                 pre_warm_connections=False,
                 coalesce_reads=COALESCE,
                 location_epoch_cache=WARM,
-                adaptive_plan=SKEW,
+                adaptive_plan=SKEW or PUSHPLAN,
+                planned_push=PUSHPLAN,
                 push_merge=MERGE,
                 collect_shuffle_reader_stats=True)
     if TENANT:
@@ -593,6 +602,69 @@ def test_chaos_merge_corrupt_segment_degrades_per_map(tmp_path):
         _shutdown(driver, execs)
 
 
+def test_chaos_pushplan_reducer_kill_mid_push(tmp_path):
+    """The planned reducer for partition 0 dies MID-PUSH — after
+    accepting its first pushed range, while the senders' replay is
+    still streaming toward it. Staged inputs die with it; the reduce on
+    a survivor serves its OWN staged partitions pushed-first,
+    pull-fills every hole, recovery recomputes the dead slot's maps,
+    and the output is an EXACT multiset of the fault-free ground truth
+    — zero duplicate rows, zero lost rows."""
+    driver, execs = _cluster(tmp_path, adaptive_plan=True,
+                             planned_push=True, push_merge=False,
+                             coalesce_target_bytes=2048,
+                             fetch_retry_budget=1)
+    holder = {"victim_slot": None}
+    killed = threading.Event()
+
+    def arm(ep, orig):
+        def handler(conn, msg):
+            orig(conn, msg)
+            if (holder["victim_slot"] is not None
+                    and ep.exec_index() == holder["victim_slot"]
+                    and not killed.is_set()):
+                killed.set()
+                # stop from a fresh thread: the handler runs on a serve
+                # worker the stop would otherwise wait on
+                threading.Thread(target=ep.server.stop,
+                                 daemon=True).start()
+        return handler
+
+    for ex in execs:
+        ep = ex.executor
+        ep._on_push_planned = arm(ep, ep._on_push_planned)
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=8,
+                                         partitioner=PartitionerSpec("modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        plan = driver.driver.build_reduce_plan(1)
+        assert plan is not None, f"seed={SEED}"
+        holder["victim_slot"] = plan.placement_of(0)
+        assert killed.wait(10), \
+            f"seed={SEED}: no push ever reached the planned reducer"
+        victim_idx = next(
+            i for i, ex in enumerate(execs)
+            if ex.executor.exec_index() == holder["victim_slot"])
+        reducer_idx = next(i for i in range(len(execs))
+                           if i != victim_idx)
+        got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
+                                    reducer_index=reducer_idx,
+                                    max_stage_retries=3, driver=driver)
+        # zero duplicate rows, zero lost rows: exact multiset equality
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        assert driver.driver.members()[holder["victim_slot"]] \
+            == TOMBSTONE, f"seed={SEED}"
+        # the senders saw the death, not an error: failed planned pushes
+        # are shed (the ranges stay pull-fetched), never worker-fatal
+        snaps = [ex.executor.pushed_store.snapshot()
+                 for i, ex in enumerate(execs) if i != victim_idx]
+        assert all(s is not None for s in snaps), f"seed={SEED}"
+    finally:
+        _shutdown(driver, execs)
+
+
 # -- cross-tenant isolation (the CHAOS_TENANT satellite) -----------------
 
 
@@ -1066,6 +1138,12 @@ def test_chaos_matrix(tmp_path, scenario):
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=8,
                                          partitioner=PartitionerSpec("modulo"))
         run_map_stage(execs, handle, _map_fn_big)
+        if PUSHPLAN:
+            # background planned pushes: the plan publishes now, so the
+            # pushers race the faulted reduce below and staged ranges
+            # resolve first at their planned slots
+            assert driver.driver.build_reduce_plan(1) is not None, \
+                f"seed={SEED}: PUSHPLAN sweep built no plan"
         victim_addr = (execs[2].executor.manager_id.rpc_host,
                        execs[2].executor.manager_id.rpc_port)
         injector.install_endpoint(execs[0].executor)
@@ -1142,6 +1220,11 @@ def test_chaos_disk_matrix(tmp_path, scenario):
         # the map stage runs UNDER the faults: spill retries, fallback
         # dirs, and WriteFailedError re-placement all exercise here
         run_map_stage(execs, handle, _map_fn)
+        if PUSHPLAN:
+            # background planned pushes under storage faults: staging
+            # spills cross the same injected EIO/ENOSPC/slow-disk shims
+            assert driver.driver.build_reduce_plan(1) is not None, \
+                f"seed={SEED}: PUSHPLAN sweep built no plan"
         if ELASTIC:
             churn = _ElasticChurn(driver.conf, driver, tmp_path)
         got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,

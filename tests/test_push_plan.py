@@ -1,0 +1,424 @@
+"""Planned-push (sender-driven shuffle) tests.
+
+Units on the ``PushedInputStore`` double-fence discipline (attempt
+fences, plan epochs, tombstones, budget spill, repay-exactly
+accounting), the e2e push-vs-pull byte-parity matrix across every
+dataplane combo (coalesced / sequential / pipelined x merged on/off),
+the zero-RPC gate for fully-pushed partitions (frames counted
+SERVER-side across the whole cluster), hole fallback, mid-stage
+re-plan supersession, and the microbench acceptance gate
+(shuffle/pushplan_bench.py). ``PUSHPLAN_SEED`` varies the generated
+data for seed sweeps.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel import messages as M
+from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+from sparkrdma_tpu.shuffle.push_merge import wait_for_coverage
+from sparkrdma_tpu.shuffle.pushed_store import PushedInputStore
+from sparkrdma_tpu.shuffle.reader import TpuShuffleReader
+from sparkrdma_tpu.shuffle.resolver import TpuShuffleBlockResolver
+
+SEED = int(os.environ.get("PUSHPLAN_SEED", "0"))
+
+
+# -- units: PushedInputStore ----------------------------------------------
+
+
+def test_pushed_store_fence_epoch_and_tombstone_discipline(tmp_path):
+    conf = TpuShuffleConf(use_cpp_runtime=False)
+    resolver = TpuShuffleBlockResolver(str(tmp_path / "s"), conf=conf)
+    store = PushedInputStore(resolver, conf)
+    try:
+        status, acc = store.push(1, 0, fence=5, plan_epoch=1,
+                                 start_partition=0, sizes=[3, 2],
+                                 data=b"abcde")
+        assert (status, acc) == (M.STATUS_OK, b"\x01\x01")
+        assert store.take(1, 0, plan_epoch=1) == {0: b"abc"}
+        assert store.take(1, 1, plan_epoch=1) == {0: b"de"}
+        # ranges stay staged after a take (warm re-reads)
+        assert store.take(1, 0, plan_epoch=1) == {0: b"abc"}
+        # stale ATTEMPT fence: rejected per partition, bytes unchanged
+        _, acc = store.push(1, 0, fence=4, plan_epoch=1,
+                            start_partition=0, sizes=[3, 2], data=b"XXXYY")
+        assert acc == b"\x00\x00"
+        assert store.take(1, 0, plan_epoch=1) == {0: b"abc"}
+        # newer fence supersedes; the old charge is released in-place
+        _, acc = store.push(1, 0, fence=7, plan_epoch=1,
+                            start_partition=0, sizes=[3, 2], data=b"ABCDE")
+        assert acc == b"\x01\x01"
+        assert store.take(1, 0, plan_epoch=1) == {0: b"ABC"}
+        assert store.pushes_superseded == 2
+        # stale PLAN epoch: shed wholesale
+        _, acc = store.push(1, 1, fence=1, plan_epoch=0,
+                            start_partition=0, sizes=[2], data=b"zz")
+        assert acc == b"\x00"
+        # a NEWER epoch adopts first (push beat the plan broadcast) and
+        # releases every older-epoch range — exactly, not approximately
+        _, acc = store.push(1, 1, fence=1, plan_epoch=2,
+                            start_partition=0, sizes=[2], data=b"qq")
+        assert acc == b"\x01"
+        assert store.take(1, 0, plan_epoch=1) == {}  # stale never served
+        assert store.take(1, 0, plan_epoch=2) == {1: b"qq"}
+        assert store.maps_staged(1, 0, plan_epoch=2) == [1]
+        snap = store.snapshot()
+        assert snap["staged_ranges"] == 1 and snap["mem_bytes"] == 2, snap
+        # on_plan: same adoption path as a push-carried epoch
+        store.on_plan(1, 3)
+        assert store.take(1, 0, plan_epoch=2) == {}
+        assert store.snapshot()["staged_ranges"] == 0
+        # drop -> tombstone: a racing push is FINALIZED (stops the
+        # pusher); a registration event re-arms the id for reuse
+        store.drop_shuffle(1)
+        status, _ = store.push(1, 0, fence=9, plan_epoch=3,
+                               start_partition=0, sizes=[1], data=b"a")
+        assert status == M.STATUS_FINALIZED
+        store.note_registered(1)
+        status, acc = store.push(1, 0, fence=9, plan_epoch=3,
+                                 start_partition=0, sizes=[1], data=b"a")
+        assert (status, acc) == (M.STATUS_OK, b"\x01")
+        store.drop_shuffle(1)
+        assert store.snapshot()["mem_bytes"] == 0
+        assert resolver.disk_ledger.usage(0) == 0
+    finally:
+        store.stop()
+        resolver.stop()
+
+
+def test_pushed_store_budget_spill_and_repay(tmp_path):
+    """``push_staging_budget=0`` sends every range to disk: files land
+    under ``<spill_dir>/pushed/``, the tenant's disk ledger is charged,
+    takes read back the exact bytes, and drop repays + unlinks."""
+    conf = TpuShuffleConf(use_cpp_runtime=False, push_staging_budget=0)
+    resolver = TpuShuffleBlockResolver(str(tmp_path / "s"), conf=conf)
+    store = PushedInputStore(resolver, conf)
+    try:
+        status, acc = store.push(7, 2, fence=1, plan_epoch=1,
+                                 start_partition=0, sizes=[4, 4],
+                                 data=b"aaaabbbb")
+        assert (status, acc) == (M.STATUS_OK, b"\x01\x01")
+        snap = store.snapshot()
+        assert snap["mem_bytes"] == 0 and snap["spilled_bytes"] == 8, snap
+        assert resolver.disk_ledger.usage(0) == 8
+        assert list((tmp_path / "s" / "pushed").glob("push_7_*"))
+        assert store.take(7, 0, plan_epoch=1) == {2: b"aaaa"}
+        assert store.take(7, 1, plan_epoch=1) == {2: b"bbbb"}
+        # location-epoch ADVANCE (recovery): conservatively drop rows,
+        # repaying the spill charge; the plan epoch is kept
+        store.on_location_epoch(7, 2)
+        assert store.take(7, 0, plan_epoch=1) == {}
+        assert resolver.disk_ledger.usage(0) == 0
+        assert not list((tmp_path / "s" / "pushed").glob("push_7_*"))
+    finally:
+        store.stop()
+        resolver.stop()
+
+
+# -- e2e cluster ----------------------------------------------------------
+
+
+def _cluster(tmp_path, n=3, **kw):
+    base = dict(connect_timeout_ms=10000, use_cpp_runtime=False,
+                retry_backoff_base_ms=10, retry_backoff_cap_ms=80,
+                adaptive_plan=True, planned_push=True,
+                push_deadline_ms=8000)
+    base.update(kw)
+    conf = TpuShuffleConf(**base)
+    driver = TpuShuffleManager(conf, is_driver=True)
+    execs = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                               executor_id=str(i),
+                               spill_dir=str(tmp_path / f"e{i}"))
+             for i in range(n)]
+    for ex in execs:
+        ex.executor.wait_for_members(n)
+    return driver, execs, conf
+
+
+def _shutdown(driver, execs):
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def _write_maps(driver, execs, num_maps=6, num_partitions=4, rows=400,
+                payload_w=0, shuffle_id=1):
+    handle = driver.register_shuffle(
+        shuffle_id, num_maps, num_partitions, PartitionerSpec("modulo"),
+        row_payload_bytes=payload_w)
+    for m in range(num_maps):
+        w = execs[m % len(execs)].get_writer(handle, m)
+        rng = np.random.default_rng(SEED * 1000 + m)
+        keys = rng.integers(0, 5000, rows).astype(np.uint64)
+        payload = (rng.integers(0, 255, (rows, payload_w), dtype=np.uint64)
+                   .astype(np.uint8) if payload_w else None)
+        w.write_batch(keys, payload)
+        w.close()
+    return handle
+
+
+def _plan_and_stage(driver, execs, handle, timeout=15):
+    """Publish the plan, then wait until EVERY (map, partition) is
+    staged at its planned slot — the plan broadcast races the drain
+    call, so coverage is polled, not assumed."""
+    plan = driver.driver.build_reduce_plan(handle.shuffle_id)
+    assert plan is not None, "no size rows reached the planner?"
+    by_slot = {ex.executor.exec_index(timeout=5): ex for ex in execs}
+    sid = handle.shuffle_id
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for ex in execs:
+            ex.pusher.drain(timeout)
+        if all(len(by_slot[plan.placement_of(p)].executor.pushed_store
+                   .maps_staged(sid, p, plan.plan_epoch))
+               == handle.num_maps
+               for p in range(handle.num_partitions)):
+            return plan, by_slot
+        time.sleep(0.02)
+    raise AssertionError("planned pushes never fully staged: %s" % [
+        (p, by_slot[plan.placement_of(p)].executor.pushed_store
+         .maps_staged(sid, p, plan.plan_epoch))
+        for p in range(handle.num_partitions)])
+
+
+def _rows_multiset(reader):
+    """Sorted (key || payload) byte rows — the framing-independent
+    byte-parity check (equal multisets, duplicates preserved)."""
+    keys, payload = reader.read_all()
+    if payload is None or payload.size == 0:
+        return sorted(keys.tobytes()[i * 8:i * 8 + 8]
+                      for i in range(len(keys)))
+    return sorted(keys[i].tobytes() + payload[i].tobytes()
+                  for i in range(len(keys)))
+
+
+def _read_partition(ex, conf, handle, p, payload_w=0):
+    return TpuShuffleReader(ex.executor, ex.resolver, conf,
+                            handle.shuffle_id, handle.num_maps, p, p + 1,
+                            payload_w)
+
+
+class _WireCounters:
+    """Server-side frame counts across the WHOLE cluster — the honest
+    zero-RPC gate: a fully-pushed reducer must cause no metadata or
+    data frames to arrive anywhere (driver table/plan serves included),
+    not merely report zeros in its own client metrics."""
+
+    def __init__(self, driver, execs):
+        self.meta = 0
+        self.data = 0
+
+        def wrap(kind, orig):
+            def handler(*a):
+                setattr(self, kind, getattr(self, kind) + 1)
+                return orig(*a)
+            return handler
+
+        drv = driver.driver
+        drv._on_fetch_table = wrap("meta", drv._on_fetch_table)
+        drv._on_fetch_plan = wrap("meta", drv._on_fetch_plan)
+        for ex in execs:
+            ep = ex.executor
+            ep._on_fetch_output = wrap("meta", ep._on_fetch_output)
+            ep._on_fetch_outputs = wrap("meta", ep._on_fetch_outputs)
+            ep._on_fetch_blocks = wrap("data", ep._on_fetch_blocks)
+
+
+_DATAPLANES = {
+    "coalesced": dict(coalesce_reads=True),
+    "sequential": dict(coalesce_reads=False, read_ahead_depth=1),
+    "pipelined": dict(coalesce_reads=False, read_ahead_depth=8),
+}
+
+
+@pytest.mark.parametrize("dataplane", sorted(_DATAPLANES))
+@pytest.mark.parametrize("merged", [False, True])
+def test_e2e_push_vs_pull_byte_parity(tmp_path, dataplane, merged):
+    """The parity matrix: a fully-pushed read must be byte-identical to
+    a pull over EVERY dataplane combo — coalesced / sequential /
+    pipelined, merged segments on and off."""
+    kw = dict(_DATAPLANES[dataplane])
+    if merged:
+        kw.update(push_merge=True, merge_replicas=1)
+    driver, execs, conf = _cluster(tmp_path, **kw)
+    try:
+        handle = _write_maps(driver, execs, payload_w=24)
+        if merged:
+            for ex in execs:
+                assert ex.pusher.drain(15)
+            assert wait_for_coverage(driver.driver, handle.shuffle_id,
+                                     handle.num_maps,
+                                     handle.num_partitions, timeout=15)
+        plan, by_slot = _plan_and_stage(driver, execs, handle)
+        pull_conf = TpuShuffleConf(**dict(conf.to_dict(),
+                                          planned_push=False))
+        for p in range(handle.num_partitions):
+            ex = by_slot[plan.placement_of(p)]
+            push_reader = _read_partition(ex, conf, handle, p, 24)
+            pushed = _rows_multiset(push_reader)
+            assert push_reader.metrics.pushed_reads == handle.num_maps, \
+                push_reader.metrics
+            assert push_reader.metrics.failed_fetches == 0
+            pull_reader = _read_partition(ex, pull_conf, handle, p, 24)
+            assert pushed == _rows_multiset(pull_reader), \
+                f"partition {p} seed={SEED} {dataplane} merged={merged}"
+            assert pull_reader.metrics.pushed_reads == 0
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_e2e_fully_pushed_read_is_zero_rpc(tmp_path):
+    """The tentpole's headline: a reducer whose inputs were all pushed
+    starts with ZERO metadata RPCs and ZERO data RPCs — counted
+    server-side across the driver and every executor."""
+    driver, execs, conf = _cluster(tmp_path)
+    try:
+        handle = _write_maps(driver, execs, payload_w=24)
+        plan, by_slot = _plan_and_stage(driver, execs, handle)
+        wire = _WireCounters(driver, execs)
+        rows = []
+        for p in range(handle.num_partitions):
+            ex = by_slot[plan.placement_of(p)]
+            reader = _read_partition(ex, conf, handle, p, 24)
+            rows.extend(_rows_multiset(reader))
+            m = reader.metrics
+            assert m.pushed_reads == handle.num_maps, m
+            assert m.metadata_rpcs_per_stage == 0, m
+            assert m.requests_per_reduce == 0, m
+            assert m.remote_fetches == 0 and m.local_fetches == 0, m
+        assert (wire.meta, wire.data) == (0, 0), (wire.meta, wire.data)
+        # sanity: the counters DO count — the same read pulling hits
+        # the wire, and fetches the same bytes
+        pull_conf = TpuShuffleConf(**dict(conf.to_dict(),
+                                          planned_push=False))
+        pulled = []
+        for p in range(handle.num_partitions):
+            ex = by_slot[plan.placement_of(p)]
+            pulled.extend(_rows_multiset(
+                _read_partition(ex, pull_conf, handle, p, 24)))
+        assert wire.meta > 0 and wire.data > 0
+        assert sorted(rows) == sorted(pulled)
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_e2e_hole_falls_back_per_map_byte_identical(tmp_path):
+    """Evict one staged range at the planned slot: the reducer serves
+    the other maps from staging and pull-fills ONLY the hole — no
+    duplicate rows, no missing rows, failed_fetches == 0."""
+    driver, execs, conf = _cluster(tmp_path)
+    try:
+        handle = _write_maps(driver, execs)
+        plan, by_slot = _plan_and_stage(driver, execs, handle)
+        ex = by_slot[plan.placement_of(0)]
+        store = ex.executor.pushed_store
+        with store._lock:
+            state = store._shuffles[handle.shuffle_id]
+            store._free_row_locked(state.rows.pop((0, 0)))
+        reader = _read_partition(ex, conf, handle, 0)
+        rows = _rows_multiset(reader)
+        m = reader.metrics
+        assert m.pushed_reads == handle.num_maps - 1, m
+        assert m.remote_fetches + m.local_fetches == 1, m
+        assert m.failed_fetches == 0, m
+        pull_conf = TpuShuffleConf(**dict(conf.to_dict(),
+                                          planned_push=False))
+        assert rows == _rows_multiset(
+            _read_partition(ex, pull_conf, handle, 0)), f"seed={SEED}"
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_e2e_replan_supersedes_staged_pushes_exactly(tmp_path):
+    """A mid-stage re-plan (bumped epoch) supersedes every stale staged
+    range, the senders' replay re-stages under the new epoch, and reads
+    serve ONLY new-epoch rows — staged-range counts prove the
+    supersession was exact (released, not duplicated)."""
+    driver, execs, conf = _cluster(tmp_path)
+    try:
+        handle = _write_maps(driver, execs)
+        plan1, by_slot = _plan_and_stage(driver, execs, handle)
+        assert plan1.plan_epoch == 1
+        n_ranges = handle.num_maps * handle.num_partitions
+        assert sum(ex.executor.pushed_store.snapshot()["staged_ranges"]
+                   for ex in execs) == n_ranges
+        # rebuild: same histogram, bumped epoch, broadcast like the
+        # original; stores adopt + shed, pushers replay
+        plan2, by_slot = _plan_and_stage(driver, execs, handle)
+        assert plan2.plan_epoch == 2
+        # exactness: every stale range released, every range re-staged
+        # once — the store holds exactly one epoch's worth of rows
+        assert sum(ex.executor.pushed_store.snapshot()["staged_ranges"]
+                   for ex in execs) == n_ranges
+        assert any(ex.executor.pushed_store.pushes_superseded
+                   for ex in execs)
+        for p in range(handle.num_partitions):
+            store = by_slot[plan2.placement_of(p)].executor.pushed_store
+            # the stale epoch is never consumable, the new one is full
+            assert store.take(handle.shuffle_id, p, plan1.plan_epoch) \
+                == {}
+            assert len(store.maps_staged(handle.shuffle_id, p,
+                                         plan2.plan_epoch)) \
+                == handle.num_maps
+        # and the read at the new epoch is byte-identical to pull
+        pull_conf = TpuShuffleConf(**dict(conf.to_dict(),
+                                          planned_push=False))
+        for p in range(handle.num_partitions):
+            ex = by_slot[plan2.placement_of(p)]
+            reader = _read_partition(ex, conf, handle, p)
+            rows = _rows_multiset(reader)
+            assert reader.metrics.pushed_reads == handle.num_maps
+            assert rows == _rows_multiset(
+                _read_partition(ex, pull_conf, handle, p)), f"seed={SEED}"
+    finally:
+        _shutdown(driver, execs)
+
+
+def test_e2e_unregister_drops_staging_and_stops_pusher(tmp_path):
+    """Shuffle TTL: unregister releases every staged range (leases
+    freed, files gone) and tombstones the id so a racing push gets
+    FINALIZED instead of parking zombie bytes."""
+    driver, execs, _ = _cluster(tmp_path)
+    try:
+        handle = _write_maps(driver, execs)
+        plan, by_slot = _plan_and_stage(driver, execs, handle)
+        driver.unregister_shuffle(handle.shuffle_id)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snaps = [ex.executor.pushed_store.snapshot() for ex in execs]
+            if all(s["staged_ranges"] == 0 and s["mem_bytes"] == 0
+                   for s in snaps):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(snaps)
+        store = by_slot[plan.placement_of(0)].executor.pushed_store
+        status, _ = store.push(handle.shuffle_id, 0, fence=99,
+                               plan_epoch=plan.plan_epoch,
+                               start_partition=0, sizes=[1], data=b"x")
+        assert status == M.STATUS_FINALIZED
+    finally:
+        _shutdown(driver, execs)
+
+
+# -- microbench acceptance gate -------------------------------------------
+
+
+def test_pushplan_microbench_acceptance(tmp_path):
+    """The PR's acceptance gate, exactly as the bench secondary records
+    it: reduce-stage start-to-first-row >= 1.5x push vs pull under the
+    wire-latency shim, byte-identical output, and 0 metadata + 0 data
+    RPCs for the fully-pushed read."""
+    from sparkrdma_tpu.shuffle.pushplan_bench import run_pushplan_microbench
+
+    res = run_pushplan_microbench(str(tmp_path))
+    assert res["identical"], res
+    assert res["rpcs"]["push"] == {"meta": 0, "data": 0}, res
+    assert res["rpcs"]["pull"]["meta"] > 0, res
+    assert res["pushplan_speedup"] >= 1.5, res
+    assert res["pushed_reads"] == res["maps"] * res["partitions"], res
