@@ -474,6 +474,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("elastic drain done")
     _bench_pushplan(detail)
     _progress("planned push done")
+    _bench_ha_failover(detail)
+    _progress("driver failover done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -833,6 +835,41 @@ def _bench_pushplan(detail: dict) -> None:
         detail["pushplan_rpcs"] = res["rpcs"]
     except Exception as e:  # noqa: BLE001
         detail["pushplan_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_ha_failover(detail: dict) -> None:
+    """Driver HA's cost, measured without hardware: a lease-armed
+    primary with a warm standby shadowing its op log CRASHES after the
+    map outputs have replicated, and ``failover_downtime_ms`` is crash
+    to the FIRST successful publish against the promoted standby — the
+    whole control-plane outage as an executor sees it (lease expiry +
+    CAS takeover + op-log replay + TakeoverMsg re-point), probed by an
+    idempotent republish loop (shuffle/ha_bench.py). Gates: the
+    post-failover reduce is byte-identical and re-executes ZERO maps —
+    losing the driver may cost a wait, never a recompute.
+    ``failover_replay_ops`` is the op-log tail the promotion replayed
+    (the ``oplog_lag_entries`` gauge). Pure host path — identical on
+    TPU and CPU-fallback records."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.ha_bench import run_ha_microbench
+
+        with tempfile.TemporaryDirectory(prefix="habench_") as td:
+            res = run_ha_microbench(td)
+        if not res["identical"]:
+            detail["ha_failover_error"] = \
+                "post-failover reduce diverged from the ground truth"
+            return
+        if res["reexec"] != 0:
+            detail["ha_failover_error"] = (
+                f"failover re-executed {res['reexec']} maps")
+            return
+        detail["failover_downtime_ms"] = res["failover_downtime_ms"]
+        detail["failover_lease_ms"] = res["lease_ms"]
+        detail["failover_replay_ops"] = res["replay_ops"]
+    except Exception as e:  # noqa: BLE001
+        detail["ha_failover_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _bench_tenant_isolation(detail: dict) -> None:

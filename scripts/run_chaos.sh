@@ -63,6 +63,18 @@
 #                               matrix; the cross-tenant isolation
 #                               scenarios assert blast radius
 #                               regardless)
+#   CHAOS_DRIVER_MODES="0 1"    driver-HA modes to sweep (default both:
+#                               off, and CHAOS_DRIVER=1 so the wide
+#                               byte-identity matrices run with a
+#                               lease-armed primary, a warm standby
+#                               shadowing its op log, and a primary
+#                               CRASH at a seeded random point inside
+#                               the reduce window — lease takeover,
+#                               op-log replay, TakeoverMsg re-pointing,
+#                               and the DriverClient retry envelope all
+#                               cross every injected fault; the
+#                               dedicated kill -9 acceptance scenario
+#                               runs regardless)
 #   CHAOS_DISK=0          drop the storage-fault matrix from the sweep
 #   CHAOS_LOCKGRAPH=1     run every scenario under the lock-order shim
 #                         (sparkrdma_tpu/analysis/lockgraph.py): the
@@ -80,8 +92,10 @@ MERGE_MODES=${CHAOS_MERGE_MODES:-"0 1"}
 PUSHPLAN_MODES=${CHAOS_PUSHPLAN_MODES:-"0 1"}
 TENANT_MODES=${CHAOS_TENANT_MODES:-"0 1"}
 ELASTIC_MODES=${CHAOS_ELASTIC_MODES:-"0 1"}
+DRIVER_MODES=${CHAOS_DRIVER_MODES:-"0 1"}
 DISK=${CHAOS_DISK:-1}
 failed=()
+for driver in $DRIVER_MODES; do
 for elastic in $ELASTIC_MODES; do
 for tenant in $TENANT_MODES; do
 for pushplan in $PUSHPLAN_MODES; do
@@ -93,27 +107,31 @@ for coalesce in $MODES; do
     echo "=== chaos sweep: seed ${seed} coalesce=${coalesce}" \
          "warm=${warm} skew=${skew} merge=${merge}" \
          "pushplan=${pushplan} tenant=${tenant} elastic=${elastic}" \
-         "disk=${DISK} ==="
+         "driver=${driver} disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
          CHAOS_WARM="${warm}" CHAOS_SKEW="${skew}" \
          CHAOS_MERGE="${merge}" CHAOS_PUSHPLAN="${pushplan}" \
          CHAOS_TENANT="${tenant}" \
-         CHAOS_ELASTIC="${elastic}" CHAOS_DISK="${DISK}" \
+         CHAOS_ELASTIC="${elastic}" CHAOS_DRIVER="${driver}" \
+         CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
            -p no:cacheprovider -p no:randomly; then
       echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm}" \
            "skew=${skew} merge=${merge} pushplan=${pushplan}" \
-           "tenant=${tenant} elastic=${elastic} FAILED — replay with:"
+           "tenant=${tenant} elastic=${elastic} driver=${driver}" \
+           "FAILED — replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
            "CHAOS_WARM=${warm} CHAOS_SKEW=${skew}" \
          "CHAOS_MERGE=${merge} CHAOS_PUSHPLAN=${pushplan}" \
            "CHAOS_TENANT=${tenant}" \
-           "CHAOS_ELASTIC=${elastic} CHAOS_DISK=${DISK}" \
+           "CHAOS_ELASTIC=${elastic} CHAOS_DRIVER=${driver}" \
+           "CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}")
+      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}d${driver}")
     fi
   done
+done
 done
 done
 done
@@ -129,4 +147,4 @@ fi
 echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
      "planes, both reduce-planning modes, both push-merge modes," \
      "both planned-push modes, both tenancy modes, both" \
-     "elastic-membership modes (disk=${DISK})"
+     "elastic-membership modes, both driver-HA modes (disk=${DISK})"

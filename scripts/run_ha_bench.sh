@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Driver-HA sweep: the HA test battery (tests/test_ha.py — lease CAS on
+# both backends, single-winner races, op-log compaction and replay
+# idempotency over the driver-bound wire frames, DriverClient failover
+# re-pointing, the in-process lease failover with live executors, and
+# the zombie-primary fence) including the slow end-to-end scenarios,
+# then the failover microbench across a set of seeds with its
+# acceptance gates: byte-identical post-failover reduce, ZERO map
+# re-executions, and a promoted incarnation. ``failover_downtime_ms``
+# (crash to first successful publish against the promoted standby) and
+# ``replay_ops`` are the numbers one crash costs. A red seed replays
+# exactly:
+#
+#     python -m pytest tests/test_ha.py
+#
+# Usage: scripts/run_ha_bench.sh [seed ...]
+#   HA_SEEDS="0 1 2"   alternative way to pass the seed list
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SEEDS=${*:-${HA_SEEDS:-"0 7 42"}}
+failed=()
+echo "=== HA test battery (slow scenarios included) ==="
+if ! JAX_PLATFORMS=cpu python -m pytest tests/test_ha.py -q -m '' \
+     -p no:cacheprovider -p no:randomly; then
+  failed+=("test_ha")
+fi
+echo "=== chaos kill -9 acceptance ==="
+if ! JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+     -k sigkill -p no:cacheprovider -p no:randomly; then
+  failed+=("sigkill")
+fi
+
+echo "=== failover microbench ==="
+for seed in $SEEDS; do
+  if ! JAX_PLATFORMS=cpu python - "$seed" <<'EOF'
+import json, sys, tempfile
+from sparkrdma_tpu.shuffle.ha_bench import run_ha_microbench
+
+seed = int(sys.argv[1])
+with tempfile.TemporaryDirectory(prefix="habench_") as td:
+    res = run_ha_microbench(td, seed=seed)
+print(json.dumps(res))
+ok = (res["identical"] and res["reexec"] == 0
+      and res["incarnation"] >= 1
+      and res["failover_downtime_ms"] > 0)
+sys.exit(0 if ok else 1)
+EOF
+  then
+    failed+=("microbench-${seed}")
+  fi
+done
+
+if [ "${#failed[@]}" -gt 0 ]; then
+  echo "HA sweep: FAILED: ${failed[*]}"
+  exit 1
+fi
+echo "HA sweep: all seeds green, failover gates met (byte-identical," \
+     "zero re-executions, promoted incarnation)"

@@ -33,6 +33,23 @@ invariants after every fired step:
 * **ledger-conserve** — per tenant, TenantLedger usage equals charges
   minus releases of live state exactly (a double-release or a leaked
   charge breaks the equality) and is never negative.
+* **lease-single-holder** — the driver lease CAS (shuffle/ha.py)
+  admits exactly one holder per term, ever: two standbys racing the
+  same takeover resolve to one promotion.
+* **no-resurrect** — once an observer processed ``EPOCH_DEAD`` (and
+  nothing re-registered the id), no later step — including a promoted
+  standby's re-broadcast — may hand it a positive epoch again: a new
+  primary must re-derive the TTL sweep from replicated register times
+  instead of trusting the unregister op to have been replicated.
+
+The driver-death scenarios (``driver_failover_mid_publish``,
+``split_brain_two_leases``, ``zombie_primary_publish``,
+``failover_vs_ttl_sweep``) additionally check epoch monotonicity
+ACROSS driver incarnations (``ha.compose_epoch`` puts the incarnation
+in the high bits, so every existing keep-highest comparison fences a
+zombie old primary's writes), fence idempotency of publishes re-sent
+to the new primary, op-stream fencing by ``(incarnation, seq)``, and
+ledger conservation through log replay.
 
 Driver-side glue that lives inside ``parallel/endpoints.py`` (tombstone
 → directory prune + epoch bump; merged-publish admission) is mirrored
@@ -53,6 +70,9 @@ from sparkrdma_tpu.analysis.core import Finding, rel, repo_root
 from sparkrdma_tpu.analysis.scheduler import (Run, VirtualScheduler,
                                               explore_dfs, random_walks,
                                               replay)
+from sparkrdma_tpu.shuffle.ha import (OP_BUMP, OP_REGISTER, OP_UNREGISTER,
+                                      OP_WIRE, InMemoryLeaseStore, OpLog,
+                                      OpRecord, rebase_epoch)
 from sparkrdma_tpu.shuffle.location_plane import EPOCH_DEAD, LocationPlane
 from sparkrdma_tpu.shuffle.map_output import DriverTable
 from sparkrdma_tpu.shuffle.push_merge import MergedDirectory, MergedEntry
@@ -104,15 +124,32 @@ class World:
         self.member_history: List[Tuple[List[int], int]] = [
             (self.membership.states(), self.membership.epoch())]
         self.problem: Optional[str] = None
+        # -- driver HA mirrors (shuffle/ha.py; the oplog glue lives in
+        # endpoints._log_op / DriverStandby): the REAL lease store and
+        # OpLog stamping, with per-standby replication bookkeeping
+        self.lease = InMemoryLeaseStore()
+        self.lease_holders: Dict[int, set] = {}
+        self.incarnation = 0
+        self.oplogs: Dict[int, OpLog] = {}
+        self.ops: List[Tuple[OpRecord, Tuple]] = []
+        self.replicated: Dict[str, List[Tuple[OpRecord, Tuple]]] = {}
+        self.repl_last: Dict[str, Tuple[int, int]] = {}
+        self.promote_term: Dict[str, int] = {}
+        self.ttl_expired = False
 
     # -- driver glue mirrors ---------------------------------------------
 
     def publish(self, map_id: int, token: int, exec_index: int,
-                fence: int) -> None:
+                fence: int, table: Optional[DriverTable] = None) -> None:
         """Fenced driver-table publish (endpoints._on_publish →
         DriverTable.publish). Records the CAS outcome the fence-winner
-        invariant checks."""
-        applied = self.table.publish(map_id, token, exec_index, fence)
+        invariant checks. ``table`` lets a re-sent publish land on a
+        promoted standby's restored table — the fence bookkeeping is
+        logical (per map, executor), shared across incarnations, so an
+        idempotent re-send (equal fence) stays legal and a regression
+        does not."""
+        tbl = self.table if table is None else table
+        applied = tbl.publish(map_id, token, exec_index, fence)
         key = (map_id, exec_index)
         prev = self.applied_fences.get(key)
         if applied:
@@ -169,6 +206,131 @@ class World:
     def deliver_dead(self, obs: int) -> None:
         self.observers[obs].note_epoch(self.sid, EPOCH_DEAD)
         self.obs_dead[obs].add(self.sid)
+
+    # -- driver HA mirrors (shuffle/ha.py + endpoints oplog glue) --------
+
+    def lease_acquire(self, holder: str, term: int, now: float,
+                      ttl_s: float = 10.0) -> bool:
+        """Standby takeover CAS (DriverStandby._watch_lease →
+        LeaseStore.try_acquire). Every successful acquire is recorded so
+        the lease-single-holder invariant can see a double grant."""
+        ok = self.lease.try_acquire(holder, term, ttl_s, now=now)
+        if ok:
+            self.lease_holders.setdefault(term, set()).add(holder)
+        return ok
+
+    def lease_renew(self, holder: str, term: int, now: float,
+                    ttl_s: float = 10.0) -> bool:
+        """Primary heartbeat renew (endpoints._lease_loop). A renew that
+        succeeds after a HIGHER term was granted means the store let a
+        zombie extend a fenced lease — the failure `renew` exists to
+        surface."""
+        ok = self.lease.renew(holder, term, ttl_s, now=now)
+        if ok and any(t > term for t in self.lease_holders):
+            self.problem = (
+                f"lease-single-holder: {holder} renewed term {term} "
+                f"after term {max(self.lease_holders)} was granted")
+        return ok
+
+    def primary_log(self, sem: Tuple, incarnation: int = 0
+                    ) -> Tuple[OpRecord, Tuple]:
+        """Primary-side op append (endpoints._log_op): the writer's
+        OpLog stamps (incarnation, seq); ``sem`` is the semantic payload
+        the replay interprets."""
+        kinds = {"publish": OP_WIRE, "charge": OP_WIRE,
+                 "release": OP_WIRE, "bump": OP_BUMP,
+                 "register": OP_REGISTER, "unregister": OP_UNREGISTER}
+        oplog = self.oplogs.setdefault(
+            incarnation, OpLog(incarnation=incarnation))
+        rec = oplog.append(kinds[sem[0]], b"")
+        self.ops.append((rec, sem))
+        return rec, sem
+
+    def standby_deliver(self, name: str, rec: OpRecord,
+                        sem: Tuple) -> None:
+        """Standby stream ingest (DriverStandby._handle OpLogAppendMsg):
+        accept only strictly forward (incarnation, seq) — the fence that
+        keeps a zombie primary's appends out of the replicated log."""
+        last = self.repl_last.get(name, (0, 0))
+        if (rec.incarnation, rec.seq) <= last:
+            return
+        term = self.promote_term.get(name)
+        if term is not None and rec.incarnation < term:
+            # unreachable while the guard above holds (promotion set
+            # repl_last to (term, 0)); a tripwire, not a code path
+            self.problem = (
+                f"ha-fence: standby {name} (promoted at term {term}) "
+                f"admitted an incarnation-{rec.incarnation} op")
+            return
+        self.replicated.setdefault(name, []).append((rec, sem))
+        self.repl_last[name] = (rec.incarnation, rec.seq)
+
+    def takeover(self, name: str, term: int, now: float) -> Dict:
+        """Promotion replay (DriverStandby.promote → DriverEndpoint
+        restore): rebuild the tables from the replicated prefix with
+        REAL classes, re-apply the wire-shaped ops a second time to
+        prove replay idempotency, conserve the ledger through the
+        replay, re-derive the TTL sweep from replicated register times,
+        and rebase the epoch under the won term's incarnation."""
+        del now
+        self.promote_term[name] = term
+        self.incarnation = term
+        self.repl_last[name] = max(self.repl_last.get(name, (0, 0)),
+                                   (term, 0))
+        table = DriverTable(self.num_maps)
+        ledger = TenantLedger("modelcheck-replay", quota=0)
+        expected: Dict[int, int] = {}
+        fences: Dict[Tuple[int, int], int] = {}
+        live, bumps = True, 0
+        prefix = list(self.replicated.get(name, []))
+        for _rec, sem in prefix:
+            kind = sem[0]
+            if kind == "publish":
+                _k, map_id, token, exec_index, fence = sem
+                if table.publish(map_id, token, exec_index, fence):
+                    prev = fences.get((map_id, exec_index))
+                    if prev is not None and fence < prev:
+                        self.problem = (
+                            f"fence-winner: replay at {name} applied "
+                            f"fence {fence} after {prev}")
+                    fences[(map_id, exec_index)] = max(prev or 0, fence)
+            elif kind == "charge":
+                ledger.charge(sem[1], sem[2])
+                expected[sem[1]] = expected.get(sem[1], 0) + sem[2]
+            elif kind == "release":
+                ledger.release(sem[1], sem[2])
+                expected[sem[1]] = expected.get(sem[1], 0) - sem[2]
+            elif kind == "bump":
+                bumps += 1
+            elif kind == "unregister":
+                live = False
+        # replay idempotency: applying every wire-shaped op a second
+        # time against the restored table must be a no-op (fence floors
+        # re-admit equal fences without changing state)
+        snap = table.to_bytes()
+        for _rec, sem in prefix:
+            if sem[0] == "publish":
+                table.publish(sem[1], sem[2], sem[3], sem[4])
+        if table.to_bytes() != snap:
+            self.problem = (f"ha-replay: second application of the "
+                            f"replicated prefix changed {name}'s table")
+        # ledger conservation through replay
+        for tenant, exp in expected.items():
+            if exp < 0 or ledger.usage(tenant) != exp:
+                self.problem = (
+                    f"ledger-conserve: replay at {name} rebuilt tenant "
+                    f"{tenant} usage {ledger.usage(tenant)} != live "
+                    f"charges {exp}")
+        # re-derived TTL sweep: the register time rode the log, so an
+        # expired shuffle dies here whether or not the primary's
+        # unregister op was ever replicated
+        if live and self.ttl_expired:
+            live = False
+        if not live:
+            self.dead_shuffles.setdefault(
+                self.sid, self.epochs.get(self.sid, 1))
+        return {"table": table, "live": live,
+                "epoch": rebase_epoch(1 + bumps, term)}
 
 
 class MergeTargetModel:
@@ -386,6 +548,23 @@ def check_invariants(world: World,
             if s1[slot] != SLOT_LIVE:
                 return (f"member-legal: slot {slot} joined in state "
                         f"{s1[slot]} (joiners must start LIVE)")
+
+    # lease-single-holder: the CAS admits exactly one winner per term
+    for term, holders in world.lease_holders.items():
+        if len(holders) > 1:
+            return (f"lease-single-holder: term {term} granted to "
+                    f"{sorted(holders)}")
+
+    # no-resurrect: once an observer processed EPOCH_DEAD (and nothing
+    # re-registered the id in the model), no later step — including a
+    # promoted standby's re-broadcast — may re-arm it with a positive
+    # epoch
+    for i, plane in enumerate(world.observers):
+        for sid in world.obs_dead[i]:
+            e = plane.known_epoch(sid)
+            if e is not None and e > 0:
+                return (f"no-resurrect: observer {i} re-armed DEAD "
+                        f"shuffle {sid} at epoch {e}")
 
     # ledger-conserve: usage == charges - releases of live state, >= 0
     for tenant, expected in world.expected_usage.items():
@@ -711,6 +890,273 @@ def _build_push_vs_tombstone(sched: VirtualScheduler) -> World:
                             "staged ranges")
     sched.post("reduce.consume.p0", consume, chan="reducer",
                touches={"pushed"})
+    return world
+
+
+@scenario("driver_failover_mid_publish",
+          "the primary dies with publishes in flight and a partially "
+          "replicated op-log; the standby CAS-takes the lease, replays "
+          "with real classes, and re-broadcasts under the next "
+          "incarnation — re-sent publishes must be idempotent and "
+          "every epoch push monotone ACROSS incarnations")
+def _build_driver_failover_mid_publish(sched: VirtualScheduler) -> World:
+    world = World(num_observers=2, num_maps=2)
+    sid = world.sid
+    world.lease_acquire("primary", 0, now=0.0)
+    # committed pre-history at the primary: map0's publish plus its
+    # staging charge, already appended to the incarnation-0 log
+    world.publish(0, 700, 0, fence=1)
+    world.charge(9, 100)
+    rec0 = world.primary_log(("publish", 0, 700, 0, 1))
+    rec0c = world.primary_log(("charge", 9, 100))
+    snap1 = DriverTable.from_bytes(world.table.to_bytes())
+    state = {"table": world.table}
+
+    def repl(recs):
+        def deliver(s, recs=recs):
+            del s
+            for r in recs:
+                world.standby_deliver("sb", *r)
+        return deliver
+
+    # the replication stream is FIFO to the standby but races everything
+    # else the dying primary does
+    sched.post("repl.pub0", repl([rec0, rec0c]), chan="standby.stream",
+               touches={"standby"})
+
+    # epoch-1 table responses already in flight to both observers
+    for i in range(2):
+        def resp1(s, i=i):
+            del s
+            world.observers[i].put_table(sid, snap1, 1)
+        sched.post(f"resp.e1->obs{i}", resp1, chan=f"obs{i}.resp",
+                   touches={f"obs{i}"})
+
+    # map1's publish lands at the primary mid-death; its log append may
+    # or may not reach the standby before the takeover
+    def pub1(s):
+        world.publish(1, 701, 1, fence=1)
+        world.charge(9, 60)
+        r1 = world.primary_log(("publish", 1, 701, 1, 1))
+        r2 = world.primary_log(("charge", 9, 60))
+        s.post("repl.pub1", repl([r1, r2]), chan="standby.stream",
+               touches={"standby"})
+    sched.post("drv.pub1", pub1, touches={"table", "standby"})
+
+    # lease expired: the standby CAS-takes term 1, replays whatever
+    # prefix it holds, and re-broadcasts rebased state
+    def takeover(s):
+        if not world.lease_acquire("sb", 1, now=11.0):
+            return
+        st = world.takeover("sb", 1, now=11.0)
+        state["table"] = st["table"]
+        for i in range(len(world.observers)):
+            def bump(s2, i=i, e=st["epoch"]):
+                del s2
+                world.observers[i].note_epoch(sid, e)
+            s.post(f"takeover.e->obs{i}", bump, chan=f"obs{i}.push",
+                   touches={f"obs{i}"})
+
+            def resp2(s2, i=i, e=st["epoch"], t=st["table"]):
+                del s2
+                world.observers[i].put_table(sid, t, e)
+            s.post(f"takeover.table->obs{i}", resp2,
+                   chan=f"obs{i}.resp", touches={f"obs{i}"})
+    sched.post("sb.takeover", takeover,
+               touches={"lease", "standby", "table", "obs0", "obs1"})
+
+    # DriverClient re-sends both publishes against whoever is primary —
+    # the fence floors make the re-send a no-op or a legal first apply
+    sched.post("repub.m0",
+               lambda s: world.publish(0, 700, 0, fence=1,
+                                       table=state["table"]),
+               chan="exec0.drv", touches={"table"})
+    sched.post("repub.m1",
+               lambda s: world.publish(1, 701, 1, fence=1,
+                                       table=state["table"]),
+               chan="exec1.drv", touches={"table"})
+    return world
+
+
+@scenario("split_brain_two_leases",
+          "two standbys race the term-1 CAS while the primary's renew "
+          "heartbeats ride their own channel; exactly one holder per "
+          "term, only the winner promotes, and a live lease refuses "
+          "the loser's next-term retry")
+def _build_split_brain_two_leases(sched: VirtualScheduler) -> World:
+    world = World(num_observers=2, num_maps=1)
+    sid = world.sid
+    world.lease_acquire("primary", 0, now=0.0)  # expires at now=10
+
+    def promote(s, name: str, term: int, now: float) -> None:
+        st = world.takeover(name, term, now=now)
+        for i in range(len(world.observers)):
+            def bump(s2, i=i, e=st["epoch"]):
+                del s2
+                world.observers[i].note_epoch(sid, e)
+            s.post(f"{name}.t{term}.e->obs{i}", bump,
+                   chan=f"obs{i}.push", touches={f"obs{i}"})
+
+    # renew heartbeats: the first lands before expiry (extends), the
+    # second races the standbys — it must fail once term 1 is granted
+    sched.post("primary.renew1",
+               lambda s: world.lease_renew("primary", 0, now=9.0),
+               chan="primary.lease", touches={"lease"})
+    sched.post("primary.renew2",
+               lambda s: world.lease_renew("primary", 0, now=12.0),
+               chan="primary.lease", touches={"lease"})
+
+    def acquire(name: str, term: int, now: float):
+        def fire(s):
+            if world.lease_acquire(name, term, now=now):
+                promote(s, name, term, now)
+        return fire
+    sched.post("sbA.acquire", acquire("sbA", 1, 11.0), chan="sbA",
+               touches={"lease", "standby", "obs0", "obs1"})
+    sched.post("sbB.acquire", acquire("sbB", 1, 11.5), chan="sbB",
+               touches={"lease", "standby", "obs0", "obs1"})
+    # next-term retries: a LIVE term-1 lease held by the other standby
+    # must refuse these (no term burn while the holder is alive)
+    sched.post("sbA.retry", acquire("sbA", 2, 12.0), chan="sbA",
+               touches={"lease", "standby", "obs0", "obs1"})
+    sched.post("sbB.retry", acquire("sbB", 2, 12.5), chan="sbB",
+               touches={"lease", "standby", "obs0", "obs1"})
+    return world
+
+
+@scenario("zombie_primary_publish",
+          "a fenced old primary keeps its connections: renew attempts, "
+          "old-incarnation epoch pushes, and log appends race the new "
+          "primary's re-broadcast — every one must lose to the "
+          "incarnation component everywhere an epoch is compared")
+def _build_zombie_primary_publish(sched: VirtualScheduler) -> World:
+    world = World(num_observers=2, num_maps=2)
+    sid = world.sid
+    world.lease_acquire("primary", 0, now=0.0)
+    world.publish(0, 800, 0, fence=1)
+    rec0 = world.primary_log(("publish", 0, 800, 0, 1))
+    world.standby_deliver("sb", *rec0)  # replicated before the death
+    snap1 = DriverTable.from_bytes(world.table.to_bytes())
+    state = {"table": world.table}
+    for i in range(2):
+        world.observers[i].put_table(sid, snap1, 1)
+        world.observers[i].note_epoch(sid, 1)
+
+    def takeover(s):
+        if not world.lease_acquire("sb", 1, now=11.0):
+            return
+        st = world.takeover("sb", 1, now=11.0)
+        state["table"] = st["table"]
+        for i in range(len(world.observers)):
+            def bump(s2, i=i, e=st["epoch"]):
+                del s2
+                world.observers[i].note_epoch(sid, e)
+            s.post(f"takeover.e->obs{i}", bump, chan=f"obs{i}.push",
+                   touches={f"obs{i}"})
+    sched.post("sb.takeover", takeover,
+               touches={"lease", "standby", "table", "obs0", "obs1"})
+
+    # the zombie's renew: legal only while no higher term exists (the
+    # lease_renew mirror flags a post-takeover success)
+    sched.post("zombie.renew",
+               lambda s: world.lease_renew("primary", 0, now=11.5),
+               chan="primary.lease", touches={"lease"})
+
+    # the zombie's epoch-bump pushes carry small incarnation-0 values;
+    # they ride its own still-open connections (distinct channels from
+    # the new primary's pushes) and must never regress an observer
+    zbump = world.epochs[sid] + 1
+    for i in range(2):
+        def zb(s, i=i):
+            del s
+            world.observers[i].note_epoch(sid, zbump)
+        sched.post(f"zombie.bump->obs{i}", zb, chan=f"obs{i}.zpush",
+                   touches={f"obs{i}"})
+
+    # the zombie applies + appends a publish: before the takeover it is
+    # a legitimate primary (the op replicates and replays); after, the
+    # (incarnation, seq) guard at the standby fences the append
+    def zappend(s):
+        del s
+        world.publish(1, 801, 0, fence=1)
+        rec = world.primary_log(("publish", 1, 801, 0, 1),
+                                incarnation=0)
+        world.standby_deliver("sb", *rec)
+    sched.post("zombie.append", zappend, chan="standby.stream",
+               touches={"table", "standby"})
+
+    # an executor re-sends map0's publish to whoever is primary
+    sched.post("repub.m0",
+               lambda s: world.publish(0, 800, 0, fence=1,
+                                       table=state["table"]),
+               chan="exec0.drv", touches={"table"})
+    return world
+
+
+@scenario("failover_vs_ttl_sweep",
+          "the TTL sweep's unregister races its own replication and "
+          "the takeover; the promoted standby re-derives the sweep "
+          "from replicated register times, so a DEAD shuffle stays "
+          "dead whether or not the unregister op ever replicated")
+def _build_failover_vs_ttl_sweep(sched: VirtualScheduler) -> World:
+    world = World(num_observers=2, num_maps=2)
+    sid = world.sid
+    world.lease_acquire("primary", 0, now=0.0)
+    world.publish(0, 900, 0, fence=1)
+    world.publish(1, 901, 1, fence=1)
+    for rec in (world.primary_log(("publish", 0, 900, 0, 1)),
+                world.primary_log(("publish", 1, 901, 1, 1))):
+        world.standby_deliver("sb", *rec)
+    # the register time rode the log at register; by now the TTL is
+    # past, so the primary's sweep AND a promoted standby's re-derived
+    # sweep both see the shuffle expired
+    world.ttl_expired = True
+    snap = DriverTable.from_bytes(world.table.to_bytes())
+
+    for i in range(2):
+        def resp(s, i=i):
+            del s
+            world.observers[i].put_table(sid, snap, 1)
+        sched.post(f"resp.e1->obs{i}", resp, chan=f"obs{i}.resp",
+                   touches={f"obs{i}"})
+
+    def sweep(s):
+        world.unregister()
+        rec = world.primary_log(("unregister",))
+        # append-before-push: the broadcaster queues the standby stream
+        # send ahead of the EPOCH_DEAD pushes, but the standby's
+        # PROCESSING still races them — which is exactly why the
+        # promoted standby must re-derive the sweep instead of trusting
+        # this op to have arrived
+        s.post("repl.unreg",
+               lambda s2: world.standby_deliver("sb", *rec),
+               chan="standby.stream", touches={"standby"})
+        for i in range(len(world.observers)):
+            s.post(f"dead->obs{i}",
+                   lambda s2, i=i: world.deliver_dead(i),
+                   chan=f"obs{i}.push", touches={f"obs{i}"})
+    sched.post("ttl.sweep", sweep,
+               touches={"driver", "standby", "obs0", "obs1"})
+
+    def takeover(s):
+        if not world.lease_acquire("sb", 1, now=11.0):
+            return
+        st = world.takeover("sb", 1, now=11.0)
+        if st["live"]:
+            # only a live restored shuffle re-broadcasts positive state
+            for i in range(len(world.observers)):
+                def bump(s2, i=i, e=st["epoch"]):
+                    del s2
+                    world.observers[i].note_epoch(sid, e)
+                s.post(f"takeover.e->obs{i}", bump,
+                       chan=f"obs{i}.push", touches={f"obs{i}"})
+        else:
+            for i in range(len(world.observers)):
+                s.post(f"takeover.dead->obs{i}",
+                       lambda s2, i=i: world.deliver_dead(i),
+                       chan=f"obs{i}.push", touches={f"obs{i}"})
+    sched.post("sb.takeover", takeover,
+               touches={"lease", "standby", "obs0", "obs1"})
     return world
 
 

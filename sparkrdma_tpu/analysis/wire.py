@@ -187,6 +187,13 @@ def _gen_arg(name: str, rng: random.Random):
         return rng.choice([None,
                            [rng.randrange(1 << 31)
                             for _ in range(rng.randrange(8))]])
+    if name == "blob":
+        # HA frames: op payload / snapshot envelope — opaque bytes
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+    if name in ("name", "host"):
+        # lease-holder identity / standby address host
+        return "".join(rng.choice("abc-xyz.0123") for _ in
+                       range(rng.randrange(1, 16)))
     if name == "manager_id":
         return _mk_manager_id(rng)
     if name == "manager_ids":
@@ -251,6 +258,26 @@ _EXTRA_CASES: Dict[str, List[Callable[[], "rpc_msg.RpcMsg"]]] = {
     "PushPlannedResp": [
         lambda: M.PushPlannedResp(1, M.STATUS_UNKNOWN_SHUFFLE, b""),
         lambda: M.PushPlannedResp(1, M.STATUS_OK, b"\x00\x00\x00")],
+    # driver-HA corners (msgs 42-45): the incarnation-0 identity stamps
+    # a pre-failover log writes, max-u32 incarnation + max-u64 seq (the
+    # unsigned pack boundaries), an empty op/snapshot blob, an
+    # empty-name standby hello (a misconfigured holder id must still
+    # round-trip, the lease CAS rejects it later), and a takeover
+    # re-pointing to a long hostname
+    "OpLogAppendMsg": [
+        lambda: M.OpLogAppendMsg(0, 1, 1, b""),
+        lambda: M.OpLogAppendMsg((1 << 32) - 1, (1 << 64) - 1, 8,
+                                 b"\x00" * 3)],
+    "SnapshotMsg": [
+        lambda: M.SnapshotMsg(0, 0, b""),
+        lambda: M.SnapshotMsg((1 << 32) - 1, (1 << 64) - 1, b"{}")],
+    "StandbyHelloMsg": [
+        lambda: M.StandbyHelloMsg("", "", 0, 0),
+        lambda: M.StandbyHelloMsg("sb-1", "h" * 200, (1 << 32) - 1,
+                                  (1 << 64) - 1)],
+    "TakeoverMsg": [
+        lambda: M.TakeoverMsg(0, "127.0.0.1", 1),
+        lambda: M.TakeoverMsg((1 << 32) - 1, "x" * 128, (1 << 32) - 1)],
 }
 
 

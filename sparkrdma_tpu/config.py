@@ -573,6 +573,32 @@ _KEYS = [
              "selector (device-or-host for the whole stage, the "
              "regression escape hatch); single-slice meshes are "
              "unaffected either way."),
+    # --- driver HA (TPU-only: shuffle/ha.py, docs/CONFIG.md "Driver HA")
+    _Key("ha_standbys", 0, "int", 0, 16,
+         doc="Replicated-driver standby count the deployment intends to "
+             "run (0 = HA off, the single-driver behavior — no op log "
+             "kept, no lease taken). Nonzero arms the driver's OpLog "
+             "and lets StandbyHello registrations stream it; the value "
+             "itself is advisory (standbys register dynamically) but "
+             "gates the whole subsystem so non-HA deployments pay "
+             "nothing."),
+    _Key("driver_lease_ms", 5000, "int", 100, 3600_000,
+         doc="Driver leadership lease TTL. The primary renews at a "
+             "quarter of this; a standby whose poll sees the lease "
+             "expired CAS-takes the next term and promotes. This is "
+             "the failover detection bound AND the zombie-primary "
+             "window bound: a deposed primary can keep pushing for at "
+             "most one lease after losing renewal, and every such push "
+             "is fenced by its stale incarnation. Size it well under "
+             "request_deadline_ms so executor retries ride through a "
+             "failover."),
+    _Key("oplog_snapshot_every", 256, "int", 1, 1 << 20,
+         doc="Op-log compaction period: after this many appended ops "
+             "the primary folds state into a fresh snapshot and "
+             "truncates the tail, bounding both standby catch-up time "
+             "and driver memory. Smaller = faster cold-standby "
+             "catch-up, more snapshot encode work on the mutation "
+             "path."),
 ]
 
 _KEY_MAP: Dict[str, _Key] = {k.name: k for k in _KEYS}

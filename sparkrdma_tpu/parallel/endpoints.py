@@ -23,7 +23,9 @@ role the (address, lkey) pair plays in the reference.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import os
 import queue
 import struct
 import threading
@@ -32,7 +34,10 @@ from typing import Dict, List, Optional, Protocol, Tuple
 
 from sparkrdma_tpu.config import TpuShuffleConf
 from sparkrdma_tpu.parallel import messages as M
-from sparkrdma_tpu.parallel.rpc_msg import AnnounceMsg, HelloMsg, RpcMsg
+from sparkrdma_tpu.parallel.driver_client import (DriverClient,
+                                                  DriverUnreachableError)
+from sparkrdma_tpu.parallel.rpc_msg import (AnnounceMsg, HelloMsg, RpcMsg,
+                                            decode_message)
 from sparkrdma_tpu.parallel.transport import (
     ChecksumError,
     Connection,
@@ -125,9 +130,28 @@ class ShuffleDataSource(Protocol):
 
 
 class DriverEndpoint:
-    """Control-plane driver."""
+    """Control-plane driver.
 
-    def __init__(self, conf: Optional[TpuShuffleConf] = None, host: str = ""):
+    With driver HA armed (``ha_standbys`` > 0, or constructed by a
+    promoting :class:`~sparkrdma_tpu.shuffle.ha.DriverStandby`), every
+    mutation of the tables below is wrapped in an
+    :class:`~sparkrdma_tpu.shuffle.ha.OpLog` and streamed to registered
+    standbys over the same push channel executors use. ``incarnation``
+    is the lease term this endpoint was built at: it composes into the
+    HIGH bits of every epoch this endpoint mints
+    (:func:`~sparkrdma_tpu.shuffle.ha.compose_epoch`), so after a
+    failover every epoch the new primary publishes strictly dominates
+    anything the deposed one can still push — the existing keep-highest
+    guards ARE the zombie fence. ``restore`` is the promoting standby's
+    ``(snapshot_blob | None, tail_records)``: replayed before serving,
+    then the authoritative state is re-broadcast (membership, epoch
+    rebases, plans, re-finalize, TakeoverMsg)."""
+
+    def __init__(self, conf: Optional[TpuShuffleConf] = None, host: str = "",
+                 incarnation: int = 0, server: Optional[ControlServer] = None,
+                 lease_store=None, lease_holder: Optional[str] = None,
+                 restore=None):
+        from sparkrdma_tpu.shuffle.ha import OpLog
         self.conf = conf or TpuShuffleConf()
         bind_host = host or self.conf.driver_host or "127.0.0.1"
         # elastic membership (parallel/membership.py): the epoch-versioned
@@ -223,12 +247,51 @@ class DriverEndpoint:
         self._tenants: Dict[int, int] = {}
         self._register_times: Dict[int, float] = {}
         self.gc_expired = 0  # audit: TTL-expired shuffles unregistered
+        # driver HA (shuffle/ha.py): the replicated-state-machine plane.
+        # The op log is armed when HA is configured or this endpoint was
+        # promoted from a standby; _ha_lock (reentrant: logged mutations
+        # nest — a replayed publish derives epoch bumps) serializes
+        # {append, replicate-queue, apply, compact} so log order IS
+        # apply order and a snapshot at seq S reflects every op <= S.
+        self.incarnation = int(incarnation)
+        ha_armed = (self.conf.ha_standbys > 0 or self.incarnation > 0
+                    or lease_store is not None)
+        self.oplog = (OpLog(self.incarnation,
+                            self.conf.oplog_snapshot_every)
+                      if ha_armed else None)
+        self._ha_lock = threading.RLock()
+        self._standbys: List[Tuple[str, str, int]] = []  # (name, host, port)
+        self._standbys_lock = threading.Lock()
+        self._replaying = False
+        self._derived = threading.local()  # in-apply derived-mutation flag
+        self.lease_store = lease_store
+        self.lease_holder = lease_holder or f"driver-{os.getpid()}"
+        self._lease_lost = threading.Event()
+        self.ha_failovers_count = 0  # audit: takeovers this endpoint did
         # the server LAST: its accept thread dispatches hellos/joins the
         # moment the socket opens, and the handlers touch membership,
         # admission and tracer state — every field above must exist
-        # before the first frame can arrive
-        self.server = ControlServer(bind_host, self.conf.driver_port,
-                                    self.conf, self._handle, name="driver")
+        # before the first frame can arrive. A promoting standby hands
+        # its OWN server in: its handler delegates here only after
+        # promotion returns, so no frame reaches a half-built endpoint.
+        if server is not None:
+            self.server = server
+        else:
+            self.server = ControlServer(bind_host, self.conf.driver_port,
+                                        self.conf, self._handle,
+                                        name="driver")
+        if restore is not None:
+            self._restore(restore)
+        self._lease_thread: Optional[threading.Thread] = None
+        if self.lease_store is not None:
+            ttl_s = self.conf.driver_lease_ms / 1000
+            # a fresh primary claims its term; a promoted one already
+            # holds it (try_acquire refuses term == current, harmlessly)
+            self.lease_store.try_acquire(self.lease_holder,
+                                         self.incarnation, ttl_s)
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, daemon=True, name="driver-lease")
+            self._lease_thread.start()
         self._gc_thread: Optional[threading.Thread] = None
         if self.conf.shuffle_ttl_ms > 0:
             self._gc_thread = threading.Thread(
@@ -238,6 +301,334 @@ class DriverEndpoint:
     @property
     def address(self) -> Tuple[str, int]:
         return self.server.host, self.server.port
+
+    # -- driver HA: op log, snapshots, restore (shuffle/ha.py) -----------
+
+    def _ha_apply(self, kind: int, payload: bytes, apply_fn):
+        """Log one mutation, replicate it, apply it, maybe compact —
+        one critical section. The append and its standby-stream push
+        are queued BEFORE ``apply_fn`` runs (and so before any
+        executor-facing push the apply queues): the broadcaster drains
+        FIFO, so a standby holds the op before any executor observes
+        its effect — the ordering the failover_vs_ttl_sweep model
+        scenario depends on. Derived mutations inside the apply (epoch
+        bumps a publish causes, tombstone fallout) see
+        ``_derived.active`` and skip logging themselves: replay
+        re-derives them from the logged cause."""
+        if self.oplog is None or self._replaying:
+            return apply_fn()
+        with self._ha_lock:
+            self._log_op(kind, payload)
+            was = getattr(self._derived, "active", False)
+            self._derived.active = True
+            try:
+                out = apply_fn()
+            finally:
+                self._derived.active = was
+            self._maybe_compact()
+            return out
+
+    def _in_derived_apply(self) -> bool:
+        return getattr(self._derived, "active", False)
+
+    def _log_op(self, kind: int, payload: bytes) -> None:
+        rec = self.oplog.append(kind, payload)
+        with self._standbys_lock:
+            standbys = list(self._standbys)
+        for _name, h, p in standbys:
+            self._queue_push((h, p), M.OpLogAppendMsg(
+                rec.incarnation, rec.seq, rec.kind, rec.payload))
+
+    def _maybe_compact(self) -> None:
+        """Fold state into a snapshot every ``oplog_snapshot_every``
+        ops. Runs AFTER the triggering op applied (inside _ha_lock), so
+        the snapshot at seq S really contains every op <= S and the
+        truncated tail loses nothing."""
+        from sparkrdma_tpu.shuffle import ha
+        if not self.oplog.snapshot_due():
+            return
+        seq = self.oplog.last_seq()
+        blob = ha.encode_snapshot(self.snapshot_state())
+        self.oplog.install_snapshot(seq, blob)
+        with self._standbys_lock:
+            standbys = list(self._standbys)
+        for _name, h, p in standbys:
+            self._queue_push((h, p), M.SnapshotMsg(self.incarnation, seq,
+                                                   blob))
+
+    def snapshot_state(self) -> dict:
+        """The replicated control-plane state as a plain dict (bytes
+        leaves allowed — the ha snapshot codec base64s them). Size
+        histograms are deliberately NOT carried: publishes after the
+        snapshot re-feed them via the logged frames, and a post-failover
+        plan built from a thinner histogram is still a valid plan (the
+        planner degrades to coarser splits, never to an error)."""
+        unix_now, mono_now = time.time(), time.monotonic()
+        with self._tables_lock:
+            shuffles = {}
+            for sid, table in self._tables.items():
+                plan = self._plans.get(sid)
+                merged = self._merged.get(sid)
+                shuffles[str(sid)] = {
+                    "num_maps": table.num_maps,
+                    "num_partitions": self._num_partitions.get(sid, 0),
+                    "tenant": self._tenants.get(sid, 0),
+                    "epoch": self._epochs.get(sid, 1),
+                    # wall-clock registration time: monotonic clocks
+                    # don't travel between processes, and the promoted
+                    # standby must re-derive the TTL sweep from the
+                    # REPLICATED registration time (the no-resurrect
+                    # invariant), not from its own replay instant
+                    "reg_unix": unix_now - (mono_now
+                                            - self._register_times.get(
+                                                sid, mono_now)),
+                    "table": table.to_bytes(),
+                    "plan": (plan.to_bytes() if plan is not None
+                             else None),
+                    "merged": (merged.to_bytes() if merged is not None
+                               else None),
+                    "finalized": sid in self._finalize_sent,
+                }
+        members, states, epoch = self.membership.snapshot()
+        return {"shuffles": shuffles,
+                "membership": {"members": [m.serialize() for m in members],
+                               "states": list(states),
+                               "epoch": epoch}}
+
+    def _restore(self, restore) -> None:
+        """Replay ``(snapshot_blob | None, tail_records)`` into this
+        endpoint, then re-broadcast the authoritative state under the
+        new incarnation. Executor-facing pushes are suppressed during
+        the replay (_queue_push drops them) — the takeover re-announce
+        at the end is the one authoritative broadcast."""
+        from sparkrdma_tpu.shuffle import ha
+        blob, tail = restore
+        self._replaying = True  # analysis: unguarded-ok(restore runs in __init__ before the server dispatches any handler thread)
+        try:
+            if blob:
+                self._load_snapshot(ha.decode_snapshot(blob))
+            for rec in sorted(tail, key=lambda r: (r.incarnation, r.seq)):
+                try:
+                    self._apply_op(rec)
+                except Exception:  # noqa: BLE001 — one bad op must not
+                    # strand the takeover; the rebased re-announce below
+                    # still invalidates every stale cache
+                    log.exception("driver restore: op (%d,%d) kind %d "
+                                  "failed", rec.incarnation, rec.seq,
+                                  rec.kind)
+        finally:
+            self._replaying = False  # analysis: unguarded-ok(still inside __init__, single-threaded)
+        # seed OUR log with a complete snapshot at seq 0: a standby
+        # registering before the first compaction must receive the
+        # restored state, or a second failover would lose it
+        self.oplog.install_snapshot(0, ha.encode_snapshot(
+            self.snapshot_state()))
+        self._announce_takeover()
+
+    def _load_snapshot(self, state: dict) -> None:
+        from sparkrdma_tpu.shuffle.push_merge import MergedDirectory
+        from sparkrdma_tpu.shuffle.planner import ReducePlan
+        from sparkrdma_tpu.shuffle.tenancy import AdmissionRejected
+        unix_now, mono_now = time.time(), time.monotonic()
+        mem = state.get("membership", {})
+        if mem.get("members"):
+            members = []
+            for raw in mem["members"]:
+                mid, _ = ShuffleManagerId.deserialize(raw)
+                members.append(mid)
+            self.membership.restore(members, list(mem.get("states", [])),
+                                    int(mem.get("epoch", 0)))
+        for key, s in state.get("shuffles", {}).items():
+            sid = int(key)
+            tenant = int(s.get("tenant", 0))
+            try:
+                self.admission.admit(tenant, sid)
+            except AdmissionRejected:
+                # config drift between primaries; the shuffle EXISTS, so
+                # restore it anyway — admission re-converges on its next
+                # unregister
+                log.warning("driver restore: admission rejected restored "
+                            "shuffle %d (tenant %d); restoring anyway",
+                            sid, tenant)
+            with self._tables_lock:
+                self._tables[sid] = DriverTable.from_bytes(s["table"])
+                self._epochs[sid] = int(s.get("epoch", 1))
+                self._num_partitions[sid] = int(s.get("num_partitions", 0))
+                self._tenants[sid] = tenant
+                age = max(0.0, unix_now - float(s.get("reg_unix",
+                                                      unix_now)))
+                self._register_times[sid] = mono_now - age
+                if s.get("plan") is not None:
+                    self._plans[sid] = ReducePlan.from_bytes(s["plan"])
+                if s.get("merged") is not None:
+                    self._merged[sid] = MergedDirectory.from_bytes(
+                        s["merged"])
+                if s.get("finalized"):
+                    self._finalize_sent.add(sid)
+                if self.conf.adaptive_plan and sid not in self._size_hists:
+                    from sparkrdma_tpu.shuffle.planner import SizeHistogram
+                    self._size_hists[sid] = SizeHistogram(
+                        int(s["num_maps"]), int(s.get("num_partitions",
+                                                      0)))
+
+    def _apply_op(self, rec) -> None:
+        """Replay one op record (``_replaying`` is set: handlers mutate
+        but push nothing). OP_WIRE replays the encoded frame through the
+        normal dispatch — fence floors and epoch guards make an op the
+        snapshot already contains a no-op, which is what the replay
+        idempotency tests pin."""
+        from sparkrdma_tpu.shuffle import ha
+        if rec.kind == ha.OP_WIRE:
+            try:
+                msg = decode_message(rec.payload)
+            except ValueError:
+                log.warning("driver restore: undecodable wire op (%d,%d)",
+                            rec.incarnation, rec.seq)
+                return
+            self._handle(None, msg)
+        elif rec.kind == ha.OP_REGISTER:
+            sid, num_maps, num_partitions, tenant, reg_unix = \
+                ha.unpack_register(rec.payload)
+            self.register_shuffle(sid, num_maps, num_partitions, tenant)
+            with self._tables_lock:
+                if sid in self._register_times:
+                    age = max(0.0, time.time() - reg_unix)
+                    self._register_times[sid] = time.monotonic() - age
+        elif rec.kind == ha.OP_UNREGISTER:
+            self.unregister_shuffle(ha.unpack_sid(rec.payload))
+        elif rec.kind == ha.OP_BUMP:
+            self.bump_epoch(ha.unpack_sid(rec.payload),
+                            reason="replayed bump")
+        elif rec.kind == ha.OP_TOMBSTONE:
+            mid, _ = ShuffleManagerId.deserialize(rec.payload)
+            self.remove_member(mid)
+        elif rec.kind == ha.OP_DRAIN:
+            slot, step = ha.unpack_drain(rec.payload)
+            self.drain_transition(slot, step)
+        elif rec.kind == ha.OP_PLAN:
+            from sparkrdma_tpu.shuffle.planner import ReducePlan
+            plan = ReducePlan.from_bytes(rec.payload)
+            self._install_plan(plan.shuffle_id, plan)
+        elif rec.kind == ha.OP_FINALIZE:
+            self.finalize_merge(ha.unpack_sid(rec.payload))
+        else:
+            log.warning("driver restore: unknown op kind %d", rec.kind)
+
+    def _announce_takeover(self) -> None:
+        """The promoted primary's one authoritative re-broadcast:
+        membership snapshot, every live shuffle's location epoch rebased
+        into the new incarnation, the newest plans, re-finalize triggers
+        (merge targets idempotently re-publish segments the op-log lag
+        window may have missed), and the TakeoverMsg that re-points
+        every executor's DriverClient."""
+        from sparkrdma_tpu.shuffle.ha import rebase_epoch
+        inc = self.incarnation
+        # TTL re-derive FIRST, from the replicated registration clocks:
+        # a restored-but-expired shuffle dies (ordinary EPOCH_DEAD push)
+        # before any re-broadcast could resurrect it at a reducer
+        self.gc_sweep()
+        # the takeover pointer leads the queue so executor retries
+        # re-aim before the state pushes land behind it
+        self._queue_push(None, M.TakeoverMsg(inc, self.server.host,
+                                             self.server.port))
+        members, states, mepoch = self.membership.snapshot()
+        mepoch = self.membership.rebase_epoch(rebase_epoch(mepoch, inc))
+        self.publish_membership(members, states, mepoch)
+        with self._tables_lock:
+            sids = sorted(self._tables)
+            plans = {}
+            for sid in sids:
+                self._epochs[sid] = rebase_epoch(self._epochs[sid], inc)
+                plan = self._plans.get(sid)
+                if plan is not None:
+                    plan = dataclasses.replace(
+                        plan, plan_epoch=rebase_epoch(plan.plan_epoch,
+                                                      inc))
+                    self._plans[sid] = plan
+                    plans[sid] = plan.to_bytes()
+            epochs = {sid: self._epochs[sid] for sid in sids}
+            refinalize = [sid for sid in sids
+                          if sid in self._finalize_sent]
+        for sid in sids:
+            self._queue_push(None, M.EpochBumpMsg(sid, epochs[sid]))
+        for sid in sids:
+            if sid in plans:
+                self._queue_push(None, M.ReducePlanMsg(plans[sid]))
+        for sid in refinalize:
+            self._queue_push(None, M.FinalizeSegmentsReq(0, sid))
+        self.ha_failovers_count += 1
+        log.warning("driver: incarnation %d serving — %d shuffles "
+                    "restored, membership epoch %d re-announced", inc,
+                    len(sids), mepoch)
+
+    def _on_standby_hello(self, msg: "M.StandbyHelloMsg") -> None:
+        """Register (or re-register) a standby and queue its catch-up:
+        the newest snapshot plus the whole tail. The standby dedupes by
+        (incarnation, seq), so over-sending is harmless; under-sending
+        would strand it cold."""
+        if self.oplog is None:
+            log.warning("driver: standby hello from %s with HA off "
+                        "(set ha_standbys > 0)", msg.name)
+            return
+        addr = (msg.host, msg.port)
+        with self._standbys_lock:
+            self._standbys = ([s for s in self._standbys
+                               if s[0] != msg.name]
+                              + [(msg.name, msg.host, msg.port)])
+        with self._ha_lock:
+            snap = self.oplog.snapshot()
+            blob, tail = self.oplog.restore_point()
+            if blob is not None:
+                self._queue_push(addr, M.SnapshotMsg(self.incarnation,
+                                                     snap[0], blob))
+            for rec in tail:
+                if rec.seq > msg.last_seq or blob is not None:
+                    self._queue_push(addr, M.OpLogAppendMsg(
+                        rec.incarnation, rec.seq, rec.kind, rec.payload))
+        log.info("driver: standby %s registered at %s:%d (caught up "
+                 "from seq %d)", msg.name, msg.host, msg.port,
+                 msg.last_seq)
+
+    def _lease_loop(self) -> None:
+        """Renew the leadership lease at a quarter TTL. The instant a
+        renew fails a higher term exists — we are the zombie: go mute
+        (stop the broadcaster) so no further push leaves this endpoint.
+        Everything already in flight is fenced by incarnation at every
+        receiver; muting just stops paying for doomed sends."""
+        ttl_s = self.conf.driver_lease_ms / 1000
+        period = max(0.01, ttl_s / 4)
+        while not self._announce_stop and not self._lease_lost.is_set():
+            if not self.lease_store.renew(self.lease_holder,
+                                          self.incarnation, ttl_s):
+                self._lease_lost.set()
+                log.warning("driver: lease lost at incarnation %d — a "
+                            "newer primary exists; muting broadcasts",
+                            self.incarnation)
+                with self._announce_cond:
+                    self._announce_stop = True
+                    self._announce_cond.notify()
+                return
+            self._lease_lost.wait(period)
+
+    def deposed(self) -> bool:
+        """True once this endpoint observed a higher lease term (tests
+        and the chaos harness poll this)."""
+        return self._lease_lost.is_set()
+
+    def drain_transition(self, slot: int, step: int):
+        """The logged form of the three membership drain mutations
+        (``ha.DRAIN_BEGIN/ABORT/RETIRE``) — drain_slot and abort_drain
+        route through here so a failover mid-drain replays to the same
+        slot states."""
+        from sparkrdma_tpu.shuffle import ha
+        mutators = {ha.DRAIN_BEGIN: self.membership.begin_drain,
+                    ha.DRAIN_ABORT: self.membership.abort_drain,
+                    ha.DRAIN_RETIRE: self.membership.retire}
+        apply_fn = mutators[step]
+        if self.oplog is not None and not self._replaying:
+            return self._ha_apply(ha.OP_DRAIN, ha.op_drain(slot, step),
+                                  lambda: apply_fn(slot))
+        return apply_fn(slot)
 
     # -- shuffle registry (driver side of registerShuffle) ---------------
 
@@ -257,6 +648,20 @@ class DriverEndpoint:
         ``admission_max_inflight``) and the mapping is pushed to every
         executor as a TenantMapMsg so serve-path fair share and quota
         ledgers charge the right owner."""
+        from sparkrdma_tpu.shuffle import ha
+        if self.oplog is not None and not self._replaying:
+            return self._ha_apply(
+                ha.OP_REGISTER,
+                ha.op_register(shuffle_id, num_maps, num_partitions,
+                               tenant, time.time()),
+                lambda: self._register_impl(shuffle_id, num_maps,
+                                            num_partitions, tenant))
+        return self._register_impl(shuffle_id, num_maps, num_partitions,
+                                   tenant)
+
+    def _register_impl(self, shuffle_id: int, num_maps: int,
+                       num_partitions: int = 0, tenant: int = 0) -> None:
+        from sparkrdma_tpu.shuffle.ha import compose_epoch
         from sparkrdma_tpu.shuffle.location_plane import ShardMap
 
         def admit_event(kind: str, t: int, waited_ms: int) -> None:
@@ -305,7 +710,10 @@ class DriverEndpoint:
                     self.admission.on_unregister(tenant, shuffle_id)
                 return
             self._tables[shuffle_id] = DriverTable(num_maps)
-            self._epochs[shuffle_id] = 1
+            # epoch 1 of THIS incarnation: identical to the pre-HA 1 at
+            # incarnation 0; after a failover, strictly above anything
+            # the previous incarnation ever published for a reused id
+            self._epochs[shuffle_id] = compose_epoch(self.incarnation, 1)
             self._num_partitions[shuffle_id] = num_partitions
             self._tenants[shuffle_id] = int(tenant)
             self._register_times[shuffle_id] = time.monotonic()
@@ -334,6 +742,17 @@ class DriverEndpoint:
                 shuffle_id, int(tenant), self.conf.shuffle_ttl_ms))
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
+        from sparkrdma_tpu.shuffle import ha
+        if self.oplog is not None and not self._replaying:
+            # log-before-push discipline: the standby stream holds the
+            # unregister before any executor can observe the EPOCH_DEAD
+            # it causes, so a takeover can never resurrect a shuffle a
+            # reducer already saw die
+            return self._ha_apply(ha.OP_UNREGISTER, ha.op_sid(shuffle_id),
+                                  lambda: self._unregister_impl(shuffle_id))
+        return self._unregister_impl(shuffle_id)
+
+    def _unregister_impl(self, shuffle_id: int) -> None:
         with self._tables_lock:
             known = self._tables.pop(shuffle_id, None) is not None
             self._epochs.pop(shuffle_id, None)
@@ -380,6 +799,11 @@ class DriverEndpoint:
         GC sweep reaps only shuffles no one has touched for a full
         TTL. Warm iterative jobs that issue zero driver RPCs by design
         should size shuffle_ttl_ms above their run or disable it."""
+        if self._replaying:
+            # Failover replay must not freshen TTL clocks: the restored
+            # reg_unix already carries the true idle age, and replayed
+            # publishes are history, not fresh liveness proof.
+            return
         if shuffle_id in self._register_times:
             self._register_times[shuffle_id] = time.monotonic()
 
@@ -429,8 +853,19 @@ class DriverEndpoint:
 
     def bump_epoch(self, shuffle_id: int, reason: str = "") -> Optional[int]:
         """Advance one shuffle's epoch and push the invalidation. The
-        driver calls this itself on repair publishes and tombstones;
-        public for engines that learn of staleness out of band."""
+        driver calls this itself on repair publishes and tombstones
+        (DERIVED bumps — replay re-derives them from the logged cause,
+        so only out-of-band calls log their own OP_BUMP); public for
+        engines that learn of staleness out of band."""
+        from sparkrdma_tpu.shuffle import ha
+        if (self.oplog is not None and not self._replaying
+                and not self._in_derived_apply()):
+            return self._ha_apply(ha.OP_BUMP, ha.op_sid(shuffle_id),
+                                  lambda: self._bump_impl(shuffle_id,
+                                                          reason))
+        return self._bump_impl(shuffle_id, reason)
+
+    def _bump_impl(self, shuffle_id: int, reason: str = "") -> Optional[int]:
         with self._tables_lock:
             if shuffle_id not in self._epochs:
                 return None
@@ -491,21 +926,39 @@ class DriverEndpoint:
         hist, owners, live, avoid = inputs
         if hist.maps_recorded == 0 or hist.num_partitions == 0:
             return None
+        from sparkrdma_tpu.shuffle.ha import compose_epoch
         with self._tables_lock:
             prev = self._plans.get(shuffle_id)
-        epoch = prev.plan_epoch + 1 if prev is not None else 1
+        epoch = (prev.plan_epoch + 1 if prev is not None
+                 else compose_epoch(self.incarnation, 1))
         plan = ReducePlanner(self.conf).plan(shuffle_id, hist, owners,
                                              live, plan_epoch=epoch,
                                              tracer=tracer,
                                              avoid_slots=avoid)
-        with self._tables_lock:
-            if shuffle_id not in self._tables:
-                return None  # unregistered while planning
-            self._plans[shuffle_id] = plan
-        self._queue_push(None, M.ReducePlanMsg(plan.to_bytes()))
+        if not self._install_plan(shuffle_id, plan):
+            return None  # unregistered while planning
         log.info("driver: reduce plan shuffle %d epoch %d: %s",
                  shuffle_id, plan.plan_epoch, plan.counts())
         return plan
+
+    def _install_plan(self, shuffle_id: int, plan) -> bool:
+        """Install + push one plan, logged as OP_PLAN (the plan BYTES
+        are authoritative — replay installs rather than re-deriving, so
+        a failover preserves the exact task layout reducers hold)."""
+        from sparkrdma_tpu.shuffle import ha
+
+        def apply() -> bool:
+            with self._tables_lock:
+                if shuffle_id not in self._tables:
+                    return False
+                self._plans[shuffle_id] = plan
+            self._queue_push(None, M.ReducePlanMsg(plan.to_bytes()))
+            return True
+
+        if (self.oplog is not None and not self._replaying
+                and not self._in_derived_apply()):
+            return self._ha_apply(ha.OP_PLAN, plan.to_bytes(), apply)
+        return apply()
 
     def replan_reduce(self, shuffle_id: int, completed_task_ids,
                       dead_slot: int = -1, tracer=None):
@@ -529,12 +982,9 @@ class DriverEndpoint:
         new_plan = ReducePlanner(self.conf).replan(
             plan, hist, owners, live, completed_task_ids, tracer=tracer,
             avoid_slots=avoid)
-        with self._tables_lock:
-            if shuffle_id not in self._tables:
-                return None
-            self._plans[shuffle_id] = new_plan
+        if not self._install_plan(shuffle_id, new_plan):
+            return None
         self.plan_replans += 1
-        self._queue_push(None, M.ReducePlanMsg(new_plan.to_bytes()))
         log.info("driver: reduce RE-plan shuffle %d epoch %d (dead slot "
                  "%d)", shuffle_id, new_plan.plan_epoch, dead_slot)
         return new_plan
@@ -660,11 +1110,23 @@ class DriverEndpoint:
         """Broadcast the finalize trigger for one shuffle's merge
         targets (also queued automatically when the last map publishes;
         targets finalize idempotently)."""
+        from sparkrdma_tpu.shuffle import ha
+
+        def apply() -> None:
+            with self._tables_lock:
+                if shuffle_id in self._finalize_sent:
+                    return
+                self._finalize_sent.add(shuffle_id)
+            self._queue_push(None, M.FinalizeSegmentsReq(0, shuffle_id))
+
         with self._tables_lock:
             if shuffle_id in self._finalize_sent:
-                return
-            self._finalize_sent.add(shuffle_id)
-        self._queue_push(None, M.FinalizeSegmentsReq(0, shuffle_id))
+                return  # cheap pre-check: no op logged for a duplicate
+        if (self.oplog is not None and not self._replaying
+                and not self._in_derived_apply()):
+            return self._ha_apply(ha.OP_FINALIZE, ha.op_sid(shuffle_id),
+                                  apply)
+        return apply()
 
     def refinalize_merge(self, shuffle_id: int) -> None:
         """Re-broadcast the finalize trigger: drain re-pushes REOPEN
@@ -737,12 +1199,21 @@ class DriverEndpoint:
         fetchers fail fast instead of contacting a dead peer. The tombstoned
         snapshot is re-announced so all executors converge.
         """
-        res = self.membership.tombstone(manager_id)
-        if res is None:
-            return  # unknown or already tombstoned: nothing to do
-        snapshot, states, epoch, dead_slot = res
-        self.publish_membership(snapshot, states, epoch)
-        self.on_slot_dead(dead_slot)
+        from sparkrdma_tpu.shuffle import ha
+
+        def apply() -> None:
+            res = self.membership.tombstone(manager_id)
+            if res is None:
+                return  # unknown or already tombstoned: nothing to do
+            snapshot, states, epoch, dead_slot = res
+            self.publish_membership(snapshot, states, epoch)
+            self.on_slot_dead(dead_slot)
+
+        if (self.oplog is not None and not self._replaying
+                and not self._in_derived_apply()):
+            return self._ha_apply(ha.OP_TOMBSTONE, manager_id.serialize(),
+                                  apply)
+        return apply()
 
     def on_slot_dead(self, dead_slot: int) -> None:
         """The location-plane half of losing a slot (failure tombstone
@@ -811,7 +1282,8 @@ class DriverEndpoint:
         mind and the drainee is still healthy), broadcasting the state
         change — without the publish, peers would treat the slot as
         draining forever. No-op (False) unless the slot is DRAINING."""
-        reverted = self.membership.abort_drain(slot)
+        from sparkrdma_tpu.shuffle.ha import DRAIN_ABORT
+        reverted = self.drain_transition(slot, DRAIN_ABORT)
         if reverted is None:
             return False
         self.publish_membership(*reverted)
@@ -845,11 +1317,28 @@ class DriverEndpoint:
     # -- message handling ------------------------------------------------
 
     def _handle(self, conn: Connection, msg: RpcMsg) -> Optional[RpcMsg]:
+        # wire-shaped mutations are op-logged VERBATIM and re-applied
+        # through this same dispatch on replay: the fence floors / epoch
+        # guards inside the handlers are the idempotency story, so the
+        # log needs no semantic understanding of the frames it carries
+        if (self.oplog is not None and not self._replaying
+                and isinstance(msg, (HelloMsg, M.JoinMsg, M.PublishMsg,
+                                     M.MergedPublishMsg))):
+            from sparkrdma_tpu.shuffle.ha import OP_WIRE
+            return self._ha_apply(OP_WIRE, msg.encode(),
+                                  lambda: self._dispatch(conn, msg))
+        return self._dispatch(conn, msg)
+
+    def _dispatch(self, conn: Optional[Connection],
+                  msg: RpcMsg) -> Optional[RpcMsg]:
         if isinstance(msg, HelloMsg):
             self._on_hello(msg.manager_id)
             return None
         if isinstance(msg, M.JoinMsg):
             self._on_hello(msg.manager_id, explicit_join=True)
+            return None
+        if isinstance(msg, M.StandbyHelloMsg):
+            self._on_standby_hello(msg)
             return None
         if isinstance(msg, M.PublishMsg):
             return self._on_publish(msg)
@@ -897,19 +1386,27 @@ class DriverEndpoint:
         """Hand the broadcaster the newest snapshot; older queued ones are
         superseded (every snapshot is the full membership, so skipping
         intermediates loses nothing — executors order by epoch anyway)."""
+        if self._replaying:
+            return  # restore is silent; the takeover re-announce speaks
         with self._announce_cond:
             if (self._announce_pending is None
                     or epoch > self._announce_pending[1]):
                 self._announce_pending = (snapshot, epoch)
             self._announce_cond.notify()
 
-    def _queue_push(self, target: Optional[ShuffleManagerId],
-                    msg: RpcMsg) -> None:
+    def _queue_push(self, target, msg: RpcMsg) -> None:
         """Queue a metadata-plane push for the broadcaster thread:
-        ``target=None`` broadcasts to every live member, else one
-        directed send (shard-entry forwards). Best-effort by design —
-        a lost push is backstopped by the fetch-failure invalidation
-        path, so no retry ladder hangs off the publish handler."""
+        ``target=None`` broadcasts to every live member, a
+        ShuffleManagerId directs one send (shard-entry forwards), and a
+        raw ``(host, port)`` tuple directs one send to a non-member
+        address (the standby replication stream). Best-effort by design
+        — a lost push is backstopped by the fetch-failure invalidation
+        path (or, for standbys, by the re-hello catch-up), so no retry
+        ladder hangs off the publish handler. Suppressed during restore
+        replay: the takeover re-announce is the authoritative
+        broadcast."""
+        if self._replaying:
+            return
         with self._announce_cond:
             if self._announce_stop:
                 return
@@ -945,8 +1442,16 @@ class DriverEndpoint:
                 except Exception:  # noqa: BLE001 — same survival contract
                     log.exception("driver: metadata push failed")
 
-    def _send_push(self, target: Optional[ShuffleManagerId],
-                   msg: RpcMsg) -> None:
+    def _send_push(self, target, msg: RpcMsg) -> None:
+        if isinstance(target, tuple):  # standby replication stream
+            try:
+                self._clients.get(*target).send(msg)
+            except TransportError as e:
+                # one attempt, like every push: a dead standby re-syncs
+                # through its next StandbyHello catch-up
+                log.debug("driver: standby push %s to %s:%s failed: %s",
+                          type(msg).__name__, target[0], target[1], e)
+            return
         members = self.membership.members()
         targets = ([target] if target is not None
                    else [m for m in members if m != TOMBSTONE])
@@ -1180,10 +1685,16 @@ class DriverEndpoint:
     def stop(self) -> None:
         if self.autoscaler is not None:
             self.autoscaler.stop()
+        # the lease loop keys off _lease_lost too: setting it here lets
+        # a clean stop release the renew thread within one period
+        self._lease_lost.set()
         with self._announce_cond:
             self._announce_stop = True
             self._announce_cond.notify()
         self._broadcaster.join(timeout=self.conf.teardown_timeout_ms / 1000)
+        if self._lease_thread is not None:
+            self._lease_thread.join(
+                timeout=self.conf.teardown_timeout_ms / 1000)
         self._clients.close_all()
         self.server.stop()
 
@@ -1284,6 +1795,11 @@ class ExecutorEndpoint:
         self._members_event = threading.Event()
         self._members_lock = threading.Lock()
         self._clients = ConnectionCache(self.conf, on_message=self._handle)
+        # the ONE driver channel (parallel/driver_client.py): every
+        # driver-bound call site routes through it so a failover
+        # re-points them all at once; a TakeoverMsg moves the pointer
+        # forward-only under the incarnation comparison
+        self.driver = DriverClient(self.conf, self._clients, driver_addr)
         # metadata plane (shuffle/location_plane.py): the epoch-validated
         # local cache of driver tables + block-location entries (the
         # warm-path zero-RPC store), and this executor's driver-table
@@ -1394,8 +1910,11 @@ class ExecutorEndpoint:
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> None:
-        """Hello to the driver (scala/RdmaShuffleManager.scala:204-226)."""
-        self.driver_conn().send(HelloMsg(self.manager_id))
+        """Hello to the driver (scala/RdmaShuffleManager.scala:204-226).
+        Routed through the retry envelope: a hello racing a driver
+        failover re-dials the re-pointed primary, and fencing makes a
+        duplicate hello (one per primary that saw it) idempotent."""
+        self.driver.send(HelloMsg(self.manager_id))
 
     def join_cluster(self) -> None:
         """Explicit mid-job JOIN (parallel/membership.py): same
@@ -1403,10 +1922,10 @@ class ExecutorEndpoint:
         elastic event. An old driver without the frame would tear the
         connection — the hello already sent is the compatible greeting,
         so a lost/ignored join degrades to static-membership behavior."""
-        self.driver_conn().send(M.JoinMsg(self.manager_id))
+        self.driver.send(M.JoinMsg(self.manager_id))
 
     def driver_conn(self) -> Connection:
-        return self._clients.get(*self._driver_addr)
+        return self.driver.conn()
 
     def stop(self) -> None:
         # flagged BEFORE close_all so a racing prewarm dial either sees
@@ -1911,6 +2430,16 @@ class ExecutorEndpoint:
         if isinstance(msg, M.MembershipBumpMsg):
             self._on_membership_bump(msg)
             return None
+        if isinstance(msg, M.TakeoverMsg):
+            # driver failover: re-point the driver channel, forward-only
+            # under the incarnation comparison (a zombie's stale
+            # broadcast loses). In-flight retry loops re-read the
+            # address every attempt, so nothing else needs to notice.
+            if self.driver.note_takeover(msg.incarnation, msg.host,
+                                         msg.port):
+                log.info("driver takeover observed: incarnation %d at "
+                         "%s:%d", msg.incarnation, msg.host, msg.port)
+            return None
         if isinstance(msg, M.DrainReq):
             # NOT the serve pool: the replication pass can run for up to
             # drain_deadline_ms and must not starve block serving —
@@ -2153,9 +2682,8 @@ class ExecutorEndpoint:
             return cached
         from sparkrdma_tpu.shuffle.planner import ReducePlan
         try:
-            conn = self.driver_conn()
-            resp = conn.request(
-                M.FetchPlanReq(conn.next_req_id(), shuffle_id),
+            resp = self.driver.request(
+                lambda c: M.FetchPlanReq(c.next_req_id(), shuffle_id),
                 timeout=timeout)
         except (TransportError, TimeoutError) as e:
             log.debug("reduce-plan fetch for shuffle %d failed: %s",
@@ -2670,7 +3198,7 @@ class ExecutorEndpoint:
                 msg.shuffle_id,
                 self.exec_index(
                     timeout=self.conf.connect_timeout_ms / 1000),
-                publish=lambda m: self.driver_conn().send(m),
+                publish=lambda m: self.driver.send(m),
                 tracer=self.tracer)
         except Exception:  # noqa: BLE001 — dedicated thread, must not
             # die silently; the shuffle just stays unfinalized here
@@ -2713,12 +3241,11 @@ class ExecutorEndpoint:
             return cached
         from sparkrdma_tpu.shuffle.push_merge import MergedDirectory
         try:
-            conn = self.driver_conn()
             if metrics is not None:
                 metrics.record_metadata_rpc()
                 metrics.record_request()
-            resp = conn.request(
-                M.FetchMergedReq(conn.next_req_id(), shuffle_id),
+            resp = self.driver.request(
+                lambda c: M.FetchMergedReq(c.next_req_id(), shuffle_id),
                 timeout=self.conf.resolved_request_deadline_s())
         except (TransportError, TimeoutError) as e:
             log.debug("merged-directory fetch for shuffle %d failed: %s",
@@ -2748,10 +3275,12 @@ class ExecutorEndpoint:
         entry = DriverTable.pack_entry(
             table_token,
             self.exec_index(timeout=self.conf.connect_timeout_ms / 1000))
-        conn = self.driver_conn()
         msg = M.PublishMsg(shuffle_id, map_id, entry, fence=fence,
                            lengths=lengths)
-        conn.send(msg)
+        # retry envelope: a publish racing a failover lands on the new
+        # primary; the fence token makes the duplicate (one per primary
+        # that received it) idempotent, so at-least-once is safe
+        self.driver.send(msg)
 
     def get_driver_table(self, shuffle_id: int, expect_published: int,
                          timeout: Optional[float] = None,
@@ -2805,17 +3334,18 @@ class ExecutorEndpoint:
                 return table, epoch
             # fall through: shard host lost/lagging — the driver is
             # authoritative
-        conn = self.driver_conn()
         while True:
             remaining = deadline - time.monotonic()
             if metrics is not None:
                 metrics.record_metadata_rpc()
-            resp = conn.request(
-                M.FetchTableReq(conn.next_req_id(), shuffle_id,
-                                min_published=expect_published,
-                                timeout_ms=max(1, int(remaining * 1000))),
-                timeout=max(0.05, remaining) + 5.0)  # grace over the
-            # server-side hold so the sweeper answers before we give up
+            resp = self.driver.request(
+                lambda c: M.FetchTableReq(
+                    c.next_req_id(), shuffle_id,
+                    min_published=expect_published,
+                    timeout_ms=max(1, int(remaining * 1000))),
+                timeout=max(0.05, remaining) + 5.0,  # grace over the
+                # server-side hold so the sweeper answers before we give up
+                deadline_s=max(0.05, remaining))
             assert isinstance(resp, M.FetchTableResp)
             if resp.num_published >= expect_published:
                 table = DriverTable.from_bytes(resp.table)

@@ -99,6 +99,32 @@ class MembershipPlane:
         with self._lock:
             return self._epoch
 
+    def restore(self, members: List[ShuffleManagerId], states: List[int],
+                epoch: int) -> None:
+        """Install a replicated snapshot wholesale (driver failover
+        restore). The epoch only ratchets up — a stale snapshot behind
+        ops already replayed must not rewind the version the rebased
+        re-announce is built on."""
+        with self._lock:
+            self._members = list(members)
+            self._states = list(states)
+            if epoch > self._epoch:
+                self._epoch = epoch
+
+    def rebase_epoch(self, min_epoch: int) -> int:
+        """Raise the epoch floor (never lowers it) and return the result.
+
+        A promoted driver rebases the replayed plane into its own
+        incarnation's epoch space so its first re-announce dominates
+        every broadcast the dead primary ever sent — receivers keep the
+        highest epoch, so a stale in-flight announce from the old
+        incarnation loses at every executor without any extra fencing.
+        """
+        with self._lock:
+            if min_epoch > self._epoch:
+                self._epoch = min_epoch
+            return self._epoch
+
     def snapshot(self) -> Tuple[List[ShuffleManagerId], List[int], int]:
         with self._lock:
             return list(self._members), list(self._states), self._epoch
@@ -261,7 +287,8 @@ def drain_slot(driver, slot: int,
     members = driver.members()
     if not 0 <= slot < len(members) or members[slot] == TOMBSTONE:
         return result
-    begun = driver.membership.begin_drain(slot)
+    from sparkrdma_tpu.shuffle.ha import DRAIN_BEGIN
+    begun = driver.drain_transition(slot, DRAIN_BEGIN)
     if begun is None:
         return result  # already draining or dead
     snapshot, states, epoch = begun
@@ -311,7 +338,8 @@ def drain_slot(driver, slot: int,
 
     repointed = sum(len(driver.maps_owned_by(sid, slot))
                     for sid in driver.live_shuffles())
-    retired = driver.membership.retire(slot)
+    from sparkrdma_tpu.shuffle.ha import DRAIN_RETIRE
+    retired = driver.drain_transition(slot, DRAIN_RETIRE)
     if retired is not None:
         driver.publish_membership(*retired)
         driver.on_slot_dead(slot)

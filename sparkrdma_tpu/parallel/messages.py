@@ -1185,3 +1185,121 @@ TASK_OK = 0
 TASK_ERROR = 1
 TASK_FETCH_FAILED = 2
 TASK_NO_RUNNER = 3
+
+
+# ---------------------------------------------------------------------------
+#                         driver HA: op-log replication + lease takeover
+#                         (shuffle/ha.py; one-sided pushes on the
+#                         announce channel, never request/reply)
+
+def _pack_str(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    return struct.pack("<H", len(raw)) + raw
+
+
+def _unpack_str(payload: bytes, off: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from("<H", payload, off)
+    off += 2
+    return payload[off:off + n].decode("utf-8"), off + n
+
+
+@register()
+class OpLogAppendMsg(RpcMsg):
+    """Primary -> standbys: one replicated op-log record, stamped
+    ``(incarnation, seq)`` (monotone; receivers accept only strictly
+    forward stamps, which fences a zombie primary's appends). ``kind``
+    is the ha.OP_* discriminator; ``blob`` is the op payload — for
+    OP_WIRE, the encoded driver-bound frame itself, replayed through
+    the same handler whose fence floors make the second application a
+    no-op."""
+
+    def __init__(self, incarnation: int, seq: int, kind: int,
+                 blob: bytes):
+        self.incarnation = incarnation
+        self.seq = seq
+        self.kind = kind
+        self.blob = blob
+
+    def payload(self) -> bytes:
+        return struct.pack("<IQI", self.incarnation, self.seq,
+                           self.kind) + self.blob
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "OpLogAppendMsg":
+        incarnation, seq, kind = struct.unpack_from("<IQI", payload, 0)
+        return cls(incarnation, seq, kind, bytes(payload[16:]))
+
+
+@register()
+class SnapshotMsg(RpcMsg):
+    """Primary -> standby: the full control-plane snapshot taken at
+    ``(incarnation, seq)`` (ha.encode_snapshot envelope). Sent once at
+    subscribe time (and after compactions) so a cold standby catches up
+    from the snapshot plus the op tail instead of an unbounded log."""
+
+    def __init__(self, incarnation: int, seq: int, blob: bytes):
+        self.incarnation = incarnation
+        self.seq = seq
+        self.blob = blob
+
+    def payload(self) -> bytes:
+        return struct.pack("<IQ", self.incarnation, self.seq) + self.blob
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "SnapshotMsg":
+        incarnation, seq = struct.unpack_from("<IQ", payload, 0)
+        return cls(incarnation, seq, bytes(payload[12:]))
+
+
+@register()
+class StandbyHelloMsg(RpcMsg):
+    """Standby -> primary: subscribe to the replication stream. ``name``
+    is the standby's lease-holder identity, ``host``/``port`` the
+    address its catch-up server listens on (the primary pushes
+    SnapshotMsg + OpLogAppendMsg there), ``last_seq`` the newest seq it
+    already holds so a resubscribe after a blip replays only the gap."""
+
+    def __init__(self, name: str, host: str, port: int, last_seq: int):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.last_seq = last_seq
+
+    def payload(self) -> bytes:
+        return (_pack_str(self.name) + _pack_str(self.host)
+                + struct.pack("<IQ", self.port, self.last_seq))
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "StandbyHelloMsg":
+        name, off = _unpack_str(payload, 0)
+        host, off = _unpack_str(payload, off)
+        port, last_seq = struct.unpack_from("<IQ", payload, off)
+        return cls(name, host, port, last_seq)
+
+
+@register()
+class TakeoverMsg(RpcMsg):
+    """New primary -> executors: the driver lease moved — incarnation
+    ``incarnation`` now answers at ``host:port``. Executors observe a
+    failover as one more membership-style bump: re-point the
+    DriverClient (forward-only on incarnation, so a late replay of an
+    older takeover cannot re-point backwards) and let the in-flight
+    retry envelopes re-send against the new address. The authoritative
+    state re-broadcast (announce + epoch bumps + plans) rides the same
+    channel right behind this frame."""
+
+    def __init__(self, incarnation: int, host: str, port: int):
+        self.incarnation = incarnation
+        self.host = host
+        self.port = port
+
+    def payload(self) -> bytes:
+        return struct.pack("<I", self.incarnation) + _pack_str(
+            self.host) + struct.pack("<I", self.port)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "TakeoverMsg":
+        (incarnation,) = struct.unpack_from("<I", payload, 0)
+        host, off = _unpack_str(payload, 4)
+        (port,) = struct.unpack_from("<I", payload, off)
+        return cls(incarnation, host, port)

@@ -75,6 +75,13 @@ WIRE_IDS: Dict[str, int] = {
     "DrainResp": 39,
     "PushPlannedReq": 40,
     "PushPlannedResp": 41,
+    # driver HA (shuffle/ha.py): the op-log replication stream and the
+    # lease takeover announcement — one-sided pushes like everything
+    # else on the announce channel
+    "OpLogAppendMsg": 42,
+    "SnapshotMsg": 43,
+    "StandbyHelloMsg": 44,
+    "TakeoverMsg": 45,
 }
 
 # Ids deliberately absent from the dense 1..max range, with the reason

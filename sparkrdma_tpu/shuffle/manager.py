@@ -103,7 +103,8 @@ class TpuShuffleManager:
                  driver_addr: Optional[Tuple[str, int]] = None,
                  host: str = "127.0.0.1", executor_id: str = "driver",
                  spill_dir: Optional[str] = None,
-                 num_executors_hint: int = 0):
+                 num_executors_hint: int = 0,
+                 lease_store=None, lease_holder: Optional[str] = None):
         self.conf = conf or TpuShuffleConf()
         self.is_driver = is_driver
         self.driver: Optional[DriverEndpoint] = None
@@ -125,7 +126,12 @@ class TpuShuffleManager:
         self._mem_stats = MemStats()
 
         if is_driver:
-            self.driver = DriverEndpoint(self.conf, host=host)
+            # HA deployments hand the driver role a shared lease store
+            # (shuffle/ha.py): the endpoint renews the lease and mutes
+            # itself the instant a standby wins the next term
+            self.driver = DriverEndpoint(self.conf, host=host,
+                                         lease_store=lease_store,
+                                         lease_holder=lease_holder)
             driver_addr = self.driver.address
         if driver_addr is None:
             raise ValueError("executor role needs driver_addr")

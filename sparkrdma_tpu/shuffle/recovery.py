@@ -30,6 +30,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, TypeVar
 
 import numpy as np
 
+from sparkrdma_tpu.parallel.driver_client import DriverUnreachableError
 from sparkrdma_tpu.shuffle.fetcher import FetchFailedError
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
 from sparkrdma_tpu.shuffle.writer import WriteFailedError
@@ -354,16 +355,42 @@ def run_reduce_with_retry(executors: Sequence[TpuShuffleManager],
     budget discovering the same death.
     """
     attempt = 0
+    driver_waits = 0
     while True:
         try:
             return reduce_fn(executors[reducer_index], handle)
+        except DriverUnreachableError as e:
+            # the CONTROL PLANE is electing (driver failover), the data
+            # plane is fine: no peer is dead, no map is lost. Retry the
+            # sync against the (re-pointed) driver — never tombstone a
+            # peer or recompute anything over it. Each wait already
+            # spanned a full request_deadline_ms envelope inside
+            # DriverClient, sized to ride out one driver_lease_ms
+            # failover, so the bound here is a couple of envelopes.
+            driver_waits += 1
+            if driver_waits > max_stage_retries + 1:
+                raise
+            log.warning("reduce sync hit an unreachable driver (%s); "
+                        "retrying against the new primary (wait %d)",
+                        e, driver_waits)
         except FetchFailedError as e:
             attempt += 1
             if attempt > max_stage_retries:
                 raise
-            recover_lost_maps(executors, handle, map_fn, e,
-                              executors[reducer_index].executor,
-                              driver=driver, attempt=attempt)
+            try:
+                recover_lost_maps(executors, handle, map_fn, e,
+                                  executors[reducer_index].executor,
+                                  driver=driver, attempt=attempt)
+            except DriverUnreachableError as de:
+                # recovery's own driver sync died mid-failover: don't
+                # charge the STAGE retry budget for a control-plane
+                # blink — un-charge it and re-enter through the reduce
+                driver_waits += 1
+                if driver_waits > max_stage_retries + 1:
+                    raise
+                attempt -= 1
+                log.warning("recovery sync hit an unreachable driver "
+                            "(%s); retrying (wait %d)", de, driver_waits)
 
 
 @dataclass
@@ -435,6 +462,7 @@ def run_planned_reduce(executors: Sequence[TpuShuffleManager],
     executions: Dict[int, int] = {}
     replans = 0
     attempt = 0
+    driver_waits = 0
     while True:
         pending = [t for t in plan.tasks if t.task_id not in completed]
         if not pending:
@@ -458,6 +486,17 @@ def run_planned_reduce(executors: Sequence[TpuShuffleManager],
                 result.task_slots[task.task_id] = slot
                 if on_task_done is not None:
                     on_task_done(task, slot)
+        except DriverUnreachableError as e:
+            # failover window: completed tasks keep their results; the
+            # next pass re-syncs against the new primary. No recompute,
+            # no tombstone — the peers are fine.
+            driver_waits += 1
+            if driver_waits > max_stage_retries + 1:
+                raise
+            log.warning("planned reduce hit an unreachable driver (%s); "
+                        "retrying against the new primary (wait %d)",
+                        e, driver_waits)
+            continue
         except FetchFailedError as e:
             attempt += 1
             if attempt > max_stage_retries:
@@ -466,9 +505,19 @@ def run_planned_reduce(executors: Sequence[TpuShuffleManager],
             if not slot_mgrs:
                 raise
             recover_ep = next(iter(slot_mgrs.values())).executor
-            dead_slot = recover_lost_maps(executors, handle, map_fn, e,
-                                          recover_ep, driver=driver,
-                                          attempt=attempt)
+            try:
+                dead_slot = recover_lost_maps(executors, handle, map_fn, e,
+                                              recover_ep, driver=driver,
+                                              attempt=attempt)
+            except DriverUnreachableError as de:
+                driver_waits += 1
+                if driver_waits > max_stage_retries + 1:
+                    raise
+                attempt -= 1  # a control-plane blink is not a stage retry
+                log.warning("planned-reduce recovery hit an unreachable "
+                            "driver (%s); retrying (wait %d)", de,
+                            driver_waits)
+                continue
             if endpoint is not None and hasattr(endpoint, "replan_reduce"):
                 new_plan = endpoint.replan_reduce(
                     handle.shuffle_id, set(completed),

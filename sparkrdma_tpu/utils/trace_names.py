@@ -48,6 +48,7 @@ INSTANTS = frozenset({
     "admit.reject",
     "autoscale.resize",
     "commit.fenced",
+    "driver.takeover",
     "exchange.degrade",
     "exchange.hierarchical",
     "exchange.overlap",
@@ -82,6 +83,8 @@ INSTANTS = frozenset({
 
 # Chrome "C"-phase counter series.
 COUNTERS = frozenset({
+    "ha_failovers",
+    "oplog_lag_entries",
     "peer.suspects",
 })
 

@@ -72,15 +72,16 @@ def test_wire_registry_is_dense_and_unique():
 
 
 def test_wire_density_over_full_membership_range():
-    """Msgs 40-41 (PushPlannedReq/Resp) closed the id space at 41: the
-    registry + reservations must tile 1..41 exactly, and every
-    membership message must carry _EXTRA_CASES domain corners (epoch 0,
-    max-i64, DRAINING-only vectors) so the fuzzer exercises the signed
-    boundaries the name-based generator avoids."""
+    """Msgs 42-45 (driver-HA op-log/snapshot/takeover frames) closed
+    the id space at 45: the registry + reservations must tile 1..45
+    exactly, and every membership message must carry _EXTRA_CASES
+    domain corners (epoch 0, max-i64, DRAINING-only vectors) so the
+    fuzzer exercises the signed boundaries the name-based generator
+    avoids."""
     ids = [t for t, _ in wire.live_pairs()]
-    assert max(ids) == 41
+    assert max(ids) == 45
     assert set(ids) | set(wire.rpc_msg.RESERVED_WIRE_IDS) == set(
-        range(1, 42))
+        range(1, 46))
     for name in ("JoinMsg", "MembershipBumpMsg", "DrainReq", "DrainResp"):
         assert name in wire._EXTRA_CASES, name
     corners = [c() for c in wire._EXTRA_CASES["MembershipBumpMsg"]]
@@ -308,7 +309,30 @@ def test_modelcheck_catalog_clean_and_enumerates_500():
     assert total >= 500, f"only {total} schedules enumerated: {stats}"
     assert {s.name for s in stats} >= {
         "pub_tomb_bump", "fence_loser", "finalize_vs_push",
-        "drain_vs_kill", "ttl_vs_late_fetch"}
+        "drain_vs_kill", "ttl_vs_late_fetch",
+        "driver_failover_mid_publish", "split_brain_two_leases",
+        "zombie_primary_publish", "failover_vs_ttl_sweep"}
+
+
+def test_modelcheck_driver_death_scenarios_enumerate_500():
+    """The driver-HA gate (ISSUE 17 acceptance): the four driver-death
+    scenarios ALONE cover >= 500 distinct DFS schedules with zero
+    invariant violations — lease CAS single-holder, epoch monotonicity
+    across incarnations, zombie writes fenced, no resurrected shuffle,
+    ledger conservation through replay."""
+    driver_death = {"driver_failover_mid_publish",
+                    "split_brain_two_leases", "zombie_primary_publish",
+                    "failover_vs_ttl_sweep"}
+    total = 0
+    for scn in modelcheck.catalog():
+        if scn.name not in driver_death:
+            continue
+        runs, st = modelcheck.run_scenario(scn)
+        bad = [r for r in runs if r.violation]
+        assert not bad, (f"{scn.name}: {bad[0].violation}; "
+                         f"schedule: {' -> '.join(bad[0].trace)}")
+        total += st.dfs_schedules
+    assert total >= 500, f"only {total} driver-death schedules"
 
 
 def test_scheduler_fifo_channels_and_por():

@@ -12,7 +12,11 @@ Fast scenarios run in tier-1 (marked ``chaos``); the wide sweep is
 seeds via ``CHAOS_SEED``.
 """
 
+import json
 import os
+import signal
+import subprocess
+import sys
 import threading
 import time
 
@@ -35,7 +39,10 @@ from sparkrdma_tpu.parallel.faults import (
     FaultInjector,
     StorageFaultInjector,
 )
-from sparkrdma_tpu.shuffle.manager import PartitionerSpec, TpuShuffleManager
+from sparkrdma_tpu.shuffle.ha import (DriverStandby, FileLeaseStore,
+                                      InMemoryLeaseStore)
+from sparkrdma_tpu.shuffle.manager import (PartitionerSpec, ShuffleHandle,
+                                           TpuShuffleManager)
 from sparkrdma_tpu.shuffle.recovery import run_map_stage, run_reduce_with_retry
 
 pytestmark = pytest.mark.chaos
@@ -89,6 +96,16 @@ TENANT = os.environ.get("CHAOS_TENANT", "0") not in ("0", "false")
 # matrix; run_chaos.sh sweeps both. The dedicated scale-up/drain-down
 # acceptance scenarios below run regardless.
 ELASTIC = os.environ.get("CHAOS_ELASTIC", "0") not in ("0", "false")
+# driver HA under chaos: 1 runs the wide byte-identity matrices with a
+# lease-armed primary, a warm standby shadowing its op log, and a
+# primary CRASH at a seeded random point inside the reduce window — the
+# standby CAS-takes the next lease term, replays, and re-points the
+# executors via TakeoverMsg, so reducer syncs ride the DriverClient
+# retry envelope across a real failover under every injected fault;
+# run_chaos.sh sweeps both. The dedicated SIGKILL acceptance scenario
+# (separate primary process, kill -9, zero map re-executions) runs
+# regardless.
+DRIVER = os.environ.get("CHAOS_DRIVER", "0") not in ("0", "false")
 # CHAOS_LOCKGRAPH=1: run every scenario under the lock-order shim
 # (sparkrdma_tpu/analysis/lockgraph.py) so the chaos matrix doubles as
 # race detection — faults drive the rare teardown/retry/suspect paths
@@ -121,13 +138,25 @@ def _conf(**kw):
         # gate runs, nothing sheds) and a live TTL sweeper whose TTL no
         # scenario can reach — expiry mid-fault would be its own bug
         base.update(admission_max_inflight=16, shuffle_ttl_ms=120_000)
+    if DRIVER:
+        # the driver-HA sweep dimension: a lease short enough that the
+        # failover lands inside the scenario, and a request deadline
+        # generous enough that executor retries ride through the
+        # no-primary window instead of surfacing it
+        base.update(ha_standbys=1, driver_lease_ms=900,
+                    request_deadline_ms=20_000)
     base.update(kw)
     return TpuShuffleConf(**base)
 
 
 def _cluster(tmp_path, n=3, **kw):
     conf = _conf(**kw)
-    driver = TpuShuffleManager(conf, is_driver=True)
+    if DRIVER:
+        driver = TpuShuffleManager(conf, is_driver=True,
+                                   lease_store=InMemoryLeaseStore(),
+                                   lease_holder="primary")
+    else:
+        driver = TpuShuffleManager(conf, is_driver=True)
     if TENANT:
         # every scenario's shuffles register under a real tenant id so
         # TenantMapMsg pushes, DRR serve queues, and ledger charging
@@ -206,6 +235,43 @@ class _ElasticChurn:
         self._thread.join(timeout=10)
         if self._joiner is not None:
             self._joiner.stop()
+
+
+class _DriverFailover:
+    """CHAOS_DRIVER=1 background churn: a warm standby shadows the
+    primary's op log; at a seeded random point inside the reduce window
+    the primary CRASHES (server down, lease renewals stop — the
+    in-process stand-in for SIGKILL; the real kill -9 acceptance is the
+    dedicated scenario at the bottom of this file). The standby
+    CAS-takes the next lease term, replays, and re-points the executors
+    via TakeoverMsg, so the scenario's byte-identity assertions hold
+    unchanged: reducer syncs ride the DriverClient retry envelope
+    across the outage."""
+
+    def __init__(self, driver):
+        self._driver = driver
+        ep = driver.driver
+        self.standby = DriverStandby(driver.conf, ep.lease_store,
+                                     "chaos-standby",
+                                     primary_addr=ep.address).start()
+        # seeded kill point: varies across the sweep, replays exactly
+        rng = np.random.default_rng(SEED + 7700)
+        self._delay = 0.05 + rng.random() * 0.3
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="driver-failover-churn")
+        self._thread.start()
+
+    def _run(self):
+        time.sleep(self._delay)
+        try:
+            self._driver.driver.stop()
+        except Exception:  # noqa: BLE001 — the crash itself must never
+            # fail the scenario; the assertions live in the test body
+            pass
+
+    def stop(self):
+        self._thread.join(timeout=10)
+        self.standby.stop()
 
 
 # -- tier-1 chaos scenarios (fast, deterministic counts) -----------------
@@ -1134,6 +1200,7 @@ def test_chaos_matrix(tmp_path, scenario):
                              read_ahead_depth=4)
     injector = FaultInjector(seed=SEED)
     churn = None
+    failover = None
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=8,
                                          partitioner=PartitionerSpec("modulo"))
@@ -1150,6 +1217,8 @@ def test_chaos_matrix(tmp_path, scenario):
         _scenario_faults(scenario, injector, victim_addr)
         if ELASTIC:
             churn = _ElasticChurn(driver.conf, driver, tmp_path)
+        if DRIVER:
+            failover = _DriverFailover(driver)
 
         got = run_reduce_with_retry(execs, handle, _map_fn_big, _reduce_fn,
                                     reducer_index=0, max_stage_retries=3,
@@ -1161,6 +1230,8 @@ def test_chaos_matrix(tmp_path, scenario):
         injector.uninstall()
         if churn is not None:
             churn.stop()
+        if failover is not None:
+            failover.stop()
         _shutdown(driver, execs)
 
 
@@ -1213,6 +1284,7 @@ def test_chaos_disk_matrix(tmp_path, scenario):
     injector = StorageFaultInjector(seed=SEED)
     injector.install()
     churn = None
+    failover = None
     try:
         deterministic = _disk_faults(scenario, injector)
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
@@ -1227,6 +1299,8 @@ def test_chaos_disk_matrix(tmp_path, scenario):
                 f"seed={SEED}: PUSHPLAN sweep built no plan"
         if ELASTIC:
             churn = _ElasticChurn(driver.conf, driver, tmp_path)
+        if DRIVER:
+            failover = _DriverFailover(driver)
         got = run_reduce_with_retry(execs, handle, _map_fn, _reduce_fn,
                                     reducer_index=0, max_stage_retries=3,
                                     driver=driver)
@@ -1245,6 +1319,8 @@ def test_chaos_disk_matrix(tmp_path, scenario):
         injector.uninstall()
         if churn is not None:
             churn.stop()
+        if failover is not None:
+            failover.stop()
         _shutdown(driver, execs)
 
 
@@ -1440,3 +1516,157 @@ def test_chaos_elastic_drainee_death_mid_drain_falls_back(tmp_path):
             f"seed={SEED}: {counter}"
     finally:
         _shutdown(driver, execs)
+
+
+# -- driver HA: the kill -9 acceptance scenario ---------------------------
+#
+# The primary driver runs in its OWN PROCESS holding a file-backed lease
+# and gets SIGKILLed at a seeded random point after the map outputs have
+# replicated to a warm in-test standby. The standby must CAS-take the
+# next lease term within the lease TTL, replay its shadowed op log, and
+# re-point the executors — and the job must complete byte-identically
+# with ZERO map re-executions: the map outputs live on the executors,
+# so losing the driver may cost a wait, never a recompute.
+
+_PRIMARY_CHILD = r"""
+import json, os, sys, time
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel.endpoints import DriverEndpoint
+from sparkrdma_tpu.shuffle.ha import FileLeaseStore
+
+conf = TpuShuffleConf(**json.loads(sys.argv[1]))
+ep = DriverEndpoint(conf, host="127.0.0.1",
+                    lease_store=FileLeaseStore(sys.argv[2]),
+                    lease_holder="primary")
+ep.register_shuffle(7, num_maps=4, num_partitions=4)
+with open(sys.argv[3] + ".tmp", "w") as f:
+    json.dump({"host": ep.server.host, "port": ep.server.port,
+               "pid": os.getpid()}, f)
+os.replace(sys.argv[3] + ".tmp", sys.argv[3])
+while True:  # hold the lease until SIGKILL
+    time.sleep(0.5)
+"""
+
+
+def test_chaos_driver_sigkill_failover_zero_reexecutions(tmp_path):
+    conf_kw = dict(connect_timeout_ms=2000, max_connection_attempts=1,
+                   retry_backoff_base_ms=20, retry_backoff_cap_ms=150,
+                   pre_warm_connections=False, use_cpp_runtime=False,
+                   ha_standbys=1, driver_lease_ms=800,
+                   request_deadline_ms=20_000)
+    conf = TpuShuffleConf(**conf_kw)
+    lease_path = str(tmp_path / "lease.json")
+    addr_path = str(tmp_path / "driver_addr.json")
+    child_src = tmp_path / "primary_child.py"
+    child_src.write_text(_PRIMARY_CHILD)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, str(child_src), json.dumps(conf_kw), lease_path,
+         addr_path], env=env, cwd=repo_root)
+    standby = None
+    execs = []
+    try:
+        deadline = time.monotonic() + 60
+        while not os.path.exists(addr_path):
+            assert proc.poll() is None, \
+                f"seed={SEED}: primary child died at startup"
+            assert time.monotonic() < deadline, \
+                f"seed={SEED}: primary child never published its address"
+            time.sleep(0.05)
+        with open(addr_path) as f:
+            info = json.load(f)
+        addr = (info["host"], info["port"])
+
+        standby = DriverStandby(conf, FileLeaseStore(lease_path),
+                                "standby-1", primary_addr=addr).start()
+        execs = [TpuShuffleManager(conf, driver_addr=addr,
+                                   executor_id=str(i),
+                                   spill_dir=str(tmp_path / f"e{i}"))
+                 for i in range(2)]
+        for ex in execs:
+            ex.executor.wait_for_members(2)
+
+        handle = ShuffleHandle(7, 4, 4, 0, PartitionerSpec("modulo"))
+        map_runs = []
+        runs_lock = threading.Lock()
+
+        def map_fn(writer, map_id):
+            with runs_lock:
+                map_runs.append(map_id)
+            rng = np.random.default_rng(1000 + map_id)
+            writer.write_batch(
+                rng.integers(0, 5000, size=500).astype(np.uint64))
+
+        run_map_stage(execs, handle, map_fn)
+        # all four publishes are on the primary; wait until the standby's
+        # shadowed op log has gone QUIET having heard them — nothing
+        # mutates driver state after the map stage, so a stable ingest
+        # seq means the async replication stream has fully drained and a
+        # kill at any later instant loses no op
+        table, _ = execs[0].executor.get_driver_table_v(
+            7, expect_published=4, timeout=10)
+        assert table.num_published == 4, f"seed={SEED}"
+        stable_since, last_seen = time.monotonic(), standby._last
+        while time.monotonic() - stable_since < 0.5:
+            assert time.monotonic() < deadline, \
+                f"seed={SEED}: standby never caught up"
+            time.sleep(0.05)
+            if standby._last != last_seen:
+                stable_since, last_seen = time.monotonic(), standby._last
+        assert last_seen[1] > 0, f"seed={SEED}: standby heard no ops"
+
+        # reducers launch, then the primary dies at a seeded random
+        # point inside the reduce window: reducers that already synced
+        # never notice; the rest ride the DriverClient retry envelope
+        # into the promoted standby
+        results = {}
+
+        def reduce_one(i):
+            reader = execs[i].get_reader(handle, 0, 4)
+            keys, _ = reader.read_all()
+            results[i] = np.sort(keys)
+
+        threads = [threading.Thread(target=reduce_one, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(np.random.default_rng(SEED + 990).random() * 0.2)
+        os.kill(proc.pid, signal.SIGKILL)
+        t_kill = time.monotonic()
+        proc.wait(timeout=10)
+
+        # takeover within the lease TTL (the remaining TTL at kill time
+        # is at most one driver_lease_ms; the watcher polls at TTL/4,
+        # promotion itself is bounded by replay) + scheduling grace
+        while standby.endpoint is None:
+            assert time.monotonic() - t_kill < \
+                conf.driver_lease_ms / 1000 + 1.0, \
+                f"seed={SEED}: standby never took the lease"
+            time.sleep(0.02)
+        new_primary = standby.endpoint
+        assert new_primary.incarnation >= 1, f"seed={SEED}"
+
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), \
+            f"seed={SEED}: a reducer hung across the failover"
+        expected = _expected(4)
+        for i in range(2):
+            np.testing.assert_array_equal(
+                results[i], expected,
+                err_msg=f"seed={SEED}: reducer {i} diverged after kill -9")
+        # ZERO re-executions: losing the driver costs a wait, never a
+        # recompute — every map ran exactly once
+        assert sorted(map_runs) == [0, 1, 2, 3], \
+            f"seed={SEED}: map re-executions after failover: {map_runs}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+        for ex in execs:
+            ex.stop()
+        if standby is not None:
+            standby.stop()
