@@ -468,6 +468,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("hierarchical exchange done")
     _bench_serve_path(detail)
     _progress("serve path done")
+    _bench_client_fetch(detail)
+    _progress("client fetch done")
     _bench_tenant_isolation(detail)
     _progress("tenant isolation done")
     _bench_elastic(detail)
@@ -713,6 +715,45 @@ def _bench_serve_path(detail: dict) -> None:
             if cpu["crc"]["zero_copy"] else 0.0)
     except Exception as e:  # noqa: BLE001
         detail["serve_path_error"] = f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_client_fetch(detail: dict) -> None:
+    """The native client fetch engine's win — the receive-side mirror of
+    the serve secondary: client-side CPU per GB fetched (getrusage of
+    the fetching process, the server isolated in a subprocess) plus the
+    wire-to-device latency of one request's payload, A/B'd against the
+    pure-Python receive path on the same block schedule at equal bytes
+    with per-request digests gating byte-identity
+    (shuffle/client_bench.py). Skips cleanly where the .so isn't
+    built."""
+    try:
+        import tempfile
+
+        from sparkrdma_tpu.shuffle.client_bench import run_client_microbench
+
+        cpu, w2d = {}, {}
+        for checksum, tag in ((False, "plain"), (True, "crc")):
+            with tempfile.TemporaryDirectory(prefix="clientbench_") as td:
+                res = run_client_microbench(td, file_mb=32, total_mb=128,
+                                            checksum=checksum)
+            if not res["identical"]:
+                detail["client_fetch_error"] = \
+                    f"{tag}: engines fetched different bytes"
+                return
+            cpu[tag] = res["cpu_s_per_gb"]
+            w2d[tag] = res["wire_to_device_ms"]
+            if checksum:
+                detail["client_doorbell"] = res["doorbell"]
+        detail["client_cpu_per_gb"] = cpu
+        detail["client_wire_to_device_ms"] = w2d
+        detail["client_cpu_speedup"] = (
+            round(cpu["plain"]["python"] / cpu["plain"]["native"], 2)
+            if cpu["plain"]["native"] else 0.0)
+        detail["client_cpu_speedup_crc"] = (
+            round(cpu["crc"]["python"] / cpu["crc"]["native"], 2)
+            if cpu["crc"]["native"] else 0.0)
+    except Exception as e:  # noqa: BLE001
+        detail["client_fetch_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _bench_topo_exchange(detail: dict) -> None:

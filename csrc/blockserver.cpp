@@ -112,24 +112,43 @@ constexpr int kMaxIov = 64;
 // analogue of kInHighWater — bounds deferred-request memory per conn).
 constexpr uint32_t kMaxPendingPerConn = 4096;
 
-// CRC-32 (IEEE 802.3, the zlib polynomial) — table-driven, computed inline
-// so the shared library needs no zlib link. Must match Python's
+// CRC-32 (IEEE 802.3, the zlib polynomial) — slice-by-8 tables, computed
+// inline so the shared library needs no zlib link. Must match Python's
 // zlib.crc32: init 0xFFFFFFFF, reflected 0xEDB88320, final complement.
+// Slice-by-8 folds eight bytes per step (eight parallel table lookups
+// instead of a serial byte chain), which keeps the checksum from being
+// the bottleneck when a whole payload is verified in one pass — the
+// byte-at-a-time loop runs ~400 MB/s, an order below the dataplane.
 struct Crc32Table {
-  uint32_t t[256];
+  uint32_t t[8][256];
   Crc32Table() {
     for (uint32_t i = 0; i < 256; ++i) {
       uint32_t c = i;
       for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-      t[i] = c;
+      t[0][i] = c;
     }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int j = 1; j < 8; ++j)
+        t[j][i] = t[0][t[j - 1][i] & 0xFF] ^ (t[j - 1][i] >> 8);
   }
 };
 const Crc32Table kCrc32;
 
 uint32_t crc32_ieee(const uint8_t* p, size_t n) {
   uint32_t c = 0xFFFFFFFFu;
-  for (size_t i = 0; i < n; ++i) c = kCrc32.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  while (n >= 8) {
+    uint32_t lo, hi;  // memcpy: alignment-safe (UBSan) and little-endian
+    memcpy(&lo, p, 4);
+    memcpy(&hi, p + 4, 4);
+    lo ^= c;
+    c = kCrc32.t[7][lo & 0xFF] ^ kCrc32.t[6][(lo >> 8) & 0xFF] ^
+        kCrc32.t[5][(lo >> 16) & 0xFF] ^ kCrc32.t[4][lo >> 24] ^
+        kCrc32.t[3][hi & 0xFF] ^ kCrc32.t[2][(hi >> 8) & 0xFF] ^
+        kCrc32.t[1][(hi >> 16) & 0xFF] ^ kCrc32.t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  for (size_t i = 0; i < n; ++i) c = kCrc32.t[0][(c ^ p[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
 }
 

@@ -75,6 +75,16 @@
 #                               cross every injected fault; the
 #                               dedicated kill -9 acceptance scenario
 #                               runs regardless)
+#   CHAOS_NATIVE_FETCH_MODES="0 1"  client dataplane modes to sweep
+#                               (default both: the pure-Python fetcher,
+#                               and CHAOS_NATIVE_FETCH=1 so the matrix
+#                               runs on the NATIVE dataplane — C++ block
+#                               server serving, the C client engine
+#                               fetching into pool leases — and every
+#                               control-plane/disk/membership fault
+#                               crosses the engine's fallback-to-Python
+#                               envelope; degrades to Python where the
+#                               .so isn't built)
 #   CHAOS_DISK=0          drop the storage-fault matrix from the sweep
 #   CHAOS_LOCKGRAPH=1     run every scenario under the lock-order shim
 #                         (sparkrdma_tpu/analysis/lockgraph.py): the
@@ -93,8 +103,10 @@ PUSHPLAN_MODES=${CHAOS_PUSHPLAN_MODES:-"0 1"}
 TENANT_MODES=${CHAOS_TENANT_MODES:-"0 1"}
 ELASTIC_MODES=${CHAOS_ELASTIC_MODES:-"0 1"}
 DRIVER_MODES=${CHAOS_DRIVER_MODES:-"0 1"}
+NATIVE_FETCH_MODES=${CHAOS_NATIVE_FETCH_MODES:-"0 1"}
 DISK=${CHAOS_DISK:-1}
 failed=()
+for nfetch in $NATIVE_FETCH_MODES; do
 for driver in $DRIVER_MODES; do
 for elastic in $ELASTIC_MODES; do
 for tenant in $TENANT_MODES; do
@@ -107,12 +119,13 @@ for coalesce in $MODES; do
     echo "=== chaos sweep: seed ${seed} coalesce=${coalesce}" \
          "warm=${warm} skew=${skew} merge=${merge}" \
          "pushplan=${pushplan} tenant=${tenant} elastic=${elastic}" \
-         "driver=${driver} disk=${DISK} ==="
+         "driver=${driver} nfetch=${nfetch} disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
          CHAOS_WARM="${warm}" CHAOS_SKEW="${skew}" \
          CHAOS_MERGE="${merge}" CHAOS_PUSHPLAN="${pushplan}" \
          CHAOS_TENANT="${tenant}" \
          CHAOS_ELASTIC="${elastic}" CHAOS_DRIVER="${driver}" \
+         CHAOS_NATIVE_FETCH="${nfetch}" \
          CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
@@ -120,17 +133,19 @@ for coalesce in $MODES; do
       echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm}" \
            "skew=${skew} merge=${merge} pushplan=${pushplan}" \
            "tenant=${tenant} elastic=${elastic} driver=${driver}" \
-           "FAILED — replay with:"
+           "nfetch=${nfetch} FAILED — replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
            "CHAOS_WARM=${warm} CHAOS_SKEW=${skew}" \
          "CHAOS_MERGE=${merge} CHAOS_PUSHPLAN=${pushplan}" \
            "CHAOS_TENANT=${tenant}" \
            "CHAOS_ELASTIC=${elastic} CHAOS_DRIVER=${driver}" \
+           "CHAOS_NATIVE_FETCH=${nfetch}" \
            "CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}d${driver}")
+      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}d${driver}n${nfetch}")
     fi
   done
+done
 done
 done
 done
@@ -147,4 +162,5 @@ fi
 echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
      "planes, both reduce-planning modes, both push-merge modes," \
      "both planned-push modes, both tenancy modes, both" \
-     "elastic-membership modes, both driver-HA modes (disk=${DISK})"
+     "elastic-membership modes, both driver-HA modes, both client" \
+     "fetch engines (disk=${DISK})"

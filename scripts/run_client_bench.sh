@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Client-fetch sweep: the native fetch engine's test matrix
+# (tests/test_native_fetch.py — native-vs-Python byte identity across
+# dataplane combos, read_to_device parity, doorbell batching, lease
+# free-race hardening, the client CPU-per-GB acceptance gate) across a
+# set of extra seeds, then the client microbench at full size with its
+# acceptance gates: >= 2x lower CLIENT CPU per GB than the pure-Python
+# receive path, per-request digests byte-identical with CRC trailers on
+# and off, wire-to-device latency no worse than the staged upload. A
+# red seed replays exactly:
+#
+#     NATIVE_FETCH_SEED=<seed> python -m pytest tests/test_native_fetch.py
+#
+# Usage: scripts/run_client_bench.sh [seed ...]
+#   NATIVE_FETCH_SEEDS="0 1 2"   alternative way to pass the seed list
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SEEDS=${*:-${NATIVE_FETCH_SEEDS:-"0 7 42"}}
+failed=()
+for seed in $SEEDS; do
+  echo "=== client fetch sweep: seed ${seed} ==="
+  if ! NATIVE_FETCH_SEED="${seed}" JAX_PLATFORMS=cpu \
+       python -m pytest tests/test_native_fetch.py -q \
+         -p no:cacheprovider -p no:randomly; then
+    echo "!!! seed ${seed} FAILED — replay with:"
+    echo "    NATIVE_FETCH_SEED=${seed} python -m pytest tests/test_native_fetch.py"
+    failed+=("${seed}")
+  fi
+done
+
+echo "=== client microbench (CPU-per-GB acceptance) ==="
+if ! JAX_PLATFORMS=cpu python - <<'EOF'
+import json, sys, tempfile
+from sparkrdma_tpu.shuffle.client_bench import run_client_microbench
+
+ok = True
+for checksum in (False, True):
+    with tempfile.TemporaryDirectory(prefix="clientbench_") as td:
+        res = run_client_microbench(td, total_mb=512, checksum=checksum)
+    print(json.dumps(res))
+    w2d = res["wire_to_device_ms"]
+    db = res["doorbell"]
+    ok = (ok and res["identical"]
+          and res["cpu_speedup"] >= 2.0
+          and 0 < db["writevs"] < db["frames"]
+          and w2d["native"] <= 1.25 * w2d["python"])
+sys.exit(0 if ok else 1)
+EOF
+then
+  echo "!!! client microbench FAILED its acceptance gates"
+  failed+=("microbench")
+fi
+
+if [ ${#failed[@]} -gt 0 ]; then
+  echo "client fetch sweep: FAILURES: ${failed[*]}"
+  exit 1
+fi
+echo "client fetch sweep: all green"

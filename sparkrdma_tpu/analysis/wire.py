@@ -427,6 +427,19 @@ def _mirror_spec() -> Dict[str, Dict[str, Callable[[], int]]]:
             "kMaxReqFrame": lambda: M.NATIVE_MAX_REQ_FRAME,
             "kFlagCrc32": lambda: M.FLAG_CRC32,
         },
+        # The native CLIENT speaks the same wire dialect the server does;
+        # both sides' constants pin to the one Python definition so a
+        # protocol change that edits only one .cpp file fails here.
+        "fetchclient.cpp": {
+            "kReqType": lambda: M.FetchBlocksReq.MSG_TYPE,
+            "kRespType": lambda: M.FetchBlocksResp.MSG_TYPE,
+            "kStatusOk": lambda: M.STATUS_OK,
+            "kFlagCrc32": lambda: M.FLAG_CRC32,
+            "kMaxReqFrame": lambda: M.NATIVE_MAX_REQ_FRAME,
+            "kReqFixedBytes": lambda: M.BLOCKS_REQ_FIXED_BYTES,
+            "kRespFixedBytes": lambda: M.BLOCKS_RESP_FIXED_BYTES,
+            "kBlockWireBytes": lambda: M.BLOCK_WIRE_BYTES,
+        },
     }
 
 
@@ -444,6 +457,14 @@ _IGNORED_NATIVE = {
     },
     "arena.cpp": {
         "kMaxRegion",       # allocator carve-region size, never on the wire
+    },
+    "fetchclient.cpp": {
+        "kMaxRespPayload",  # client-side sanity cap on one response frame;
+                            # pure defense, the server never hits it
+        "kMaxSendIov",      # writev batch per doorbell flush, never on the
+                            # wire (IOV_MAX-bounded client tuning)
+        "kMaxPendingPerConn",  # in-flight request cap per connection;
+                               # client memory tuning only
     },
     "staging.cpp": set(),
     "writer.cpp": set(),

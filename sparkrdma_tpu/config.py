@@ -214,6 +214,29 @@ _KEYS = [
              "copy-and-recompute per block. Off = always copy (the "
              "regression escape hatch and the serve bench's memcpy "
              "baseline; responses byte-identical either way)."),
+    _Key("native_fetch", True, "bool",
+         doc="Native client fetch engine (csrc/fetchclient.cpp): the "
+             "coalesced dataplane's vectored reads submit doorbell-"
+             "batched through a C epoll loop and their response payloads "
+             "land DIRECTLY in BufferPool lease memory — no Python bytes "
+             "object, no intermediate copy, CRC trailers verified in C. "
+             "Engages only where the wire bytes are already exactly the "
+             "lease bytes: coalesce_reads on, a pool present, the peer "
+             "advertising a native block port, and no wire_compress/"
+             "wire_codec. Any anomaly (bad status, CRC mismatch, torn "
+             "connection) re-runs that request through the Python "
+             "fetcher's retry/suspect/checksum envelope, so results are "
+             "byte-identical by construction. Off (or a pre-client .so) "
+             "= today's pure-Python receive path, bit-identical."),
+    _Key("fetch_doorbell_batch", 16, "int", 1, 4096,
+         doc="Vectored read requests queued per native-fetch doorbell: "
+             "the engine submits up to this many frames per peer, then "
+             "rings once (ONE writev carries the whole batch) and "
+             "scatters completions as they land. 1 = a flush per "
+             "request (no batching, the latency-first setting); larger "
+             "values amortize syscalls on wide reduce fan-ins. Also "
+             "bounds the planned-push sender's raw-frame batches when "
+             "it rides the same engine."),
     _Key("task_threads", 4, "int", 1, 1024,
          doc="Worker threads for shipped engine tasks per executor "
              "(Spark's executor task slots analogue)."),

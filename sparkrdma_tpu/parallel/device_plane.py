@@ -77,6 +77,23 @@ def _resolve_plan_impl(mesh, impl: str, axis_name: str) -> str:
     return resolve_transport(mesh, impl, axis_name)
 
 
+def stage_to_device(arr: np.ndarray, sharding):
+    """One staged round's host->device upload, donation-friendly: when
+    the runtime supports aliasing (jax >= 0.4.31), the host staging
+    buffer — a BufferPool lease the native fetch engine already landed
+    wire bytes in, or the round's freshly-padded block, never touched
+    again after dispatch — may back the device array directly instead of
+    being copied. Backends that can't alias (or older runtimes without
+    the parameter) transfer exactly as before; results are identical
+    either way."""
+    import jax
+
+    try:
+        return jax.device_put(arr, sharding, may_alias=True)
+    except TypeError:  # runtime predates may_alias
+        return jax.device_put(arr, sharding)
+
+
 # one-time latch for the mesh_rows_per_round deprecation (engine ctor
 # arg or conf key): the knob still pins round sizes for mixed-version
 # configs, but auto-sizing from device_hbm_budget is the supported path
@@ -612,8 +629,8 @@ def run_fused_exchange_rounds(mesh, axis_name: str, blocks,
             rows_p[:len(chunk)] = chunk
             dest_p = np.full(per_round, -1, np.int32)
             dest_p[:len(chunk)] = dchunk
-            out = step(jax.device_put(rows_p, sharding),
-                       jax.device_put(dest_p, sharding))
+            out = step(stage_to_device(rows_p, sharding),
+                       stage_to_device(dest_p, sharding))
         record_exchange(len(chunk))
         return out
 
@@ -815,8 +832,8 @@ def run_hierarchical_exchange(mesh, axis_name: str,
                     rows_p[:len(chunk)] = chunk
                     dest_p = np.full(per_round, -1, np.int32)
                     dest_p[:len(chunk)] = dchunk - lo  # slice-local device
-                    out = step(jax.device_put(rows_p, sharding),
-                               jax.device_put(dest_p, sharding))
+                    out = step(stage_to_device(rows_p, sharding),
+                               stage_to_device(dest_p, sharding))
                 record_exchange(len(chunk))
                 batch.append((s, lo, ns, chunk, dchunk, out))
             if batch and not charged:

@@ -75,6 +75,10 @@ _BLOCK = struct.Struct("<IQI")  # (buf token, offset, length)
 NATIVE_MAX_REQ_FRAME = 1 << 20          # csrc/blockserver.cpp kMaxReqFrame
 BLOCKS_REQ_FIXED_BYTES = 8 + _QI.size + 4   # header + req_id/shuffle + count
 BLOCK_WIRE_BYTES = _BLOCK.size          # one (buf, offset, length) range
+# Response-frame fixed prefix (csrc/fetchclient.cpp kRespFixedBytes): the
+# native CLIENT parses [total:4][type:4][req_id:8][status:4][flags:4]
+# before scattering the payload into lease memory.
+BLOCKS_RESP_FIXED_BYTES = 8 + _QI.size + 4  # header + req_id/status + flags
 
 
 @register()

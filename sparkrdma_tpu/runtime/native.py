@@ -114,6 +114,38 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.bs_set_fair.restype = None
         lib.bs_fair_queued.argtypes = [vp]
         lib.bs_fair_queued.restype = u64
+    # optional symbols: the native client fetch engine (doorbell-batched
+    # vectored reads scattered straight into BufferPool lease memory,
+    # CRC trailers verified in C). A pre-client .so degrades to the
+    # Python fetcher; callers guard with has_fetch_client().
+    if hasattr(lib, "fc_create"):
+        lib.fc_create.argtypes = []
+        lib.fc_create.restype = vp
+        lib.fc_io_uring.argtypes = [vp]
+        lib.fc_io_uring.restype = ctypes.c_int
+        lib.fc_connect.argtypes = [vp, cp, u16, ctypes.c_int, ctypes.c_int]
+        lib.fc_connect.restype = i64
+        lib.fc_submit.argtypes = [vp, i64, u64, ctypes.c_uint32, cp,
+                                  ctypes.c_uint32, vp, u64]
+        lib.fc_submit.restype = ctypes.c_int
+        lib.fc_submit_raw.argtypes = [vp, i64, u64, cp, u64, vp, u64]
+        lib.fc_submit_raw.restype = ctypes.c_int
+        lib.fc_flush.argtypes = [vp]
+        lib.fc_flush.restype = ctypes.c_int
+        lib.fc_poll.argtypes = [vp, ctypes.c_int, vp, ctypes.c_int]
+        lib.fc_poll.restype = ctypes.c_int
+        lib.fc_pending.argtypes = [vp, i64]
+        lib.fc_pending.restype = i64
+        lib.fc_conn_alive.argtypes = [vp, i64]
+        lib.fc_conn_alive.restype = ctypes.c_int
+        for fn in ("fc_flush_count", "fc_writev_count", "fc_frames_sent",
+                   "fc_conns_killed"):
+            getattr(lib, fn).argtypes = [vp]
+            getattr(lib, fn).restype = u64
+        lib.fc_close.argtypes = [vp, i64]
+        lib.fc_close.restype = None
+        lib.fc_destroy.argtypes = [vp]
+        lib.fc_destroy.restype = None
     lib.bs_unregister_file.argtypes = [vp, ctypes.c_uint32]
     lib.bs_unregister_file.restype = ctypes.c_int
     lib.bs_bytes_served.argtypes = [vp]
@@ -143,6 +175,13 @@ def has_serve_path() -> bool:
     copy responses, registered-region pool, CRC reuse) — older builds
     degrade to eager-mmap copy serving."""
     return LIB is not None and hasattr(LIB, "bs_set_zero_copy")
+
+
+def has_fetch_client() -> bool:
+    """True when the loaded .so exports the native client fetch engine
+    (csrc/fetchclient.cpp: doorbell-batched vectored reads into lease
+    memory) — older builds keep the pure-Python fetcher."""
+    return LIB is not None and hasattr(LIB, "fc_create")
 
 
 def has_fair_serving() -> bool:

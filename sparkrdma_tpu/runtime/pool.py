@@ -44,7 +44,8 @@ class PoolBuffer:
     ``tenant`` is who the lease is charged to (tenancy.DEFAULT_TENANT
     for every pre-tenancy caller)."""
 
-    __slots__ = ("token", "size", "view", "tenant", "_pool", "_freed")
+    __slots__ = ("token", "size", "view", "tenant", "_pool", "_freed",
+                 "_free_lock")
 
     def __init__(self, token: int, size: int, view: np.ndarray,
                  pool: "BufferPool", tenant: int = 0):
@@ -54,11 +55,18 @@ class PoolBuffer:
         self.tenant = tenant
         self._pool = pool
         self._freed = False
+        self._free_lock = threading.Lock()
 
     def free(self) -> None:
-        if not self._freed:
+        # Race-safe, not merely idempotent: lease releases can arrive
+        # from a fetch engine thread and the consumer simultaneously —
+        # exactly one caller may return the token or the arena serves
+        # the same buffer to two tenants.
+        with self._free_lock:
+            if self._freed:
+                return
             self._freed = True
-            self._pool._release(self)
+        self._pool._release(self)
 
     def __enter__(self):
         return self
@@ -90,6 +98,8 @@ class RegisteredBuffer:
 
     def release(self) -> None:
         with self._lock:
+            assert self._refs > 0, \
+                "RegisteredBuffer over-released (refcount underflow)"
             self._refs -= 1
             last = self._refs == 0
         if last:

@@ -59,7 +59,7 @@ from sparkrdma_tpu.parallel.endpoints import (
     DeadExecutorError,
     ExecutorEndpoint,
 )
-from sparkrdma_tpu.parallel.messages import STATUS_CORRUPT
+from sparkrdma_tpu.parallel.messages import STATUS_CORRUPT, STATUS_OK
 from sparkrdma_tpu.parallel.transport import (
     Backoff,
     ChecksumError,
@@ -117,11 +117,19 @@ class FetchResult:
     failure: Optional[FetchFailedError] = None
     is_sentinel: bool = False
     lease: Optional[object] = None  # RegisteredBuffer holding `data`'s view
+    _free_lock: threading.Lock = field(default_factory=threading.Lock,
+                                       repr=False, compare=False)
 
     def free(self) -> None:
-        """Release this result's reference on the shared pool lease."""
-        if self.lease is not None:
+        """Release this result's reference on the shared pool lease.
+
+        Idempotent AND race-safe: the native fetch engine completes
+        results from a non-consumer thread, so a consumer ``free`` can
+        race an unwind ``free`` — exactly one of them may hand the
+        reference back or the pool double-frees the backing buffer."""
+        with self._free_lock:
             lease, self.lease = self.lease, None
+        if lease is not None:
             lease.release()
 
 
@@ -984,6 +992,15 @@ class ShuffleFetcher:
         self._rng.shuffle(plan)
         with count_lock:
             self._expected_results += sum(len(v.segments) for v in plan)
+        # 4th resolution engine: the native client (csrc/fetchclient.cpp)
+        # lands response payloads directly in lease memory — engaged only
+        # where the wire bytes ARE the lease bytes (native block port, no
+        # wire compression/codec, pool present). Declines (engine not
+        # built, connect failure) fall through to the Python dispatch.
+        if (self._native_fetch_usable(peer)
+                and self._fetch_vectored_native(peer, exec_idx, plan,
+                                                depth)):
+            return True
         if depth <= 1:
             self._fetch_vectored_sequential(peer, exec_idx, plan)
         else:
@@ -1108,6 +1125,212 @@ class ShuffleFetcher:
                                       blocks=len(vf.blocks),
                                       bytes=vf.total_bytes)
         self._emit_vectored(vf, data)
+
+    # -- native client engine (csrc/fetchclient.cpp) ---------------------
+
+    def _native_fetch_usable(self, peer) -> bool:
+        """The native engine engages only where the wire bytes are
+        already exactly the lease bytes: a pool to lease from, the peer
+        advertising a native block port, and nothing (compression, wire
+        codec) transforming payloads between the wire and the reader."""
+        if not (self.conf.native_fetch and self.pool is not None):
+            return False
+        if not getattr(peer, "block_port", 0) or self.conf.wire_compress:
+            return False
+        if getattr(self.endpoint, "_codec", None) is not None:
+            return False
+        from sparkrdma_tpu.shuffle.native_fetch import NativeFetchEngine
+        return NativeFetchEngine.available()
+
+    def _fetch_vectored_native(self, peer, exec_idx: int,
+                               plan: List[_VectoredFetch],
+                               depth: int) -> bool:
+        """Drive one peer's vectored plan through the native client
+        engine: requests are doorbell-batched (one writev carries up to
+        ``fetch_doorbell_batch`` frames) and each response payload is
+        scattered by the C epoll loop straight into a pool lease — no
+        Python bytes object, no copy; ``_emit_vectored_lease`` just
+        hands out views. CRC trailers verify in C.
+
+        Returns False only before any request was consumed (engine not
+        built, dial failed) — the caller then runs the ordinary Python
+        dispatch. Once engaged it always returns True: happy-path
+        requests complete natively, and ANY anomaly (connection death,
+        truncation, CRC mismatch, non-OK status) re-runs that request
+        through ``_vectored_data``'s retry/suspect/checksum envelope,
+        so failure behavior stays byte-identical with the Python path.
+        A dead connection degrades the not-yet-issued remainder of the
+        plan to the Python dispatch too."""
+        from sparkrdma_tpu.shuffle import native_fetch as nf
+        try:
+            eng = nf.NativeFetchEngine()
+        except RuntimeError:
+            return False
+        conn = eng.connect(peer.rpc_host, peer.block_port,
+                           timeout_ms=self.conf.connect_timeout_ms)
+        if not conn:
+            eng.close()
+            return False
+        deadline_s = self.conf.resolved_request_deadline_s()
+        batch = max(1, self.conf.fetch_doorbell_batch)
+        window = max(1, depth)
+        ready: deque = deque(plan)
+        outstanding: Dict[int, tuple] = {}  # req_id -> (vf, lease, t_issue)
+        next_req = 1
+        unsent = 0
+        try:
+            while (ready and eng.alive(conn)) or outstanding:
+                if self._aborted.is_set():
+                    raise _Aborted()
+                while (ready and len(outstanding) < window
+                       and eng.alive(conn)):
+                    vf = ready[0]
+                    # same pre-issue fail-fast as the Python paths
+                    self._suspect_check(exec_idx, vf.segments[0].map_id)
+                    if not self._try_acquire_in_flight(
+                            vf.total_bytes,
+                            nonblocking=bool(outstanding)):
+                        break
+                    ready.popleft()
+                    lease = addr = None
+                    if vf.total_bytes:
+                        lease = self.pool.get_registered(vf.total_bytes,
+                                                         tenant=self.tenant)
+                        addr = lease._buf.view.ctypes.data
+                    req_id, next_req = next_req, next_req + 1
+                    self.metrics.record_request()
+                    t_issue = time.monotonic()
+                    rc = eng.submit(conn, req_id, self.shuffle_id,
+                                    vf.blocks, addr, vf.total_bytes)
+                    if rc != 0:
+                        # rejected before the wire (dead conn, frame too
+                        # big): this request runs through the Python
+                        # envelope; the rest keep their native path
+                        if lease is not None:
+                            lease.release()
+                        self._vectored_fallback(
+                            peer, exec_idx, vf,
+                            TransportError(
+                                f"native fetch submit failed rc={rc}"),
+                            t_issue)
+                        continue
+                    outstanding[req_id] = (vf, lease, t_issue)
+                    unsent += 1
+                    if unsent >= batch:
+                        eng.flush()
+                        unsent = 0
+                if unsent:
+                    eng.flush()  # ring the doorbell on a partial batch
+                    unsent = 0
+                if not outstanding:
+                    continue
+                comps = eng.poll(timeout_ms=50)
+                now = time.monotonic()
+                for c in comps:
+                    ent = outstanding.pop(c.req_id, None)
+                    if ent is not None:
+                        vf, lease, t_issue = ent
+                        self._finish_native(peer, exec_idx, vf, lease, c,
+                                            now - t_issue)
+                if outstanding and not comps:
+                    oldest = min(t for _v, _l, t in outstanding.values())
+                    if now - oldest > deadline_s:
+                        # server stalled under the oldest request: kill
+                        # the connection — every in-flight request fails
+                        # over to the Python envelope via its kErrConn
+                        # completion, the unissued rest degrade below
+                        eng.close_conn(conn)
+        except BaseException:
+            # unwind contract: window budget and leases held by requests
+            # that will never complete must not outlive this call
+            for vf, lease, _t in outstanding.values():
+                if lease is not None:
+                    lease.release()
+                self._release_in_flight(vf.total_bytes)
+            raise
+        finally:
+            eng.close()
+        if ready:  # connection died: Python dispatch for the remainder
+            leftovers = list(ready)
+            if depth <= 1:
+                self._fetch_vectored_sequential(peer, exec_idx, leftovers)
+            else:
+                self._fetch_vectored_windowed(peer, exec_idx, leftovers,
+                                              depth)
+        return True
+
+    def _finish_native(self, peer, exec_idx: int, vf: _VectoredFetch,
+                       lease, comp, dt: float) -> None:
+        """Settle one native completion: emit zero-copy on the happy
+        path, otherwise release the lease and re-run the request through
+        the Python envelope (which re-classifies the failure itself —
+        per-block CRC blame, corrupt-output isolation, retry budget)."""
+        if (comp.status == STATUS_OK and comp.crc_state >= 0
+                and comp.nbytes == vf.total_bytes):
+            self.metrics.record_remote(vf.total_bytes, dt)
+            if self.reader_stats is not None:
+                self.reader_stats.update(exec_idx, dt,
+                                         nbytes=vf.total_bytes)
+            if self.tracer.enabled:
+                end_us = self.tracer.now_us()
+                issue_us = end_us - dt * 1e6
+                self.tracer.complete_span("fetch.vectored", "fetch",
+                                          issue_us, end_us, peer=exec_idx,
+                                          maps=len(vf.segments),
+                                          blocks=len(vf.blocks),
+                                          bytes=vf.total_bytes,
+                                          native=True)
+            self._emit_vectored_lease(vf, lease)
+            return
+        if lease is not None:
+            lease.release()
+        if comp.crc_state < 0:
+            # C-side CRC mismatch: the Python refetch re-verifies and —
+            # if the rot persists — raises the per-block ChecksumError
+            # the heal path wants, so blame lands on the right map
+            self.metrics.record_checksum_failure()
+            err = None
+        elif comp.status > 0:
+            # the server named a status: refetch fresh so the Python
+            # client classifies it (BAD_RANGE size-cap retry, CORRUPT
+            # isolation) exactly as it would its own response
+            err = None
+        else:
+            err = TransportError("native fetch engine: connection "
+                                 f"failed (status {comp.status})")
+        self._vectored_fallback(peer, exec_idx, vf, err, time.monotonic())
+
+    def _vectored_fallback(self, peer, exec_idx: int, vf: _VectoredFetch,
+                           err: Optional[BaseException],
+                           t_issue: float) -> None:
+        """Re-run one request through the Python envelope — the same
+        contract torn async fetches use in _complete_oldest_vectored."""
+        try:
+            data = self._vectored_data(peer, exec_idx, vf,
+                                       first_error=err)
+        except BaseException:
+            self._release_in_flight(vf.total_bytes)
+            raise
+        dt = time.monotonic() - t_issue
+        self.metrics.record_remote(len(data), dt)
+        if self.reader_stats is not None:
+            self.reader_stats.update(exec_idx, dt, nbytes=len(data))
+        self._emit_vectored(vf, data)
+
+    def _emit_vectored_lease(self, vf: _VectoredFetch, lease) -> None:
+        """Slice per-(map, range) results off an ALREADY-FILLED lease:
+        the native engine scattered the response payload into the
+        lease's backing buffer in request order, the same order
+        ``slice`` bump-allocates — handing out views is the whole job.
+        ``lease`` is None only for an all-empty request."""
+        for seg in vf.segments:
+            payload = (lease.slice(seg.total_bytes)
+                       if lease is not None else b"")
+            self._results.put(FetchResult(
+                seg.map_id, seg.start_partition, seg.end_partition,
+                payload, lease=lease))
+        if lease is not None:
+            lease.release()  # creator's ref; results hold theirs
 
     def _vectored_data(self, peer, exec_idx: int, vf: _VectoredFetch,
                        first_error: Optional[BaseException] = None) -> bytes:

@@ -21,6 +21,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sparkrdma_tpu.parallel import device_plane as device_plane_mod
 from sparkrdma_tpu.shuffle.fetcher import ReadMetrics
 from sparkrdma_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
 
@@ -108,7 +109,8 @@ def run_mesh_reduce(managers: Sequence[TpuShuffleManager],
                                      out_factor=out_factor)
     sharding = NamedSharding(mesh, P(axis_name))
     received, counts, _, overflowed = jax.block_until_ready(exchange(
-        jax.device_put(rows_p, sharding), jax.device_put(dest_p, sharding)))
+        device_plane_mod.stage_to_device(rows_p, sharding),
+        device_plane_mod.stage_to_device(dest_p, sharding)))
     exchange_mod.record_exchange(len(rows))
 
     # 3. unpack per device (host-side view of the device results)
@@ -440,8 +442,8 @@ def run_mesh_reduce_streamed(managers: Sequence[TpuShuffleManager],
         dest_p = np.full(total_cap, -1, np.int32)
         dest_p[:len(rows_np)] = dest
         exchange_mod.record_exchange(len(rows_np))
-        return exchange(jax.device_put(rows_p, sharding),
-                        jax.device_put(dest_p, sharding))
+        return exchange(device_plane_mod.stage_to_device(rows_p, sharding),
+                        device_plane_mod.stage_to_device(dest_p, sharding))
 
     def collect(results) -> None:
         # np.asarray blocks on the device

@@ -799,6 +799,13 @@ class SegmentPusher:
         # so the eager path and the replay never double-push one epoch
         self._planned_log: Dict[int, List[Tuple[int, int, List[int]]]] = {}
         self._planned_done: Dict[Tuple[int, int], int] = {}
+        # native raw-frame sender (csrc/fetchclient.cpp, fc_submit_raw):
+        # planned-push frames batch per doorbell on persistent raw-mode
+        # connections — created lazily ON the worker thread (one engine
+        # per thread), torn down when the worker exits
+        self._push_engine = None
+        self._push_conns: Dict[int, int] = {}  # slot -> conn id
+        self._push_req_id = 0
         # audit counters
         self.pushes_sent = 0
         self.push_bytes = 0
@@ -808,6 +815,7 @@ class SegmentPusher:
         self.planned_bytes = 0
         self.planned_local = 0
         self.planned_failures = 0
+        self.planned_native = 0  # planned sends carried by the raw engine
 
     def _planned_on(self) -> bool:
         # planned routing needs a ReducePlan, which needs adaptive_plan
@@ -886,21 +894,24 @@ class SegmentPusher:
         self._q.put(None)
 
     def _run(self) -> None:
-        while True:
-            task = self._q.get()
-            if task is None:
-                return
-            try:
-                self._push_map(task)
-            except Exception:  # noqa: BLE001 — a push must never kill
-                # the worker; the map stays per-map-fetched
-                self.push_failures += 1
-                log.exception("push of shuffle %d map %d failed",
-                              task.shuffle_id, task.map_id)
-            finally:
-                with self._idle:
-                    self._inflight -= 1
-                    self._idle.notify_all()
+        try:
+            while True:
+                task = self._q.get()
+                if task is None:
+                    return
+                try:
+                    self._push_map(task)
+                except Exception:  # noqa: BLE001 — a push must never
+                    # kill the worker; the map stays per-map-fetched
+                    self.push_failures += 1
+                    log.exception("push of shuffle %d map %d failed",
+                                  task.shuffle_id, task.map_id)
+                finally:
+                    with self._idle:
+                        self._inflight -= 1
+                        self._idle.notify_all()
+        finally:
+            self._close_push_engine()
 
     def _targets(self, task: _PushTask) -> Dict[int, List[Tuple[int, int]]]:
         from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
@@ -1034,6 +1045,10 @@ class SegmentPusher:
         except Exception:  # noqa: BLE001 — not yet joined
             my = -1
         deadline_s = self.conf.push_deadline_ms / 1000
+        # remote sends collect here and go out as ONE doorbell batch on
+        # the native raw engine (falling back per-send to the Python
+        # RPC); leases stay staged until the batch settles
+        sends: List[tuple] = []
         for t in plan.tasks:
             if t.placement < 0:
                 continue  # no planned destination: stays pull-fetched
@@ -1044,7 +1059,7 @@ class SegmentPusher:
                 self.tracer.instant("push.drop", "merge",
                                     shuffle=task.shuffle_id,
                                     map=task.map_id, target=t.placement)
-                return
+                break  # already-collected sends still go out
             lo, hi = t.start_partition, t.end_partition
             sizes = task.partition_lengths[lo:hi]
             try:
@@ -1056,9 +1071,9 @@ class SegmentPusher:
                 log.warning("planned push read of shuffle %d map %d "
                             "[%d,%d) failed: %s", task.shuffle_id,
                             task.map_id, lo, hi, e)
-                return
+                break
             if data is None:
-                return  # output gone (unregistered/superseded)
+                break  # output gone (unregistered/superseded)
             if t.placement == my:
                 # destination is THIS executor: land directly in the
                 # local store — zero RPCs, zero wire copies
@@ -1071,12 +1086,9 @@ class SegmentPusher:
             lease = self._stage(len(data),
                                 tenant=self.resolver.tenant_of(
                                     task.shuffle_id))
-            try:
-                self._send_planned(t.placement, task, plan.plan_epoch,
-                                   lo, sizes, data)
-            finally:
-                if lease is not None:
-                    lease.free()
+            sends.append((t.placement, task, plan.plan_epoch, lo, sizes,
+                          data, lease))
+        self._send_planned_batch(sends)
 
     def _send_planned(self, slot: int, task: _PushTask, plan_epoch: int,
                       lo: int, sizes: List[int], data: bytes) -> bool:
@@ -1099,6 +1111,140 @@ class SegmentPusher:
             return False
         if resp.status != M.STATUS_OK:
             return False
+        self.planned_sent += 1
+        self.planned_bytes += len(data)
+        return True
+
+    # -- native raw-frame sender (shared fc engine, fc_submit_raw) -------
+
+    def _native_push_engine(self):
+        """The worker thread's raw-frame engine, created lazily; None
+        when the native client isn't built or the wire isn't plain
+        (compression/codec transform frames the C side won't)."""
+        if self._push_engine is not None:
+            return self._push_engine
+        if (not self.conf.native_fetch or self.conf.wire_compress
+                or getattr(self.endpoint, "_codec", None) is not None):
+            return None
+        from sparkrdma_tpu.shuffle.native_fetch import NativeFetchEngine
+        if not NativeFetchEngine.available():
+            return None
+        try:
+            self._push_engine = NativeFetchEngine()
+        except RuntimeError:
+            return None
+        return self._push_engine
+
+    def _close_push_engine(self) -> None:
+        eng, self._push_engine = self._push_engine, None
+        self._push_conns.clear()
+        if eng is not None:
+            eng.close()
+
+    def _native_push_conn(self, eng, slot: int) -> int:
+        """A cached raw-mode connection to ``slot``'s control port (the
+        Python server speaks the same frames; replies are FIFO per
+        connection). 0 = unreachable."""
+        conn = self._push_conns.get(slot)
+        if conn and eng.alive(conn):
+            return conn
+        self._push_conns.pop(slot, None)
+        try:
+            peer = self.endpoint.member_at(slot)
+        except Exception:  # noqa: BLE001 — tombstoned mid-push
+            return 0
+        conn = eng.connect(peer.rpc_host, peer.rpc_port, raw=True,
+                           timeout_ms=self.conf.connect_timeout_ms)
+        if conn:
+            self._push_conns[slot] = conn
+        return conn
+
+    def _send_planned_batch(self, sends: List[tuple]) -> None:
+        """Send one map's collected planned pushes: doorbell-batched
+        raw frames on the native engine where possible, the per-send
+        Python RPC for the rest. Any native anomaly (dead connection,
+        undecodable reply, deadline) re-sends that item over the Python
+        path — the receive-side fence/epoch dedupe makes the replay
+        idempotent. Staging leases free only after the batch settles."""
+        try:
+            eng = self._native_push_engine() if sends else None
+            fallback = []
+            if eng is None:
+                fallback = sends
+            else:
+                pending = {}  # req_id -> (item, resp_buf)
+                batch = max(1, self.conf.fetch_doorbell_batch)
+                unsent = 0
+                for item in sends:
+                    slot, task, plan_epoch, lo, sizes, data, _lease = item
+                    conn = self._native_push_conn(eng, slot)
+                    if not conn:
+                        fallback.append(item)
+                        continue
+                    self._push_req_id += 1
+                    req = M.PushPlannedReq(self._push_req_id,
+                                           task.shuffle_id, task.map_id,
+                                           task.fence, plan_epoch, lo,
+                                           list(sizes), data)
+                    # reply: _QI head + one verdict byte per partition
+                    resp_buf = bytearray(64 + len(sizes))
+                    if eng.submit_raw(conn, req.req_id, req.encode(),
+                                      resp_buf) != 0:
+                        fallback.append(item)
+                        continue
+                    pending[req.req_id] = (item, resp_buf)
+                    unsent += 1
+                    if unsent >= batch:
+                        eng.flush()
+                        unsent = 0
+                if unsent:
+                    eng.flush()
+                deadline = (time.monotonic()
+                            + self.conf.resolved_request_deadline_s())
+                while pending and time.monotonic() < deadline:
+                    for c in eng.poll(50):
+                        ent = pending.pop(c.req_id, None)
+                        if ent is None:
+                            continue
+                        item, buf = ent
+                        if not self._settle_native_push(item, c, buf):
+                            fallback.append(item)
+                if pending:
+                    # server stalled under the batch: redial next map,
+                    # replay these over the Python path
+                    self._close_push_engine()
+                    fallback.extend(item for item, _ in pending.values())
+            for item in fallback:
+                slot, task, plan_epoch, lo, sizes, data, _lease = item
+                self._send_planned(slot, task, plan_epoch, lo, sizes,
+                                   data)
+        finally:
+            for *_rest, lease in sends:
+                if lease is not None:
+                    lease.free()
+
+    def _settle_native_push(self, item: tuple, comp, buf: bytearray
+                            ) -> bool:
+        """One raw completion -> the Python sender's verdict accounting.
+        False = replay this item over the Python RPC."""
+        from sparkrdma_tpu.shuffle.native_fetch import NativeFetchEngine
+        slot, task, plan_epoch, _lo, _sizes, data, _lease = item
+        if comp.status != 0 or comp.nbytes <= 0:
+            return False  # connection died under the request
+        try:
+            resp = NativeFetchEngine.decode_reply(
+                comp.frame_type, bytes(buf[:comp.nbytes]))
+        except Exception:  # noqa: BLE001 — undecodable reply
+            return False
+        if not isinstance(resp, M.PushPlannedResp):
+            return False
+        self.planned_native += 1
+        self.tracer.instant("push.planned_native", "push",
+                            shuffle=task.shuffle_id, map=task.map_id,
+                            target=slot, epoch=plan_epoch,
+                            bytes=len(data))
+        if resp.status != M.STATUS_OK:
+            return True  # an authoritative rejection, not a failure
         self.planned_sent += 1
         self.planned_bytes += len(data)
         return True

@@ -180,6 +180,26 @@ class TpuShuffleReader:
                 payload = jax.device_put(
                     np.zeros((0, self.row_payload_bytes), dtype=np.uint8), device)
                 return keys, payload
+            # wire->device donation: when every chunk already lives in
+            # lease memory (the native fetch engine landed the response
+            # payloads there) and tiles whole rows, the lease views go to
+            # the device directly — the staging gather below would be the
+            # one copy the zero-copy receive path exists to delete. The
+            # leases stay referenced until the transfer completes (the
+            # finally block frees them after block_until_ready).
+            if (self.fetcher.conf.native_fetch
+                    and all(r.lease is not None for r in chunks)
+                    and all(len(r.data) % row_bytes == 0 for r in chunks)):
+                import jax.numpy as jnp
+
+                parts = [jax.device_put(r.data, device) for r in chunks]
+                flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+                rows_d = flat.reshape(-1, row_bytes)
+                payload_dev = rows_d[:, 8:]
+                keys_dev = jax.lax.bitcast_convert_type(
+                    rows_d[:, :8].reshape(-1, 2, 4), jnp.uint32)
+                jax.block_until_ready((keys_dev, payload_dev))
+                return keys_dev, payload_dev
             with pool.get(total, tenant=self.fetcher.tenant) as buf:
                 pos = 0
                 for r in chunks:

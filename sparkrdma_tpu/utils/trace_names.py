@@ -65,6 +65,7 @@ INSTANTS = frozenset({
     "meta.epoch_bump",
     "peer.suspect",
     "push.drop",
+    "push.planned_native",
     "push.superseded",
     "recovery.repoint",
     "plan.coalesce",

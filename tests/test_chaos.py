@@ -106,6 +106,18 @@ ELASTIC = os.environ.get("CHAOS_ELASTIC", "0") not in ("0", "false")
 # (separate primary process, kill -9, zero map re-executions) runs
 # regardless.
 DRIVER = os.environ.get("CHAOS_DRIVER", "0") not in ("0", "false")
+# native client fetch engine under chaos: 1 runs the whole matrix on
+# the native dataplane — the C++ block server serves and the C client
+# engine (csrc/fetchclient.cpp) fetches into pool leases — so every
+# injected control-plane fault, disk fault, and membership event crosses
+# the native engine's fallback-to-Python envelope (conn death mid-batch,
+# leases released on unwind, suspect re-resolution). Data-frame faults
+# inject at the Python transport layer and so don't reach the C
+# dataplane; the byte-identity assertions are the point here.
+# run_chaos.sh sweeps both; requires the native .so (silently degrades
+# to the Python dataplane where it isn't built).
+NATIVE_FETCH = os.environ.get("CHAOS_NATIVE_FETCH",
+                              "0") not in ("0", "false")
 # CHAOS_LOCKGRAPH=1: run every scenario under the lock-order shim
 # (sparkrdma_tpu/analysis/lockgraph.py) so the chaos matrix doubles as
 # race detection — faults drive the rare teardown/retry/suspect paths
@@ -122,10 +134,19 @@ def _chaos_lockgraph():
     yield from lockgraph_module_guard()
 
 
+# Faults that cut or corrupt DATA frames inject at the Python transport
+# layer, which the native dataplane bypasses entirely — scenarios that
+# assert those faults FIRED pin the Python dataplane (the native
+# engine's own anomaly coverage lives in tests/test_native_fetch.py and
+# the sanitizer harness; the byte-identity matrix still sweeps it).
+PY_DATAPLANE = dict(use_cpp_runtime=False, native_fetch=False)
+
+
 def _conf(**kw):
     base = dict(connect_timeout_ms=3000, max_connection_attempts=2,
                 retry_backoff_base_ms=10, retry_backoff_cap_ms=80,
-                fetch_retry_budget=3, use_cpp_runtime=False,
+                fetch_retry_budget=3, use_cpp_runtime=NATIVE_FETCH,
+                native_fetch=NATIVE_FETCH,
                 pre_warm_connections=False,
                 coalesce_reads=COALESCE,
                 location_epoch_cache=WARM,
@@ -281,7 +302,7 @@ def test_chaos_corruption_healed_by_refetch(tmp_path):
     """Bit-flipped fetch payloads are caught by the CRC32 trailer and
     refetched within the budget; the reduce is byte-identical and the
     failure counters show the retries that absorbed it."""
-    driver, execs = _cluster(tmp_path, push_merge=False)
+    driver, execs = _cluster(tmp_path, push_merge=False, **PY_DATAPLANE)
     injector = FaultInjector(seed=SEED)
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
@@ -342,7 +363,7 @@ def test_chaos_transient_disconnect_absorbed(tmp_path):
     """One mid-stream disconnect (response cut on the wire) fails the
     whole in-flight window, but the retry envelope re-dials and refetches
     — byte-identical, no recompute."""
-    driver, execs = _cluster(tmp_path, read_ahead_depth=4)
+    driver, execs = _cluster(tmp_path, read_ahead_depth=4, **PY_DATAPLANE)
     injector = FaultInjector(seed=SEED)
     map_runs = []
     try:
@@ -377,7 +398,8 @@ def test_chaos_peer_kill_mid_fetch_recompute(tmp_path):
     survivors — never on the dead slot — and the reduce completes
     byte-identical."""
     driver, execs = _cluster(tmp_path, read_ahead_depth=4,
-                             fetch_retry_budget=1, push_merge=False)
+                             fetch_retry_budget=1, push_merge=False,
+                             **PY_DATAPLANE)
     injector = FaultInjector(seed=SEED)
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
@@ -546,7 +568,7 @@ def test_chaos_merge_repoint_zero_reexecutions(tmp_path):
     segments, byte-identical to the fault-free run."""
     driver, execs = _cluster(tmp_path, fetch_retry_budget=1,
                              push_merge=True, merge_replicas=2,
-                             push_deadline_ms=8000)
+                             push_deadline_ms=8000, **PY_DATAPLANE)
     injector = FaultInjector(seed=SEED)
     map_runs = []
     merged_metrics = []
@@ -753,7 +775,8 @@ def test_chaos_tenant_executor_loss_isolated(tmp_path):
     failed fetches, and its location epoch UNBUMPED (the tombstone
     invalidates only shuffles naming the dead slot)."""
     driver, execs = _cluster(tmp_path, read_ahead_depth=4,
-                             fetch_retry_budget=1, push_merge=False)
+                             fetch_retry_budget=1, push_merge=False,
+                             **PY_DATAPLANE)
     injector = FaultInjector(seed=SEED)
     t1_reruns = []
     try:
@@ -889,7 +912,7 @@ def test_chaos_stale_cache_never_serves_dead_peer(tmp_path):
     if not WARM:
         pytest.skip("cold sweep: no cache to go stale")
     driver, execs = _cluster(tmp_path, fetch_retry_budget=1,
-                             push_merge=False)
+                             push_merge=False, **PY_DATAPLANE)
     try:
         handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
                                          partitioner=PartitionerSpec("modulo"))
