@@ -478,6 +478,8 @@ def _secondary_workloads(detail: dict, mesh, n: int, on_tpu: bool) -> None:
     _progress("planned push done")
     _bench_ha_failover(detail)
     _progress("driver failover done")
+    _bench_ctrl_plane(detail)
+    _progress("control-plane scale-out done")
 
 
 def _bench_als(detail: dict, mesh, n: int, on_tpu: bool) -> None:
@@ -804,6 +806,35 @@ def _bench_topo_exchange(detail: dict) -> None:
     except Exception as e:  # noqa: BLE001
         detail["hierarchical_exchange_error"] = \
             f"{type(e).__name__}: {e}"[:120]
+
+
+def _bench_ctrl_plane(detail: dict) -> None:
+    """Partitioned metadata ownership's win, measured without hardware:
+    the same deterministic publish scripts (fence-1 publishes + zombie
+    fence-0 re-publishes + fence-2 supersedes + merged-directory blobs)
+    run through ONE driver lock vs through 4 real per-shard write
+    owners with batched driver convergence, same process
+    (shuffle/ctrl_bench.py). Gates: the resulting driver state is
+    byte-identical — table bytes, fence floors, merged directory, and
+    WHICH writes got fenced — and ``ctrl_plane_scaleout`` >= 1.5x at 4
+    owners (tier-1 asserts the same bound). ``ctrl_registrations_per_s``
+    is the part that deliberately stays driver-serialized (shard-map
+    assignment + epoch composition). Pure host path — identical on TPU
+    and CPU-fallback records."""
+    try:
+        from sparkrdma_tpu.shuffle.ctrl_bench import run_ctrl_microbench
+
+        res = run_ctrl_microbench(shards=4)
+        if not res["identical"]:
+            detail["ctrl_plane_error"] = \
+                "sharded driver state diverged from the 1-owner baseline"
+            return
+        detail["ctrl_plane_scaleout"] = res["speedup"]
+        detail["ctrl_publishes_per_s_driver"] = res["publishes_per_s_driver"]
+        detail["ctrl_publishes_per_s_sharded"] = res["publishes_per_s_sharded"]
+        detail["ctrl_registrations_per_s"] = res["registrations_per_s"]
+    except Exception as e:  # noqa: BLE001
+        detail["ctrl_plane_error"] = f"{type(e).__name__}: {e}"[:120]
 
 
 def _bench_elastic(detail: dict) -> None:

@@ -85,6 +85,18 @@
 #                               crosses the engine's fallback-to-Python
 #                               envelope; degrades to Python where the
 #                               .so isn't built)
+#   CHAOS_SHARD_MODES="0 1"     partitioned-ownership modes to sweep
+#                               (default both: off, and CHAOS_SHARD=1
+#                               so the whole matrix runs with
+#                               metadata_shards=2 + shard_ownership=1 —
+#                               publishes land at per-shard write
+#                               owners, batch-converge into the driver,
+#                               and stream to per-shard standbys, so
+#                               every injected fault crosses the
+#                               sharded control-plane write path and
+#                               its driver-direct fallback; the
+#                               dedicated kill-a-shard-owner scenario
+#                               runs regardless)
 #   CHAOS_DISK=0          drop the storage-fault matrix from the sweep
 #   CHAOS_LOCKGRAPH=1     run every scenario under the lock-order shim
 #                         (sparkrdma_tpu/analysis/lockgraph.py): the
@@ -104,8 +116,10 @@ TENANT_MODES=${CHAOS_TENANT_MODES:-"0 1"}
 ELASTIC_MODES=${CHAOS_ELASTIC_MODES:-"0 1"}
 DRIVER_MODES=${CHAOS_DRIVER_MODES:-"0 1"}
 NATIVE_FETCH_MODES=${CHAOS_NATIVE_FETCH_MODES:-"0 1"}
+SHARD_MODES=${CHAOS_SHARD_MODES:-"0 1"}
 DISK=${CHAOS_DISK:-1}
 failed=()
+for shard in $SHARD_MODES; do
 for nfetch in $NATIVE_FETCH_MODES; do
 for driver in $DRIVER_MODES; do
 for elastic in $ELASTIC_MODES; do
@@ -119,13 +133,15 @@ for coalesce in $MODES; do
     echo "=== chaos sweep: seed ${seed} coalesce=${coalesce}" \
          "warm=${warm} skew=${skew} merge=${merge}" \
          "pushplan=${pushplan} tenant=${tenant} elastic=${elastic}" \
-         "driver=${driver} nfetch=${nfetch} disk=${DISK} ==="
+         "driver=${driver} nfetch=${nfetch} shard=${shard}" \
+         "disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
          CHAOS_WARM="${warm}" CHAOS_SKEW="${skew}" \
          CHAOS_MERGE="${merge}" CHAOS_PUSHPLAN="${pushplan}" \
          CHAOS_TENANT="${tenant}" \
          CHAOS_ELASTIC="${elastic}" CHAOS_DRIVER="${driver}" \
          CHAOS_NATIVE_FETCH="${nfetch}" \
+         CHAOS_SHARD="${shard}" \
          CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
@@ -133,18 +149,20 @@ for coalesce in $MODES; do
       echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm}" \
            "skew=${skew} merge=${merge} pushplan=${pushplan}" \
            "tenant=${tenant} elastic=${elastic} driver=${driver}" \
-           "nfetch=${nfetch} FAILED — replay with:"
+           "nfetch=${nfetch} shard=${shard} FAILED — replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
            "CHAOS_WARM=${warm} CHAOS_SKEW=${skew}" \
          "CHAOS_MERGE=${merge} CHAOS_PUSHPLAN=${pushplan}" \
            "CHAOS_TENANT=${tenant}" \
            "CHAOS_ELASTIC=${elastic} CHAOS_DRIVER=${driver}" \
            "CHAOS_NATIVE_FETCH=${nfetch}" \
+           "CHAOS_SHARD=${shard}" \
            "CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}d${driver}n${nfetch}")
+      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}d${driver}n${nfetch}h${shard}")
     fi
   done
+done
 done
 done
 done
@@ -163,4 +181,4 @@ echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
      "planes, both reduce-planning modes, both push-merge modes," \
      "both planned-push modes, both tenancy modes, both" \
      "elastic-membership modes, both driver-HA modes, both client" \
-     "fetch engines (disk=${DISK})"
+     "fetch engines, both metadata-ownership modes (disk=${DISK})"

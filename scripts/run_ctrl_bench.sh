@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Control-plane scale-out sweep (ROADMAP item 3): the partitioned
+# metadata ownership battery — the shard_plane unit/endpoint tests
+# (owner fence CAS, seal-then-replay handoff, standby streams, the
+# kill-the-owner zero-re-execution acceptance), the model-checked
+# handoff scenarios, then the ctrl_bench microbench across a set of
+# seeds (repeat rounds; sleep-based op cost is noisy under load) with
+# its acceptance gates: >= 1.5x publish throughput at 4 write owners vs
+# the driver-serialized baseline AND byte-identical resulting driver
+# state (table bytes, fence floors, merged directory, fenced-zombie
+# parity) on EVERY round. ``publishes_per_s_sharded`` and
+# ``registrations_per_s`` are the headline numbers; a divergent round
+# exits non-zero immediately.
+#
+# Usage: scripts/run_ctrl_bench.sh [rounds]
+#   CTRL_BENCH_ROUNDS=5     alternative way to set the repeat count
+#   CTRL_BENCH_SHARDS=4     owner count for the scale-out mode
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+ROUNDS=${1:-${CTRL_BENCH_ROUNDS:-5}}
+SHARDS=${CTRL_BENCH_SHARDS:-4}
+failed=()
+
+echo "=== shard ownership battery (unit + endpoints + handoff) ==="
+if ! JAX_PLATFORMS=cpu python -m pytest tests/test_shard_ownership.py -q \
+     -p no:cacheprovider -p no:randomly; then
+  failed+=("test_shard_ownership")
+fi
+echo "=== kill-a-shard-owner chaos acceptance ==="
+if ! JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+     -k shard_owner_kill -p no:cacheprovider -p no:randomly; then
+  failed+=("shard_owner_kill")
+fi
+echo "=== model-checked handoff scenarios ==="
+if ! JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+from sparkrdma_tpu.analysis import modelcheck
+bad = 0
+for scn in modelcheck.catalog():
+    if scn.name not in ("handoff_vs_publish", "handoff_vs_driver_failover"):
+        continue
+    runs, stats = modelcheck.run_scenario(scn)
+    viols = [r for r in runs if r.violation]
+    print(f"{scn.name}: {len(runs)} schedules, {len(viols)} violations")
+    bad += len(viols)
+sys.exit(1 if bad else 0)
+EOF
+then
+  failed+=("modelcheck-handoff")
+fi
+
+echo "=== control-plane scale-out microbench (${ROUNDS} rounds," \
+     "${SHARDS} owners) ==="
+if ! JAX_PLATFORMS=cpu python -m sparkrdma_tpu.shuffle.ctrl_bench \
+     --shards "${SHARDS}" --seeds "${ROUNDS}"; then
+  failed+=("ctrl_bench")
+fi
+
+if [ "${#failed[@]}" -gt 0 ]; then
+  echo "ctrl-plane sweep: FAILED: ${failed[*]}"
+  exit 1
+fi
+echo "ctrl-plane sweep: green — sharded write path byte-identical to" \
+     "the driver-serialized baseline at >= 1.5x throughput"

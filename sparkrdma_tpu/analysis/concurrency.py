@@ -59,6 +59,7 @@ THREADED_MODULES = [
     "sparkrdma_tpu/shuffle/planner.py",
     "sparkrdma_tpu/shuffle/push_merge.py",
     "sparkrdma_tpu/shuffle/pushed_store.py",
+    "sparkrdma_tpu/shuffle/shard_plane.py",
     "sparkrdma_tpu/shuffle/tenancy.py",
     "sparkrdma_tpu/runtime/pool.py",
     "sparkrdma_tpu/runtime/staging.py",

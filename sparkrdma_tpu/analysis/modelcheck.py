@@ -70,9 +70,12 @@ from sparkrdma_tpu.analysis.core import Finding, rel, repo_root
 from sparkrdma_tpu.analysis.scheduler import (Run, VirtualScheduler,
                                               explore_dfs, random_walks,
                                               replay)
+from sparkrdma_tpu.shuffle import shard_plane
 from sparkrdma_tpu.shuffle.ha import (OP_BUMP, OP_REGISTER, OP_UNREGISTER,
-                                      OP_WIRE, InMemoryLeaseStore, OpLog,
-                                      OpRecord, rebase_epoch)
+                                      OP_WIRE, SHARD_OP_PUBLISH,
+                                      InMemoryLeaseStore, OpLog, OpRecord,
+                                      compose_epoch, incarnation_of,
+                                      pack_shard_publish, rebase_epoch)
 from sparkrdma_tpu.shuffle.location_plane import EPOCH_DEAD, LocationPlane
 from sparkrdma_tpu.shuffle.map_output import DriverTable
 from sparkrdma_tpu.shuffle.push_merge import MergedDirectory, MergedEntry
@@ -136,6 +139,19 @@ class World:
         self.repl_last: Dict[str, Tuple[int, int]] = {}
         self.promote_term: Dict[str, int] = {}
         self.ttl_expired = False
+        # -- partitioned metadata ownership mirrors (shuffle/shard_plane
+        # + the endpoints._owner_publish / _on_shard_handoff glue): REAL
+        # ShardOwnerStore per named host; the standby stream keyed by
+        # (owner, shard) carries the real packed op payloads
+        self.shard_owners: Dict[str, shard_plane.ShardOwnerStore] = {}
+        self.shard_streams: Dict[Tuple[str, int],
+                                 List[Tuple[int, bytes]]] = {}
+        # highest fence ACKed at an owner per (map, exec) — every ACKed
+        # write must stay visible in the driver table (the shard-converge
+        # invariant); plus sealed-segment completeness obligations
+        self.shard_acked: Dict[Tuple[int, int], int] = {}
+        self.handoff_obligations: List[Tuple[str, int, Dict[int, bytes]]] \
+            = []
 
     # -- driver glue mirrors ---------------------------------------------
 
@@ -331,6 +347,60 @@ class World:
                 self.sid, self.epochs.get(self.sid, 1))
         return {"table": table, "live": live,
                 "epoch": rebase_epoch(1 + bumps, term)}
+
+    # -- partitioned ownership mirrors (shuffle/shard_plane.py + the
+    # endpoints._owner_publish / _on_shard_handoff glue) ----------------
+
+    def shard_owner(self, name: str) -> shard_plane.ShardOwnerStore:
+        return self.shard_owners.setdefault(
+            name, shard_plane.ShardOwnerStore())
+
+    def shard_publish(self, name: str, shard: int, map_id: int,
+                      token: int, exec_index: int, fence: int,
+                      gen: int) -> int:
+        """One direct-to-owner publish (endpoints._owner_publish): the
+        owner runs the real fence CAS; APPLIED writes stream to the
+        standby and converge into the driver table (the ShardBatchMsg
+        echo, replayed through the same fenced ``publish``); anything
+        else bounces to the driver-direct path — one extra hop, never a
+        lost write."""
+        import struct as _struct
+        entry = _struct.pack("<qi", token, exec_index)
+        store = self.shard_owner(name)
+        status, _rec = store.publish(self.sid, shard, map_id, entry,
+                                     fence, gen)
+        if status == shard_plane.APPLIED:
+            key = (map_id, exec_index)
+            self.shard_acked[key] = max(self.shard_acked.get(key, 0),
+                                        fence)
+            self.shard_streams.setdefault((name, shard), []).append(
+                (SHARD_OP_PUBLISH,
+                 pack_shard_publish(map_id, fence, entry)))
+            self.publish(map_id, token, exec_index, fence)
+        elif status != shard_plane.FENCED:
+            # SEALED / STALE_GEN / NOT_OWNER: forward the original to
+            # the driver (endpoints._on_shard_publish fallback)
+            self.publish(map_id, token, exec_index, fence)
+        return status
+
+    def shard_seal(self, name: str, shard: int) -> None:
+        """Outgoing-owner half of a handoff (ShardHandoffMsg at the old
+        owner): seal, and record the completeness OBLIGATION — whoever
+        ends up owning the shard must hold every sealed entry."""
+        store = self.shard_owner(name)
+        sealed = store.entries_of(self.sid, shard)
+        store.seal(self.sid, shard)
+        self.handoff_obligations.append((name, shard, sealed))
+
+    def shard_adopt(self, name: str, shard: int, lo: int, hi: int,
+                    gen: int, replay_from: Optional[str] = None) -> bool:
+        """Incoming-owner half (endpoints._on_shard_assignment +
+        _on_shard_handoff): adopt forward-only at ``gen``, replaying the
+        standby stream buffered from ``replay_from``'s op stream."""
+        replay = list(self.shard_streams.get((replay_from, shard), [])) \
+            if replay_from is not None else None
+        return self.shard_owner(name).adopt(
+            self.sid, shard, lo, hi, self.num_maps, gen, replay=replay)
 
 
 class MergeTargetModel:
@@ -565,6 +635,42 @@ def check_invariants(world: World,
             if e is not None and e > 0:
                 return (f"no-resurrect: observer {i} re-armed DEAD "
                         f"shuffle {sid} at epoch {e}")
+
+    # shard-converge: every write an owner ACKed (applied under its
+    # generation) stays visible in the driver-authoritative fence
+    # floors — a handoff may re-route or re-send a write, never lose it
+    for (map_id, exec_index), fence in world.shard_acked.items():
+        applied = world.applied_fences.get((map_id, exec_index))
+        if applied is None or applied < fence:
+            return (f"shard-converge: owner-ACKed publish map {map_id} "
+                    f"exec {exec_index} fence {fence} never reached the "
+                    f"driver table (floor {applied})")
+
+    # shard-handoff-complete: sealing a shard must never LOSE a write —
+    # every entry of the sealed segment stays published in the
+    # driver-authoritative table (the batch echo converged it before or
+    # at the seal; the successor's replay and the publisher republish
+    # backstop only ever re-send, and fences make re-sends idempotent)
+    for sealed_name, shard, sealed_entries in world.handoff_obligations:
+        for map_id in sealed_entries:
+            if world.table.entry(map_id) is None:
+                return (f"shard-handoff-complete: sealed map {map_id} of "
+                        f"shard {shard} (old owner {sealed_name}) was "
+                        f"lost from the driver table")
+
+    # shard-single-writer: at most one UNSEALED owner per (shard,
+    # generation) — two hosts accepting writes for the same range under
+    # the same generation would split the fence-CAS authority
+    owners_by_gen: Dict[Tuple[int, int], List[str]] = {}
+    for name, store in world.shard_owners.items():
+        for sh in store.owned_shards(world.sid):
+            if store.owns(world.sid, sh):
+                g = store.gen_of(world.sid, sh) or 0
+                owners_by_gen.setdefault((sh, g), []).append(name)
+    for (sh, g), names in owners_by_gen.items():
+        if len(names) > 1:
+            return (f"shard-single-writer: shard {sh} generation {g} "
+                    f"owned unsealed by {sorted(names)}")
 
     # ledger-conserve: usage == charges - releases of live state, >= 0
     for tenant, expected in world.expected_usage.items():
@@ -1157,6 +1263,113 @@ def _build_failover_vs_ttl_sweep(sched: VirtualScheduler) -> World:
                        chan=f"obs{i}.push", touches={f"obs{i}"})
     sched.post("sb.takeover", takeover,
                touches={"lease", "standby", "obs0", "obs1"})
+    return world
+
+
+@scenario("handoff_vs_publish",
+          "shard ownership handoff races in-flight direct publishes: "
+          "the old owner seals, the new owner adopts + replays the "
+          "standby stream, stragglers bounce to the driver — no ACKed "
+          "write may be lost, no sealed shard may apply")
+def _build_handoff_vs_publish(sched: VirtualScheduler) -> World:
+    world = World(num_observers=1, num_maps=2)
+    gen1 = compose_epoch(0, 1)
+    gen2 = compose_epoch(0, 2)
+    # host A owns shard 0 (maps [0, 2)) at gen1; map0's publish already
+    # ACKed + streamed pre-history
+    world.shard_adopt("A", 0, 0, 2, gen1)
+    world.shard_publish("A", 0, 0, token=500, exec_index=0, fence=1,
+                        gen=gen1)
+
+    # in-flight concurrent with the handoff: map1's first publish aimed
+    # at A (may land before the seal — ACK + converge — or after —
+    # bounce to the driver), a zombie fence-0 re-publish of map0, and a
+    # supersede of map0 at fence 2
+    sched.post("pub.m1->A",
+               lambda s: world.shard_publish("A", 0, 1, 510, 1, 1, gen1),
+               chan="pubX", touches={"A", "table"})
+    sched.post("zombie.m0->A",
+               lambda s: world.shard_publish("A", 0, 0, 499, 0, 0, gen1),
+               chan="pubY", touches={"A", "table"})
+    sched.post("supersede.m0->A",
+               lambda s: world.shard_publish("A", 0, 0, 501, 0, 2, gen1),
+               chan="pubZ", touches={"A", "table"})
+
+    # the handoff: ShardMapMsg/ShardHandoffMsg fan out on per-member
+    # FIFO channels, so A's seal and B's adopt+replay are CONCURRENT —
+    # B can own before A sealed (gen admission is the guard, not the
+    # seal), and stragglers at A after the seal bounce to the driver
+    sched.post("handoff.seal@A", lambda s: world.shard_seal("A", 0),
+               chan="A.push", touches={"A"})
+    sched.post("handoff.adopt@B",
+               lambda s: world.shard_adopt("B", 0, 0, 2, gen2,
+                                           replay_from="A"),
+               chan="B.push", touches={"B"})
+    # the republish backstop: the publisher re-aims its remembered
+    # map0 publish at the new owner under gen2 (fence-idempotent)
+    sched.post("republish.m0->B",
+               lambda s: world.shard_publish("B", 0, 0, 500, 0, 1, gen2),
+               chan="pubX", touches={"B", "table"})
+    return world
+
+
+@scenario("handoff_vs_driver_failover",
+          "a shard handoff issued by the dying driver incarnation races "
+          "the promoted driver's re-assignment: composed generations "
+          "put the incarnation in the high bits, so the new "
+          "incarnation's assignment dominates in EVERY arrival order "
+          "and the zombie assignment can never un-seat it")
+def _build_handoff_vs_driver_failover(sched: VirtualScheduler) -> World:
+    world = World(num_observers=1, num_maps=2)
+    gen_old = compose_epoch(0, 1)
+    gen_zombie = compose_epoch(0, 2)   # the dying driver's handoff
+    gen_new = compose_epoch(1, 1)      # the promoted driver's assignment
+    world.lease_acquire("primary", 0, now=0.0)
+    world.shard_adopt("A", 0, 0, 2, gen_old)
+    world.shard_publish("A", 0, 0, token=700, exec_index=0, fence=1,
+                        gen=gen_old)
+
+    # the old incarnation's handoff to B and the new incarnation's
+    # assignment to C race at both hosts in any order; forward-only
+    # adoption on the composed generation must leave C the owner
+    sched.post("zombie.handoff.seal@A",
+               lambda s: world.shard_seal("A", 0),
+               chan="A.push", touches={"A"})
+    sched.post("zombie.handoff.adopt@B",
+               lambda s: world.shard_adopt("B", 0, 0, 2, gen_zombie,
+                                           replay_from="A"),
+               chan="B.push", touches={"B"})
+
+    def takeover(s):
+        if not world.lease_acquire("sb", 1, now=11.0):
+            return
+        world.takeover("sb", 1, now=11.0)
+        # the promoted driver re-assigns shard 0 to C; B (if it adopted
+        # the zombie handoff) must seal or be superseded by generation
+        s.post("new.assign.adopt@C",
+               lambda s2: world.shard_adopt("C", 0, 0, 2, gen_new,
+                                            replay_from="A"),
+               chan="C.push", touches={"C"})
+        s.post("new.assign.seal@B",
+               lambda s2: world.shard_seal("B", 0),
+               chan="B.push", touches={"B"})
+    sched.post("sb.takeover", takeover,
+               touches={"lease", "standby", "B", "C"})
+
+    # a straggler write still stamped with the ZOMBIE generation: every
+    # owner must bounce it (STALE_GEN at C, SEALED/NOT_OWNER at B) into
+    # the driver-direct path — it may never apply under gen_zombie at
+    # the new incarnation's owner
+    def straggler(s):
+        status = world.shard_publish("C", 0, 1, 710, 1, 1, gen_zombie)
+        if status == shard_plane.APPLIED and \
+                incarnation_of(world.shard_owner("C").gen_of(
+                    world.sid, 0) or 0) != 0:
+            world.problem = ("shard-gen-fence: a zombie-generation "
+                             "write applied at the new incarnation's "
+                             "owner")
+    sched.post("straggler.m1->C", straggler, chan="pubS",
+               touches={"C", "table"})
     return world
 
 

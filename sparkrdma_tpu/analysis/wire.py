@@ -144,8 +144,15 @@ def _gen_arg(name: str, rng: random.Random):
     loudly (None -> TypeError inside the fuzz loop), which is the
     desired "teach the fuzzer about your field" nudge.
     """
-    if name in ("req_id", "fence", "bcast_id", "consumed"):
+    if name in ("req_id", "fence", "bcast_id", "consumed", "owner_gen"):
         return rng.randrange(1 << 62)
+    if name == "seq":
+        # per-shard op-log sequence: u64 pack, fuzz the width
+        return rng.randrange(1 << 63)
+    if name == "blobs":
+        # ShardBatchMsg merged-blob riders: length-prefixed opaque bytes
+        return [bytes(rng.randrange(256) for _ in range(rng.randrange(32)))
+                for _ in range(rng.randrange(3))]
     if name == "epoch":
         # non-negative only: AnnounceMsg's broadcast epoch packs u64.
         # The signed location-plane epochs get EPOCH_DEAD coverage from
@@ -278,6 +285,33 @@ _EXTRA_CASES: Dict[str, List[Callable[[], "rpc_msg.RpcMsg"]]] = {
     "TakeoverMsg": [
         lambda: M.TakeoverMsg(0, "127.0.0.1", 1),
         lambda: M.TakeoverMsg((1 << 32) - 1, "x" * 128, (1 << 32) - 1)],
+    # partitioned-ownership corners (msgs 46-50): generation 0 (a
+    # pre-assignment straggler the owner bounces as STALE_GEN) and
+    # max-i64 generation (the composed-epoch signed-pack boundary);
+    # a length-less publish vs a histogram-bearing one; an empty
+    # convergence batch (gen-change flush of an untouched shard) and a
+    # mixed records+blobs batch; an empty op blob; and the handoff
+    # old_slot=-1 sentinel (shard count grew — no predecessor to seal)
+    "ShardPublishMsg": [
+        lambda: M.ShardPublishMsg(1, 0, b"\x00" * 12, 0, 0, None),
+        lambda: M.ShardPublishMsg(1, 2, b"\xff" * 12, (1 << 62) - 1,
+                                  (1 << 63) - 1, [0, 7, 1 << 30])],
+    "ShardMergedPublishMsg": [
+        lambda: M.ShardMergedPublishMsg(1, 0, 0, b""),
+        lambda: M.ShardMergedPublishMsg(1, 3, (1 << 63) - 1, b"m" * 64)],
+    "ShardBatchMsg": [
+        lambda: M.ShardBatchMsg(1, 0, 0, [], []),
+        lambda: M.ShardBatchMsg(1, 1, (1 << 63) - 1,
+                                [(0, 0, b"\x00" * 12, None),
+                                 (5, 9, b"\x01" * 12, [1, 2, 3])],
+                                [b"", b"blob"])],
+    "ShardOpMsg": [
+        lambda: M.ShardOpMsg(1, 0, 0, 0, 1, b""),
+        lambda: M.ShardOpMsg(1, 2, (1 << 63) - 1, (1 << 64) - 1, 2,
+                             b"\x7f" * 40)],
+    "ShardHandoffMsg": [
+        lambda: M.ShardHandoffMsg(1, 0, 1, 2, -1),
+        lambda: M.ShardHandoffMsg(1, 3, (1 << 63) - 1, 0, 5)],
 }
 
 

@@ -320,13 +320,33 @@ _KEYS = [
              "iterative bench's cold mode measures)."),
     _Key("metadata_shards", 0, "int", 0, 4096,
          doc="Shard the driver's per-shuffle location table by map-range "
-             "across up to this many executors: the driver keeps shard "
-             "assignment + commit fencing and forwards applied publishes "
-             "to shard hosts; reducers' cold-path table syncs long-poll "
-             "the shard hosts instead of serializing on the driver "
-             "endpoint. 0 = off (driver-hosted only). Any shard-host "
-             "failure falls back to the driver, which stays "
-             "authoritative."),
+             "across up to this many executors: reducers' cold-path "
+             "table syncs long-poll the shard hosts instead of "
+             "serializing on the driver endpoint. 0 = off (driver-hosted "
+             "only). Without shard_ownership the shards are read "
+             "REPLICAS (the driver applies every publish and forwards "
+             "it); with it they are partitioned write OWNERS. Any "
+             "shard-host failure falls back to the driver, which stays "
+             "authoritative either way."),
+    _Key("shard_ownership", False, "bool",
+         doc="Promote metadata shards from read replicas to partitioned "
+             "write OWNERS: executors publish map entries and merged-"
+             "directory updates DIRECTLY to the shard host owning that "
+             "map-range (one hop, no driver round-trip). Each owner "
+             "runs the fence CAS for its range, streams a per-shard op "
+             "log to a standby, and batch-converges applied writes into "
+             "the driver table (shard_batch_entries), so the driver-"
+             "visible table stays byte-identical to the unsharded path. "
+             "Membership changes hand ownership off generation-forward "
+             "(sealed logs fence stale owners). Requires "
+             "metadata_shards > 0; off = PR-6 replica forwarding."),
+    _Key("shard_batch_entries", 16, "int", 1, 4096,
+         doc="Ownership-mode batching: a shard owner flushes its applied "
+             "publishes to the driver once this many accumulate (a "
+             "background flusher also drains partial batches every few "
+             "milliseconds, so convergence lag is bounded). Higher = "
+             "fewer driver wakeups per publish; lower = tighter driver "
+             "freshness."),
     _Key("warm_read_cache", False, "bool",
          doc="Cross-stage shuffle-output reuse (shuffle/dist_cache.py): "
              "a reducer's materialized partition range is kept, keyed by "

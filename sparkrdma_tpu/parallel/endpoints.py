@@ -176,8 +176,14 @@ class DriverEndpoint:
         # is tombstoned) or the shuffle dies (EPOCH_DEAD). Guarded by
         # _tables_lock (epoch and table always move together).
         self._epochs: Dict[int, int] = {}
-        self._shard_maps: Dict[int, object] = {}  # shuffle -> ShardMap
+        # shuffle -> (ShardMap, owner_gen). The generation is composed
+        # like an epoch (ha.compose_epoch: incarnation high, per-
+        # incarnation handoff seq low) so a post-failover assignment
+        # always dominates every pre-failover owner's.
+        self._shard_maps: Dict[int, tuple] = {}
         self.epoch_bumps = 0  # audit: pushed invalidations
+        self.shard_handoffs = 0  # audit: shard ownership moves pushed
+        self.shard_batches = 0  # audit: owner batches converged
         # adaptive reduce planning (shuffle/planner.py): per-shuffle size
         # histograms fed by publish lengths, the published plans, and the
         # reduce-partition count the manager registered with. Guarded by
@@ -624,7 +630,18 @@ class DriverEndpoint:
         mutators = {ha.DRAIN_BEGIN: self.membership.begin_drain,
                     ha.DRAIN_ABORT: self.membership.abort_drain,
                     ha.DRAIN_RETIRE: self.membership.retire}
-        apply_fn = mutators[step]
+        base_fn = mutators[step]
+
+        def apply_fn(s: int):
+            res = base_fn(s)
+            if res is not None and step == ha.DRAIN_BEGIN:
+                # a draining OWNER hands its shards off NOW, not at the
+                # eventual tombstone: the drain exists to walk work off
+                # the host, and a fence-CAS range it still owned would
+                # re-pin every publish in its map-range to it
+                self._shard_handoff(s, reason="drain")
+            return res
+
         if self.oplog is not None and not self._replaying:
             return self._ha_apply(ha.OP_DRAIN, ha.op_drain(slot, step),
                                   lambda: apply_fn(slot))
@@ -722,16 +739,18 @@ class DriverEndpoint:
                 self._size_hists[shuffle_id] = SizeHistogram(
                     num_maps, num_partitions)
             if self.conf.metadata_shards > 0:
-                # shard hosts come from PLACEABLE membership: a draining
-                # slot is about to leave and must not adopt new replicas
-                live = self.membership.live_slots()
-                shard_map = ShardMap.assign(num_maps, live,
+                # shard hosts come from PLACEABLE membership: assign
+                # consults the plane directly, so a draining slot —
+                # about to leave — can never adopt a replica or (in
+                # ownership mode) a fence-CAS range
+                shard_map = ShardMap.assign(num_maps, self.membership,
                                             self.conf.metadata_shards)
                 if shard_map is not None:
-                    self._shard_maps[shuffle_id] = shard_map
+                    shard_gen = compose_epoch(self.incarnation, 1)
+                    self._shard_maps[shuffle_id] = (shard_map, shard_gen)
         if shard_map is not None:
             self._queue_push(None, M.ShardMapMsg(
-                shuffle_id, 1, num_maps, shard_map.shard_slots))
+                shuffle_id, shard_gen, num_maps, shard_map.shard_slots))
         if tenant != 0:
             # teach executors the owner (serve-path fair share, cache
             # charging). Skipped for the default tenant so pre-tenancy
@@ -920,6 +939,18 @@ class DriverEndpoint:
         the identity plan, so a size-less cluster degrades to today's
         behavior, never to an error."""
         from sparkrdma_tpu.shuffle.planner import ReducePlanner
+        if self.conf.shard_ownership and self.conf.metadata_shards > 0:
+            # owner-batch convergence is asynchronous (bounded by the
+            # executors' flush interval): planning at map-stage
+            # completion must not read the histogram mid-echo, so wait
+            # — briefly, bounded — for the table to reach its map count
+            with self._tables_lock:
+                table = self._tables.get(shuffle_id)
+            if table is not None:
+                deadline = time.monotonic() + 0.5
+                while (table.num_published < table.num_maps
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
         inputs = self._plan_inputs(shuffle_id)
         if inputs is None:
             return None
@@ -1235,6 +1266,60 @@ class DriverEndpoint:
                 directory.drop_slot(dead_slot)
         for sid in sids:
             self.bump_epoch(sid, reason="executor lost")
+        self._shard_handoff(dead_slot, reason="executor lost")
+
+    def _shard_handoff(self, slot: int, reason: str) -> None:
+        """Move every shard hosted by ``slot`` to a new owner,
+        generation-forward (model-checked: handoff_vs_publish /
+        handoff_vs_driver_failover). The refreshed ShardMapMsg rides the
+        announce channel first — the new owner adopts its range — then
+        one ShardHandoffMsg per moved shard triggers the standby-buffer
+        replay (FIFO per member keeps that order). DERIVED from the
+        logged membership op (tombstone/drain), never logged itself: a
+        standby replaying those ops re-derives the same reassignment,
+        and composed generations (incarnation in the high bits) keep any
+        replayed assignment strictly above every pre-failover owner's."""
+        if self.conf.metadata_shards <= 0:
+            return
+        from sparkrdma_tpu.shuffle.ha import compose_epoch, epoch_seq
+        from sparkrdma_tpu.shuffle.location_plane import ShardMap
+        pushes: List[RpcMsg] = []
+        moves = []
+        with self._tables_lock:
+            for sid, (smap, gen) in list(self._shard_maps.items()):
+                if slot not in smap.shard_slots:
+                    continue
+                table = self._tables.get(sid)
+                if table is None:
+                    continue
+                new_map = ShardMap.assign(table.num_maps, self.membership,
+                                          self.conf.metadata_shards,
+                                          avoid={slot})
+                if new_map is None:
+                    # nobody left to host shards: driver-only metadata
+                    # (the publish path and cold sync both fall back)
+                    self._shard_maps.pop(sid, None)
+                    continue
+                new_gen = compose_epoch(self.incarnation,
+                                        epoch_seq(gen) + 1)
+                self._shard_maps[sid] = (new_map, new_gen)
+                self.shard_handoffs += 1
+                pushes.append(M.ShardMapMsg(sid, new_gen, table.num_maps,
+                                            new_map.shard_slots))
+                for sh in range(new_map.num_shards):
+                    old = (smap.shard_slots[sh]
+                           if sh < smap.num_shards else -1)
+                    new = new_map.shard_slots[sh]
+                    if old != new:
+                        pushes.append(M.ShardHandoffMsg(sid, sh, new_gen,
+                                                        new, old))
+                        moves.append((sid, sh, new, old))
+        for m in pushes:
+            self._queue_push(None, m)
+        for sid, sh, new, old in moves:
+            self.tracer.instant("meta.shard_handoff", "meta", shuffle=sid,
+                                shard=sh, to_slot=new, from_slot=old,
+                                reason=reason)
 
     # -- elastic membership (parallel/membership.py) ---------------------
 
@@ -1323,7 +1408,8 @@ class DriverEndpoint:
         # log needs no semantic understanding of the frames it carries
         if (self.oplog is not None and not self._replaying
                 and isinstance(msg, (HelloMsg, M.JoinMsg, M.PublishMsg,
-                                     M.MergedPublishMsg))):
+                                     M.MergedPublishMsg,
+                                     M.ShardBatchMsg))):
             from sparkrdma_tpu.shuffle.ha import OP_WIRE
             return self._ha_apply(OP_WIRE, msg.encode(),
                                   lambda: self._dispatch(conn, msg))
@@ -1348,6 +1434,9 @@ class DriverEndpoint:
             return self._on_fetch_plan(msg)
         if isinstance(msg, M.MergedPublishMsg):
             self._on_merged_publish(msg)
+            return None
+        if isinstance(msg, M.ShardBatchMsg):
+            self._on_shard_batch(msg)
             return None
         if isinstance(msg, M.FetchMergedReq):
             return self._on_fetch_merged(msg)
@@ -1509,7 +1598,8 @@ class DriverEndpoint:
                         m.rpc_host, m.rpc_port)
             self.remove_member(m)
 
-    def _on_publish(self, msg: M.PublishMsg) -> Optional[RpcMsg]:
+    def _on_publish(self, msg: M.PublishMsg,
+                    forward_shard: bool = True) -> Optional[RpcMsg]:
         # Publish is one-sided in the reference (RDMA WRITE into the table,
         # scala/RdmaShuffleManager.scala:410-412) — no remote reply; problems
         # are only observable driver-side, so log rather than ack.
@@ -1580,10 +1670,13 @@ class DriverEndpoint:
         # sharded driver state: the fence CAS above is the driver's
         # authority — only surviving publishes are forwarded into the
         # owning shard host's replica (one directed positional write,
-        # the reference's table WRITE re-aimed at a shard host)
+        # the reference's table WRITE re-aimed at a shard host).
+        # ``forward_shard=False`` on the batch-convergence path: the
+        # record came FROM the owner, whose replica already holds it.
         with self._tables_lock:
-            shard_map = self._shard_maps.get(msg.shuffle_id)
-        if shard_map is not None:
+            shard_map_v = self._shard_maps.get(msg.shuffle_id)
+        if shard_map_v is not None and forward_shard:
+            shard_map = shard_map_v[0]
             members = self.membership.members()
             slot = shard_map.slot_of_map(msg.map_id)
             if slot < len(members) and members[slot] != TOMBSTONE:
@@ -1610,6 +1703,28 @@ class DriverEndpoint:
                 self._answer_waiter(conn, M.FetchTableResp(
                     req_id, count, table_bytes, epoch))
         return None
+
+    def _on_shard_batch(self, msg: "M.ShardBatchMsg") -> None:
+        """Batch convergence from a shard OWNER (shard_ownership mode):
+        replay each owner-applied write through the normal publish /
+        merged-publish path. The fence CAS and the directory's zombie
+        guard make the echo idempotent, which is exactly what keeps the
+        driver-visible table byte-identical to the unsharded path —
+        the owner accelerated the write, it never forked the state."""
+        self.shard_batches += 1
+        for map_id, fence, entry, lengths in msg.records:
+            self._on_publish(
+                M.PublishMsg(msg.shuffle_id, map_id, entry, fence=fence,
+                             lengths=lengths),
+                forward_shard=False)
+        for blob in msg.blobs:
+            try:
+                inner = M.MergedPublishMsg.from_payload(blob)
+            except (struct.error, ValueError, IndexError) as e:
+                log.warning("driver: undecodable merged blob in shard "
+                            "batch for shuffle %d: %s", msg.shuffle_id, e)
+                continue
+            self._on_merged_publish(inner)
 
     def _on_fetch_table(self, conn: Connection,
                         msg: M.FetchTableReq) -> Optional[RpcMsg]:
@@ -1812,6 +1927,32 @@ class ExecutorEndpoint:
         self.shard_store = ShardStore()
         self._shard_waiters: Dict[int, list] = {}
         self._shard_waiters_lock = threading.Lock()
+        # partitioned metadata OWNERSHIP (shuffle/shard_plane.py): with
+        # shard_ownership on, this executor may OWN map-ranges — run
+        # their fence CAS, stream their per-shard op log to a standby,
+        # and batch-converge applied writes into the driver table.
+        # shard_owner doubles as the mode flag (None = replica mode).
+        self.shard_owner = None
+        self.shard_standby = None
+        # pending owner->driver batches: (sid, shard) -> (gen, records,
+        # merged blobs); flushed at shard_batch_entries or by the
+        # flusher thread (bounded convergence lag)
+        self._shard_batches: Dict[Tuple[int, int], tuple] = {}
+        self._shard_batch_lock = threading.Lock()
+        self._shard_flusher: Optional[threading.Thread] = None
+        self._shard_flush_wake = threading.Event()
+        # publisher-side republish backstop: direct-to-owner publishes
+        # are remembered until the shuffle dies so a handoff can re-aim
+        # them (fence floors make re-sends idempotent). This is what
+        # turns "owner killed mid-publish" into a metadata re-send
+        # instead of a map re-execution.
+        self._republish: Dict[int, Dict[int, tuple]] = {}
+        self._republish_lock = threading.Lock()
+        if self.conf.shard_ownership and self.conf.metadata_shards > 0:
+            from sparkrdma_tpu.shuffle.shard_plane import (
+                ShardOwnerStore, ShardStandbyBuffer)
+            self.shard_owner = ShardOwnerStore()
+            self.shard_standby = ShardStandbyBuffer()
         # invalidation generation: a long-poll answered with a
         # PRE-invalidation table must not re-memoize after the
         # invalidation (stage recovery repaired the driver table; a stale
@@ -1935,6 +2076,7 @@ class ExecutorEndpoint:
         # analysis: unguarded-ok(set-once monotonic flag; ordering vs close_all documented above)
         self._stopping = True
         self._hb_wake.set()  # ends the heartbeat monitor, if started
+        self._shard_flush_wake.set()  # ends the shard-batch flusher
         if self._task_pool is not None:
             self._task_pool.shutdown(wait=False, cancel_futures=True)
         if self._serve_pool is not None:
@@ -2479,15 +2621,32 @@ class ExecutorEndpoint:
                 self.merge_store.note_registered(msg.shuffle_id)
             if self.pushed_store is not None:
                 self.pushed_store.note_registered(msg.shuffle_id)
-            self.location_plane.put_shard_map(
+            accepted = self.location_plane.put_shard_map(
                 msg.shuffle_id, ShardMap(msg.num_maps, msg.shard_slots),
                 msg.epoch)
+            if accepted and self.shard_owner is not None:
+                self._on_shard_assignment(msg.shuffle_id, msg.epoch)
             return None
         if isinstance(msg, M.ShardEntryMsg):
             self._on_shard_entry(msg)
             return None
         if isinstance(msg, M.FetchShardReq):
             return self._on_fetch_shard(conn, msg)
+        if isinstance(msg, M.ShardPublishMsg):
+            self._on_shard_publish(msg)
+            return None
+        if isinstance(msg, M.ShardMergedPublishMsg):
+            self._on_shard_merged_publish(msg)
+            return None
+        if isinstance(msg, M.ShardOpMsg):
+            if self.shard_standby is not None:
+                self.shard_standby.ingest(msg.shuffle_id, msg.shard,
+                                          msg.owner_gen, msg.seq,
+                                          msg.kind, msg.blob)
+            return None
+        if isinstance(msg, M.ShardHandoffMsg):
+            self._on_shard_handoff(msg)
+            return None
         if isinstance(msg, M.FetchOutputReq):
             return self._on_fetch_output(msg)
         if isinstance(msg, M.FetchOutputsReq):
@@ -2595,6 +2754,17 @@ class ExecutorEndpoint:
         if msg.epoch == M.EPOCH_DEAD:
             self.shard_store.drop(msg.shuffle_id)
             self._expire_shard_waiters(msg.shuffle_id)
+            if self.shard_owner is not None:
+                # owned ranges, buffered op streams, unconverged batches
+                # and the republish backstop all die with the shuffle
+                self.shard_owner.drop(msg.shuffle_id)
+                self.shard_standby.drop(msg.shuffle_id)
+                with self._shard_batch_lock:
+                    for k in [k for k in self._shard_batches
+                              if k[0] == msg.shuffle_id]:
+                        del self._shard_batches[k]
+                with self._republish_lock:
+                    self._republish.pop(msg.shuffle_id, None)
             if self.merge_store is not None:
                 # merged segments + overflow blobs die with the shuffle
                 self.merge_store.drop_shuffle(msg.shuffle_id)
@@ -2783,6 +2953,346 @@ class ExecutorEndpoint:
                         self._shard_waiters.pop(sid, None)
         for sid, (conn, req_id, lo, hi, _min_pub, _dl) in expired:
             self._answer_shard_waiter(sid, conn, req_id, lo, hi)
+
+    # -- partitioned metadata ownership (shuffle/shard_plane.py) ---------
+
+    def _my_slot(self) -> int:
+        """This executor's membership slot, or -1 pre-announce. The
+        announce always precedes any shard assignment on the same FIFO
+        driver channel, so a real owner resolves by the time an
+        assignment can name it; -1 callers degrade to the driver path."""
+        with self._members_lock:
+            for i, m in enumerate(self._members):
+                if m == self.manager_id:
+                    return i
+        return -1
+
+    def _on_shard_assignment(self, shuffle_id: int, gen: int) -> None:
+        """An accepted (generation-forward) shard assignment: adopt the
+        ranges this slot now owns, seal + flush the ones it no longer
+        does, and re-aim buffered publishes (the handoff backstop)."""
+        smap = self.location_plane.shard_map(shuffle_id)
+        me = self._my_slot()
+        if smap is None or me < 0:
+            return
+        owned_now = {sh for sh in range(smap.num_shards)
+                     if smap.shard_slots[sh] == me}
+        for sh in self.shard_owner.owned_shards(shuffle_id):
+            if sh not in owned_now and \
+                    (self.shard_owner.gen_of(shuffle_id, sh) or 0) < gen:
+                self.shard_owner.seal(shuffle_id, sh)
+        for sh in owned_now:
+            lo, hi = smap.range_of(sh)
+            self.shard_owner.adopt(shuffle_id, sh, lo, hi,
+                                   smap.num_maps, gen)
+        # flush + republish OFF the driver reader thread: both dial
+        # peers, and the reader must stay free to drain pushes
+        self._ensure_serve_pool().submit(self._flush_shard_batches,
+                                         shuffle_id)
+        with self._republish_lock:
+            buffered = bool(self._republish.get(shuffle_id))
+        if buffered:
+            self._ensure_serve_pool().submit(self._republish_shuffle,
+                                             shuffle_id)
+
+    def _on_shard_handoff(self, msg: "M.ShardHandoffMsg") -> None:
+        """Ownership of (shuffle, shard) moved. Outgoing owner (alive —
+        the drain case): seal NOW, later direct publishes bounce to the
+        driver. Incoming owner: replay the standby buffer under the new
+        generation — the records re-run the full owner apply (store +
+        serve replica + stream + batch), so nothing the dead owner had
+        logged is lost and the driver batch echo stays idempotent."""
+        if self.shard_owner is None:
+            return
+        sid, shard = msg.shuffle_id, msg.shard
+        me = self._my_slot()
+        if me < 0:
+            return
+        if msg.old_slot == me:
+            self.shard_owner.seal(sid, shard)
+            self._ensure_serve_pool().submit(self._flush_shard_batches,
+                                             sid)
+        if msg.new_slot == me and self.shard_standby is not None:
+            from sparkrdma_tpu.shuffle import ha
+            records = self.shard_standby.take(sid, shard)
+            for kind, blob in records:
+                if kind == ha.SHARD_OP_PUBLISH:
+                    map_id, fence, entry, lengths = \
+                        ha.unpack_shard_publish(blob)
+                    self._owner_publish(sid, map_id, entry, fence,
+                                        msg.owner_gen, lengths)
+                elif kind == ha.SHARD_OP_MERGED:
+                    self._owner_merged(sid, shard, msg.owner_gen, blob)
+
+    def _owner_publish(self, shuffle_id: int, map_id: int, entry: bytes,
+                       fence: int, gen: int, lengths=None) -> bool:
+        """Owner-side apply of one direct publish: fence CAS + log in
+        the owner store (log-before-apply), serve-replica apply + waiter
+        wake, op stream to the standby, batch toward the driver. False =
+        not applied here (caller forwards to the driver); a FENCED
+        zombie returns True — handled, deliberately not forwarded."""
+        from sparkrdma_tpu.shuffle import shard_plane
+        owner = self.shard_owner
+        if owner is None:
+            return False
+        shard = owner.shard_for(shuffle_id, map_id)
+        if shard is None:
+            return False
+        status, rec = owner.publish(shuffle_id, shard, map_id, entry,
+                                    fence, gen, lengths)
+        # analysis: epoch-eq-ok(FENCED is a write-path status code, not a version; exact match selects the handled-no-forward outcome)
+        if status == shard_plane.FENCED:
+            return True
+        if status != shard_plane.APPLIED:
+            return False
+        smap = self.location_plane.shard_map(shuffle_id)
+        num_maps = smap.num_maps if smap is not None else map_id + 1
+        epoch = self.location_plane.known_epoch(shuffle_id) or 1
+        self._on_shard_entry(M.ShardEntryMsg(shuffle_id, epoch, map_id,
+                                             num_maps, entry))
+        self._stream_shard_op(shuffle_id, shard, gen, rec)
+        self._queue_shard_batch(shuffle_id, shard, gen,
+                                record=(map_id, fence, entry, lengths))
+        return True
+
+    def _owner_merged(self, shuffle_id: int, shard: int, gen: int,
+                      blob: bytes) -> bool:
+        from sparkrdma_tpu.shuffle import shard_plane
+        owner = self.shard_owner
+        if owner is None:
+            return False
+        status, rec = owner.merged(shuffle_id, shard, gen, blob)
+        if status != shard_plane.APPLIED:
+            return False
+        self._stream_shard_op(shuffle_id, shard, gen, rec)
+        self._queue_shard_batch(shuffle_id, shard, gen, blob=blob)
+        return True
+
+    def _on_shard_publish(self, msg: "M.ShardPublishMsg") -> None:
+        """A direct-to-owner publish (the one-hop write path). Not
+        applicable here — stale map at the sender, sealed shard, a
+        handoff won the race — forwards to the driver: the stale view
+        costs one extra hop, never a lost entry."""
+        if self._owner_publish(msg.shuffle_id, msg.map_id, msg.entry,
+                               msg.fence, msg.owner_gen, msg.lengths):
+            return
+        try:
+            self.driver.send(M.PublishMsg(msg.shuffle_id, msg.map_id,
+                                          msg.entry, fence=msg.fence,
+                                          lengths=msg.lengths))
+        except TransportError as e:
+            log.debug("non-owner publish forward for shuffle %d map %d "
+                      "failed: %s", msg.shuffle_id, msg.map_id, e)
+
+    def _on_shard_merged_publish(self,
+                                 msg: "M.ShardMergedPublishMsg") -> None:
+        if self._owner_merged(msg.shuffle_id, msg.shard, msg.owner_gen,
+                              msg.blob):
+            return
+        try:
+            inner = M.MergedPublishMsg.from_payload(msg.blob)
+        except (struct.error, ValueError, IndexError) as e:
+            log.warning("undecodable merged blob routed at shuffle %d "
+                        "shard %d: %s", msg.shuffle_id, msg.shard, e)
+            return
+        try:
+            self.driver.send(inner)
+        except TransportError as e:
+            log.debug("non-owner merged forward for shuffle %d failed: "
+                      "%s", msg.shuffle_id, e)
+
+    def _shard_standby_peer(self, shuffle_id: int, shard: int):
+        """Deterministic standby for an owned shard: the NEXT shard's
+        owner slot (wrapping) — a distinct live host whenever the
+        assignment has more than one shard. None for single-shard maps
+        (the driver batch is the only backstop there, which is the
+        pre-ownership durability story)."""
+        smap = self.location_plane.shard_map(shuffle_id)
+        if smap is None or smap.num_shards < 2:
+            return None
+        slot = smap.shard_slots[(shard + 1) % smap.num_shards]
+        if slot == self._my_slot():
+            return None
+        try:
+            return self.member_at(slot)
+        except (DeadExecutorError, IndexError):
+            return None
+
+    def _stream_shard_op(self, shuffle_id: int, shard: int, gen: int,
+                         rec) -> None:
+        peer = self._shard_standby_peer(shuffle_id, shard)
+        if peer is None:
+            return
+        try:
+            conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+            conn.send(M.ShardOpMsg(shuffle_id, shard, gen, rec.seq,
+                                   rec.kind, rec.payload))
+        except TransportError as e:
+            # one-attempt like every push; the driver batch still
+            # converges, so a lost stream record degrades failover
+            # freshness, never correctness
+            log.debug("shard op stream for shuffle %d shard %d failed: "
+                      "%s", shuffle_id, shard, e)
+
+    def _queue_shard_batch(self, shuffle_id: int, shard: int, gen: int,
+                           record=None, blob=None) -> None:
+        """Stage one applied write for driver convergence; flush at
+        shard_batch_entries (the flusher thread drains partials)."""
+        out = []
+        with self._shard_batch_lock:
+            key = (shuffle_id, shard)
+            cur = self._shard_batches.get(key)
+            if cur is None or cur[0] != gen:
+                if cur is not None and (cur[1] or cur[2]):
+                    out.append(M.ShardBatchMsg(shuffle_id, shard, cur[0],
+                                               cur[1], cur[2]))
+                cur = (gen, [], [])
+                self._shard_batches[key] = cur
+            if record is not None:
+                cur[1].append(record)
+            if blob is not None:
+                cur[2].append(blob)
+            if len(cur[1]) + len(cur[2]) >= self.conf.shard_batch_entries:
+                out.append(M.ShardBatchMsg(shuffle_id, shard, gen,
+                                           cur[1], cur[2]))
+                del self._shard_batches[key]
+        for m in out:
+            try:
+                self.driver.send(m)
+            except TransportError as e:
+                log.warning("shard batch for shuffle %d failed: %s",
+                            shuffle_id, e)
+        self._ensure_shard_flusher()
+
+    def _flush_shard_batches(self,
+                             shuffle_id: Optional[int] = None) -> None:
+        with self._shard_batch_lock:
+            keys = [k for k in self._shard_batches
+                    if shuffle_id is None or k[0] == shuffle_id]
+            out = []
+            for k in keys:
+                gen, recs, blobs = self._shard_batches.pop(k)
+                if recs or blobs:
+                    out.append(M.ShardBatchMsg(k[0], k[1], gen, recs,
+                                               blobs))
+        for m in out:
+            try:
+                self.driver.send(m)
+            except TransportError as e:
+                log.warning("shard batch flush for shuffle %d failed: %s",
+                            m.shuffle_id, e)
+
+    def _ensure_shard_flusher(self) -> None:
+        if self._shard_flusher is not None or self._stopping:
+            return
+        with self._shard_batch_lock:
+            if self._shard_flusher is not None:
+                return
+            t = threading.Thread(
+                target=self._shard_flush_loop, daemon=True,
+                name=f"shard-flush-{self.manager_id.executor_id.executor}")
+            self._shard_flusher = t
+        t.start()
+
+    def _shard_flush_loop(self) -> None:
+        # partial-batch drain every 10ms: convergence lag toward the
+        # driver stays bounded even when publishes trickle in below the
+        # batch threshold
+        while not self._stopping:
+            self._shard_flush_wake.wait(timeout=0.01)
+            self._shard_flush_wake.clear()
+            if self._stopping:
+                return
+            self._flush_shard_batches()
+
+    def _send_owner_publish(self, shuffle_id: int, map_id: int,
+                            entry: bytes, fence: int, lengths) -> bool:
+        """Route a publish straight to its map-range OWNER — one hop,
+        no driver round-trip ("RPC Considered Harmful": the destination
+        is known ahead of time). Remembers the publish for handoff
+        republish first, so no window exists where a dying owner is the
+        only holder. False = caller sends the ordinary driver publish."""
+        if self.shard_owner is None:
+            return False
+        smap_v = self.location_plane.shard_map_v(shuffle_id)
+        if smap_v is None:
+            return False
+        smap, gen = smap_v
+        with self._republish_lock:
+            self._republish.setdefault(shuffle_id, {})[map_id] = (
+                entry, fence, list(lengths) if lengths is not None
+                else None)
+        try:
+            shard = smap.shard_of(map_id)
+        except IndexError:
+            return False
+        slot = smap.shard_slots[shard]
+        if slot == self._my_slot():
+            return self._owner_publish(shuffle_id, map_id, entry, fence,
+                                       gen, lengths)
+        try:
+            peer = self.member_at(slot)
+            conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+            conn.send(M.ShardPublishMsg(shuffle_id, map_id, entry,
+                                        fence, gen, lengths))
+            return True
+        except (DeadExecutorError, IndexError, TransportError) as e:
+            log.debug("direct publish for shuffle %d map %d fell back "
+                      "to the driver: %s", shuffle_id, map_id, e)
+            return False
+
+    def _republish_shuffle(self, shuffle_id: int) -> None:
+        """Handoff backstop: re-aim this publisher's remembered
+        publishes at the (new) owners. Fence floors make duplicates
+        no-ops; a publish that died in a killed owner's socket gets
+        re-delivered — a metadata re-send, never a map re-execution."""
+        with self._republish_lock:
+            buffered = dict(self._republish.get(shuffle_id, {}))
+        for map_id, (entry, fence, lengths) in buffered.items():
+            if self._send_owner_publish(shuffle_id, map_id, entry, fence,
+                                        lengths):
+                continue
+            try:
+                self.driver.send(M.PublishMsg(shuffle_id, map_id, entry,
+                                              fence=fence,
+                                              lengths=lengths))
+            except TransportError as e:
+                log.debug("republish of shuffle %d map %d failed: %s",
+                          shuffle_id, map_id, e)
+
+    def _send_owner_merged(self, msg: "M.MergedPublishMsg") -> bool:
+        """Route a merged-directory publish to the owner of shard
+        ``partition % num_shards`` (deterministic spread — merged
+        segments aren't map-range keyed, so any stable rule works).
+        False = caller sends it to the driver directly."""
+        if self.shard_owner is None:
+            return False
+        smap_v = self.location_plane.shard_map_v(msg.shuffle_id)
+        if smap_v is None:
+            return False
+        smap, gen = smap_v
+        shard = msg.partition_id % smap.num_shards
+        blob = msg.payload()
+        slot = smap.shard_slots[shard]
+        if slot == self._my_slot():
+            return self._owner_merged(msg.shuffle_id, shard, gen, blob)
+        try:
+            peer = self.member_at(slot)
+            conn = self._clients.get(peer.rpc_host, peer.rpc_port)
+            conn.send(M.ShardMergedPublishMsg(msg.shuffle_id, shard, gen,
+                                              blob))
+            return True
+        except (DeadExecutorError, IndexError, TransportError) as e:
+            log.debug("owner-routed merged publish for shuffle %d fell "
+                      "back to the driver: %s", msg.shuffle_id, e)
+            return False
+
+    def _publish_merged(self, msg: "M.MergedPublishMsg") -> None:
+        """The merge finalizer's publish callback: owner-routed in
+        ownership mode, driver-direct otherwise."""
+        if self._send_owner_merged(msg):
+            return
+        self.driver.send(msg)
 
     def _corrupt_served(self, shuffle_id: int, map_id: int,
                         detail: str) -> None:
@@ -3198,7 +3708,7 @@ class ExecutorEndpoint:
                 msg.shuffle_id,
                 self.exec_index(
                     timeout=self.conf.connect_timeout_ms / 1000),
-                publish=lambda m: self.driver.send(m),
+                publish=self._publish_merged,
                 tracer=self.tracer)
         except Exception:  # noqa: BLE001 — dedicated thread, must not
             # die silently; the shuffle just stays unfinalized here
@@ -3275,6 +3785,11 @@ class ExecutorEndpoint:
         entry = DriverTable.pack_entry(
             table_token,
             self.exec_index(timeout=self.conf.connect_timeout_ms / 1000))
+        if self._send_owner_publish(shuffle_id, map_id, entry, fence,
+                                    lengths):
+            # landed at (or on) the owning shard host — the owner's
+            # batch converges it into the driver table asynchronously
+            return
         msg = M.PublishMsg(shuffle_id, map_id, entry, fence=fence,
                            lengths=lengths)
         # retry envelope: a publish racing a failover lands on the new
@@ -3334,6 +3849,10 @@ class ExecutorEndpoint:
                 return table, epoch
             # fall through: shard host lost/lagging — the driver is
             # authoritative
+            if metrics is not None:
+                metrics.record_shard_fallback()
+            self.tracer.instant("meta.shard_fallback", "meta",
+                                shuffle=shuffle_id)
         while True:
             remaining = deadline - time.monotonic()
             if metrics is not None:

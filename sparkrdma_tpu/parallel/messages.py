@@ -1307,3 +1307,207 @@ class TakeoverMsg(RpcMsg):
         host, off = _unpack_str(payload, 4)
         (port,) = struct.unpack_from("<I", payload, off)
         return cls(incarnation, host, port)
+
+
+@register()
+class ShardPublishMsg(RpcMsg):
+    """Executor -> shard OWNER: direct positional table write for a map
+    in the owner's range (shard_ownership mode). Same body as
+    PublishMsg — 12-byte entry, attempt fence, optional per-partition
+    lengths — plus ``owner_gen``, the composed ownership generation
+    (driver incarnation in the high 32 bits, per-incarnation handoff
+    seq below) the sender believes holds the range. An owner that has
+    sealed the shard, moved to a newer generation, or never owned the
+    range forwards the publish to the driver instead of applying it,
+    so a stale sender costs one extra hop, never a lost entry."""
+
+    ENTRY_BYTES = 12
+
+    def __init__(self, shuffle_id: int, map_id: int, entry: bytes,
+                 fence: int = 0, owner_gen: int = 0, lengths=None):
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.entry = entry
+        self.fence = fence
+        self.owner_gen = owner_gen
+        self.lengths = list(lengths) if lengths is not None else None
+
+    def payload(self) -> bytes:
+        out = (struct.pack("<ii", self.shuffle_id, self.map_id)
+               + self.entry
+               + struct.pack("<qq", self.fence, self.owner_gen))
+        if self.lengths is not None:
+            out += struct.pack(f"<I{len(self.lengths)}I",
+                               len(self.lengths), *self.lengths)
+        return out
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ShardPublishMsg":
+        shuffle_id, map_id = struct.unpack_from("<ii", payload, 0)
+        entry = payload[8:8 + cls.ENTRY_BYTES]
+        off = 8 + cls.ENTRY_BYTES
+        fence, owner_gen = struct.unpack_from("<qq", payload, off)
+        off += 16
+        lengths = None
+        if len(payload) >= off + 4:
+            (n,) = struct.unpack_from("<I", payload, off)
+            if len(payload) >= off + 4 + 4 * n:
+                lengths = list(struct.unpack_from(f"<{n}I", payload,
+                                                  off + 4))
+        return cls(shuffle_id, map_id, entry, fence, owner_gen, lengths)
+
+
+@register()
+class ShardMergedPublishMsg(RpcMsg):
+    """Executor -> shard OWNER: a merged-directory publish routed to
+    the owner of shard ``partition % num_shards`` instead of the
+    driver. ``blob`` is the inner MergedPublishMsg payload verbatim —
+    the owner logs it opaquely and batch-forwards it, so the driver's
+    zombie/fence checks still run exactly once, on the same bytes."""
+
+    def __init__(self, shuffle_id: int, shard: int, owner_gen: int,
+                 blob: bytes):
+        self.shuffle_id = shuffle_id
+        self.shard = shard
+        self.owner_gen = owner_gen
+        self.blob = blob
+
+    def payload(self) -> bytes:
+        return struct.pack("<iiq", self.shuffle_id, self.shard,
+                           self.owner_gen) + self.blob
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ShardMergedPublishMsg":
+        shuffle_id, shard, owner_gen = struct.unpack_from(
+            "<iiq", payload, 0)
+        return cls(shuffle_id, shard, owner_gen, bytes(payload[16:]))
+
+
+@register()
+class ShardBatchMsg(RpcMsg):
+    """Shard owner -> driver: batch convergence of writes the owner
+    already applied and logged. ``records`` are
+    ``(map_id, fence, entry[, lengths])`` publishes (3-tuples
+    normalize to ``lengths=None``); ``blobs`` are opaque
+    MergedPublishMsg payloads. The driver replays each through its
+    normal publish path — the fence CAS makes the echo idempotent —
+    which is what keeps the driver table byte-identical to the
+    unsharded path."""
+
+    def __init__(self, shuffle_id: int, shard: int, owner_gen: int,
+                 records, blobs=None):
+        self.shuffle_id = shuffle_id
+        self.shard = shard
+        self.owner_gen = owner_gen
+        self.records = [
+            (r[0], r[1], bytes(r[2]),
+             list(r[3]) if len(r) > 3 and r[3] is not None else None)
+            for r in records
+        ]
+        self.blobs = [bytes(b) for b in (blobs or [])]
+
+    def payload(self) -> bytes:
+        out = [struct.pack("<iiqI", self.shuffle_id, self.shard,
+                           self.owner_gen, len(self.records))]
+        for map_id, fence, entry, lengths in self.records:
+            out.append(struct.pack("<iqI", map_id, fence, len(entry)))
+            out.append(entry)
+            if lengths is None:
+                out.append(struct.pack("<i", -1))
+            else:
+                out.append(struct.pack(f"<i{len(lengths)}I",
+                                       len(lengths), *lengths))
+        out.append(struct.pack("<I", len(self.blobs)))
+        for b in self.blobs:
+            out.append(struct.pack("<I", len(b)))
+            out.append(b)
+        return b"".join(out)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ShardBatchMsg":
+        shuffle_id, shard, owner_gen, nrec = struct.unpack_from(
+            "<iiqI", payload, 0)
+        off = 20
+        records = []
+        for _ in range(nrec):
+            map_id, fence, elen = struct.unpack_from("<iqI", payload,
+                                                     off)
+            off += 16
+            entry = bytes(payload[off:off + elen])
+            off += elen
+            (nlen,) = struct.unpack_from("<i", payload, off)
+            off += 4
+            lengths = None
+            if nlen >= 0:
+                lengths = list(struct.unpack_from(f"<{nlen}I", payload,
+                                                  off))
+                off += 4 * nlen
+            records.append((map_id, fence, entry, lengths))
+        (nblob,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        blobs = []
+        for _ in range(nblob):
+            (blen,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            blobs.append(bytes(payload[off:off + blen]))
+            off += blen
+        return cls(shuffle_id, shard, owner_gen, records, blobs)
+
+
+@register()
+class ShardOpMsg(RpcMsg):
+    """Shard owner -> its standby: one per-shard op-log record, stamped
+    ``(owner_gen, seq)`` — the sharded twin of OpLogAppendMsg, with
+    the ownership generation where the driver stream has its
+    incarnation. Forward-only on ``(owner_gen, seq)`` at the receiver,
+    so a sealed owner's stragglers cannot land behind a handoff."""
+
+    def __init__(self, shuffle_id: int, shard: int, owner_gen: int,
+                 seq: int, kind: int, blob: bytes):
+        self.shuffle_id = shuffle_id
+        self.shard = shard
+        self.owner_gen = owner_gen
+        self.seq = seq
+        self.kind = kind
+        self.blob = blob
+
+    def payload(self) -> bytes:
+        return struct.pack("<iiqQI", self.shuffle_id, self.shard,
+                           self.owner_gen, self.seq,
+                           self.kind) + self.blob
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ShardOpMsg":
+        shuffle_id, shard, owner_gen, seq, kind = struct.unpack_from(
+            "<iiqQI", payload, 0)
+        return cls(shuffle_id, shard, owner_gen, seq, kind,
+                   bytes(payload[28:]))
+
+
+@register()
+class ShardHandoffMsg(RpcMsg):
+    """Driver -> executors: ownership of ``(shuffle_id, shard)`` moved
+    to ``new_slot`` at generation ``owner_gen``. The outgoing owner (if
+    alive — the drain case) seals its log segment and flushes; the
+    incoming owner replays its standby buffer for the shard; everyone
+    else re-aims buffered republishes. Rides the announce channel right
+    behind the refreshed ShardMapMsg, so FIFO ordering gives the new
+    owner its assignment before the replay trigger."""
+
+    def __init__(self, shuffle_id: int, shard: int, owner_gen: int,
+                 new_slot: int, old_slot: int):
+        self.shuffle_id = shuffle_id
+        self.shard = shard
+        self.owner_gen = owner_gen
+        self.new_slot = new_slot
+        self.old_slot = old_slot
+
+    def payload(self) -> bytes:
+        return struct.pack("<iiqii", self.shuffle_id, self.shard,
+                           self.owner_gen, self.new_slot, self.old_slot)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "ShardHandoffMsg":
+        shuffle_id, shard, owner_gen, new_slot, old_slot = \
+            struct.unpack_from("<iiqii", payload, 0)
+        return cls(shuffle_id, shard, owner_gen, new_slot, old_slot)

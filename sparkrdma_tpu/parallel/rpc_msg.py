@@ -82,6 +82,14 @@ WIRE_IDS: Dict[str, int] = {
     "SnapshotMsg": 43,
     "StandbyHelloMsg": 44,
     "TakeoverMsg": 45,
+    # partitioned metadata ownership (shuffle/shard_plane.py): the
+    # direct-to-owner write path, the owner->driver convergence batch,
+    # the per-shard op-log stream, and the handoff announcement
+    "ShardPublishMsg": 46,
+    "ShardMergedPublishMsg": 47,
+    "ShardBatchMsg": 48,
+    "ShardOpMsg": 49,
+    "ShardHandoffMsg": 50,
 }
 
 # Ids deliberately absent from the dense 1..max range, with the reason

@@ -178,6 +178,11 @@ class ReadMetrics:
     # as pushed here (the pushplan bench and the zero-RPC test assert it).
     pushed_reads: int = 0
     pushed_bytes: int = 0
+    # cold table syncs whose shard phase came up short (owner/replica
+    # lost or lagging) and burned the driver-authoritative fallback —
+    # the partitioned-ownership health signal: sustained nonzero here
+    # means the shard fan-in is not actually absorbing reads
+    shard_fallbacks: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_remote(self, nbytes: int, latency_s: float) -> None:
@@ -216,6 +221,10 @@ class ReadMetrics:
         with self._lock:
             self.pushed_reads += 1
             self.pushed_bytes += nbytes
+
+    def record_shard_fallback(self) -> None:
+        with self._lock:
+            self.shard_fallbacks += 1
 
     def record_retry(self) -> None:
         with self._lock:

@@ -285,9 +285,18 @@ OP_DRAIN = 6       # <ii> slot, step (0 begin / 1 abort / 2 retire)
 OP_PLAN = 7        # ReducePlan.to_bytes() (install + push)
 OP_FINALIZE = 8    # <i> shuffle_id
 
+# Per-SHARD op kinds (shard_ownership mode, shuffle/shard_plane.py):
+# each shard owner streams its own OpLog — keyed (shard, owner_gen,
+# seq), with the ownership generation standing in for the driver
+# incarnation — to its standby. Distinct namespace from OP_* above:
+# these records never enter the driver's replicated log.
+SHARD_OP_PUBLISH = 1  # pack_shard_publish payload
+SHARD_OP_MERGED = 2   # opaque MergedPublishMsg payload
+
 _OP_REGISTER_S = struct.Struct("<iiiid")
 _OP_SID_S = struct.Struct("<i")
 _OP_DRAIN_S = struct.Struct("<ii")
+_SHARD_PUB_S = struct.Struct("<iq")  # map_id, fence (then entry + lengths)
 _REC_HEAD = struct.Struct("<IQI")  # incarnation, seq, kind
 
 DRAIN_BEGIN, DRAIN_ABORT, DRAIN_RETIRE = 0, 1, 2
@@ -447,6 +456,28 @@ def op_drain(slot: int, step: int) -> bytes:
 
 def unpack_drain(payload: bytes) -> Tuple[int, int]:
     return _OP_DRAIN_S.unpack_from(payload, 0)
+
+
+def pack_shard_publish(map_id: int, fence: int, entry: bytes,
+                       lengths=None) -> bytes:
+    """SHARD_OP_PUBLISH payload: one applied positional write, with the
+    optional per-partition lengths the driver-side histogram wants."""
+    out = _SHARD_PUB_S.pack(map_id, fence) + entry
+    if lengths is None:
+        out += struct.pack("<i", -1)
+    else:
+        out += struct.pack(f"<i{len(lengths)}I", len(lengths), *lengths)
+    return out
+
+
+def unpack_shard_publish(payload: bytes):
+    map_id, fence = _SHARD_PUB_S.unpack_from(payload, 0)
+    entry = bytes(payload[12:24])
+    (nlen,) = struct.unpack_from("<i", payload, 24)
+    lengths = None
+    if nlen >= 0:
+        lengths = list(struct.unpack_from(f"<{nlen}I", payload, 28))
+    return map_id, fence, entry, lengths
 
 
 # -- standby ----------------------------------------------------------------

@@ -106,15 +106,33 @@ class ShardMap:
         return self.shard_slots[self.shard_of(map_id)]
 
     @staticmethod
-    def assign(num_maps: int, live_slots: List[int],
-               max_shards: int) -> Optional["ShardMap"]:
+    def assign(num_maps: int, membership, max_shards: int,
+               avoid=()) -> Optional["ShardMap"]:
         """The driver's assignment policy: up to ``max_shards`` shards
         over the live executor slots, round-robin; None when sharding is
-        off (``max_shards`` < 1) or there is nobody to host."""
-        if max_shards < 1 or not live_slots or num_maps <= 0:
+        off (``max_shards`` < 1) or there is nobody to host.
+
+        ``membership`` is the driver's MembershipPlane (anything with a
+        ``live_slots()`` method) — consulted directly so a DRAINING slot
+        is never assigned as a shard owner: its writes are being walked
+        off the host, handing it a fence-CAS range would re-pin it. A
+        raw slot list is still accepted (tests, the model checker), in
+        which case the caller vouches for liveness. ``avoid`` excludes
+        slots mid-removal: membership tombstoning and shard handoff are
+        not atomic, so reassignment must not re-pick the slot whose
+        death triggered it."""
+        if max_shards < 1 or num_maps <= 0:
             return None
-        n = min(max_shards, len(live_slots), num_maps)
-        return ShardMap(num_maps, [live_slots[i % len(live_slots)]
+        if hasattr(membership, "live_slots"):
+            slots = list(membership.live_slots())  # excludes DRAINING
+        else:
+            slots = list(membership)
+        if avoid:
+            slots = [s for s in slots if s not in set(avoid)]
+        if not slots:
+            return None
+        n = min(max_shards, len(slots), num_maps)
+        return ShardMap(num_maps, [slots[i % len(slots)]
                                    for i in range(n)])
 
 
@@ -455,14 +473,29 @@ class LocationPlane:
     # -- shard map --------------------------------------------------------
 
     def put_shard_map(self, shuffle_id: int, shard_map: ShardMap,
-                      epoch: int) -> None:
+                      epoch: int) -> bool:
+        """Cache a pushed shard assignment; highest generation wins
+        (``epoch`` carries the composed ownership generation in
+        shard_ownership mode, a constant 1 in replica mode — either
+        way a reordered stale push must not roll a handoff back).
+        Returns True when the assignment was accepted."""
         with self._lock:
+            prev = self._shard_maps.get(shuffle_id)
+            if prev is not None and epoch < prev[1]:
+                return False
             self._shard_maps[shuffle_id] = (shard_map, epoch)
+            return True
 
     def shard_map(self, shuffle_id: int) -> Optional[ShardMap]:
         with self._lock:
             cached = self._shard_maps.get(shuffle_id)
             return cached[0] if cached is not None else None
+
+    def shard_map_v(self, shuffle_id: int):
+        """(shard_map, generation) — the ownership write path needs the
+        generation to stamp direct publishes."""
+        with self._lock:
+            return self._shard_maps.get(shuffle_id)
 
     # -- reduce plan ------------------------------------------------------
 

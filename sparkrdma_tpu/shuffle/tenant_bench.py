@@ -229,9 +229,18 @@ def run_sustained_bench(spill_root: str,
                         rows_per_map: int = 512,
                         num_maps: int = 2,
                         max_outstanding: int = 4,
+                        metadata_shards: int = 2,
+                        shard_ownership: bool = True,
                         seed: int = 0) -> Dict:
     """N tenants submit terasort/pagerank/join jobs at ``arrival_hz``
     each through the admission-controlled driver for ``duration_s``.
+
+    Registrations flow through the SHARDED control plane by default
+    (``metadata_shards``/``shard_ownership``): every register assigns a
+    shard map and every publish takes the direct-to-owner path, so this
+    bench doubles as the sustained-traffic soak for partitioned
+    metadata ownership (``shard_batches`` in the result records the
+    owner->driver convergence actually happening).
 
     Returns aggregate rows/s, per-tenant p99 job latency, admission
     accounting, and the zero-cross-tenant-eviction gate."""
@@ -239,7 +248,9 @@ def run_sustained_bench(spill_root: str,
                    pre_warm_connections=True,
                    admission_max_inflight=2, admission_queue_depth=1,
                    admission_retry_after_ms=200,
-                   warm_read_cache=True, dist_cache_budget="64k")
+                   warm_read_cache=True, dist_cache_budget="64k",
+                   metadata_shards=metadata_shards,
+                   shard_ownership=shard_ownership)
     driver = TpuShuffleManager(TpuShuffleConf(**conf_kw), is_driver=True)
     execs = [TpuShuffleManager(
         TpuShuffleConf(**conf_kw), driver_addr=driver.driver_addr,
@@ -373,6 +384,9 @@ def run_sustained_bench(spill_root: str,
             "wall_s": round(wall_s, 2),
             "tenants": tenants,
             "arrival_hz": arrival_hz,
+            "metadata_shards": metadata_shards,
+            "shard_batches": driver.driver.shard_batches,
+            "shard_handoffs": driver.driver.shard_handoffs,
         }
     finally:
         for ex in execs:

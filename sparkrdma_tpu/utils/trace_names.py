@@ -63,6 +63,8 @@ INSTANTS = frozenset({
     "member.retire",
     "merge.finalize",
     "meta.epoch_bump",
+    "meta.shard_fallback",
+    "meta.shard_handoff",
     "peer.suspect",
     "push.drop",
     "push.planned_native",

@@ -72,16 +72,16 @@ def test_wire_registry_is_dense_and_unique():
 
 
 def test_wire_density_over_full_membership_range():
-    """Msgs 42-45 (driver-HA op-log/snapshot/takeover frames) closed
-    the id space at 45: the registry + reservations must tile 1..45
-    exactly, and every membership message must carry _EXTRA_CASES
-    domain corners (epoch 0, max-i64, DRAINING-only vectors) so the
-    fuzzer exercises the signed boundaries the name-based generator
-    avoids."""
+    """Msgs 46-50 (partitioned-ownership publish/batch/op-log/handoff
+    frames) closed the id space at 50: the registry + reservations
+    must tile 1..50 exactly, and every membership message must carry
+    _EXTRA_CASES domain corners (epoch 0, max-i64, DRAINING-only
+    vectors) so the fuzzer exercises the signed boundaries the
+    name-based generator avoids."""
     ids = [t for t, _ in wire.live_pairs()]
-    assert max(ids) == 45
+    assert max(ids) == 50
     assert set(ids) | set(wire.rpc_msg.RESERVED_WIRE_IDS) == set(
-        range(1, 46))
+        range(1, 51))
     for name in ("JoinMsg", "MembershipBumpMsg", "DrainReq", "DrainResp"):
         assert name in wire._EXTRA_CASES, name
     corners = [c() for c in wire._EXTRA_CASES["MembershipBumpMsg"]]
@@ -311,7 +311,8 @@ def test_modelcheck_catalog_clean_and_enumerates_500():
         "pub_tomb_bump", "fence_loser", "finalize_vs_push",
         "drain_vs_kill", "ttl_vs_late_fetch",
         "driver_failover_mid_publish", "split_brain_two_leases",
-        "zombie_primary_publish", "failover_vs_ttl_sweep"}
+        "zombie_primary_publish", "failover_vs_ttl_sweep",
+        "handoff_vs_publish", "handoff_vs_driver_failover"}
 
 
 def test_modelcheck_driver_death_scenarios_enumerate_500():

@@ -118,6 +118,15 @@ DRIVER = os.environ.get("CHAOS_DRIVER", "0") not in ("0", "false")
 # to the Python dataplane where it isn't built).
 NATIVE_FETCH = os.environ.get("CHAOS_NATIVE_FETCH",
                               "0") not in ("0", "false")
+# partitioned metadata ownership under chaos: 1 runs the whole matrix
+# with metadata_shards=2 + shard_ownership=True — executors publish
+# map outputs DIRECTLY to per-shard write owners (fence CAS on the
+# owner, batch convergence into the driver, per-shard standby streams)
+# so every injected fault also crosses the sharded control-plane write
+# path and its driver-direct fallback; run_chaos.sh sweeps both. The
+# dedicated kill-a-shard-owner scenario below runs whenever sharding
+# is on and asserts the per-shard failover costs ZERO re-executions.
+SHARD = os.environ.get("CHAOS_SHARD", "0") not in ("0", "false")
 # CHAOS_LOCKGRAPH=1: run every scenario under the lock-order shim
 # (sparkrdma_tpu/analysis/lockgraph.py) so the chaos matrix doubles as
 # race detection — faults drive the rare teardown/retry/suspect paths
@@ -166,6 +175,12 @@ def _conf(**kw):
         # no-primary window instead of surfacing it
         base.update(ha_standbys=1, driver_lease_ms=900,
                     request_deadline_ms=20_000)
+    if SHARD:
+        # the partitioned-ownership sweep dimension: two write owners,
+        # a small batch so convergence happens repeatedly inside every
+        # scenario's publish window
+        base.update(metadata_shards=2, shard_ownership=True,
+                    shard_batch_entries=4)
     base.update(kw)
     return TpuShuffleConf(**base)
 
@@ -1693,3 +1708,92 @@ def test_chaos_driver_sigkill_failover_zero_reexecutions(tmp_path):
             ex.stop()
         if standby is not None:
             standby.stop()
+
+
+# -- partitioned metadata ownership: the shard-owner kill acceptance ------
+#
+# A shard OWNER is metadata-only infrastructure: killing it mid-stage
+# must cost a per-shard handoff (standby log replay + republish
+# backstop), never a map re-execution. The victim here owns shard 0's
+# fence CAS but holds ZERO map outputs (placement pins the data on the
+# other executors), so any re-execution in this scenario would be the
+# control plane LOSING a publish — exactly the bug class the handoff
+# protocol exists to rule out.
+
+
+def test_chaos_shard_owner_kill_mid_publish_zero_reexecutions(tmp_path):
+    """Kill the owner of shard 0 while the map stage's publishes are
+    streaming at it (a seeded point after its first applied write). The
+    stragglers bounce to the driver-direct path, the driver hands the
+    shard to a successor, and the reduce completes byte-identical with
+    ZERO map re-executions — the driver table never lost a publish."""
+    driver, execs = _cluster(tmp_path, n=4, metadata_shards=2,
+                             shard_ownership=True,
+                             shard_batch_entries=64,  # unconverged tail
+                             push_merge=False)
+    map_runs = []
+    killer = None
+    done = threading.Event()
+    try:
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec("modulo"))
+        smap = None
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and smap is None:
+            smv = execs[0].executor.location_plane.shard_map_v(1)
+            smap = smv[0] if smv is not None else None
+            time.sleep(0.02)
+        assert smap is not None, f"seed={SEED}: no shard map pushed"
+        victim_slot = smap.shard_slots[0]
+        victim_idx = next(i for i, ex in enumerate(execs)
+                          if ex.executor.exec_index() == victim_slot)
+        survivors = [i for i in range(len(execs)) if i != victim_idx]
+
+        def kill_on_first_applied():
+            victim_ep = execs[victim_idx].executor
+            while (victim_ep.shard_owner.applied == 0
+                   and not done.wait(0.002)):
+                pass
+            if done.is_set():
+                return
+            victim_ep.stop()  # abrupt: applied writes left unconverged
+            driver.driver.remove_member(victim_ep.manager_id)
+
+        killer = threading.Thread(target=kill_on_first_applied)
+        killer.start()
+        # the victim hosts METADATA only: every map output lives on the
+        # survivors, so the owner kill can never justify a recompute
+        run_map_stage(execs, handle, _map_fn,
+                      placement={m: survivors[m % len(survivors)]
+                                 for m in range(6)})
+        killer.join(timeout=10)
+        assert not killer.is_alive(), f"seed={SEED}: killer hung"
+        assert execs[victim_idx].executor.shard_owner.applied > 0, \
+            f"seed={SEED}: the victim never owned a publish"
+        deadline = time.monotonic() + 8
+        while (time.monotonic() < deadline
+               and driver.driver.shard_handoffs == 0):
+            time.sleep(0.05)
+        assert driver.driver.shard_handoffs >= 1, f"seed={SEED}"
+
+        def counting_map_fn(writer, map_id):
+            map_runs.append(map_id)
+            _map_fn(writer, map_id)
+
+        live = [execs[i] for i in survivors]
+        got = run_reduce_with_retry(live, handle, counting_map_fn,
+                                    _reduce_fn, reducer_index=0,
+                                    max_stage_retries=3, driver=driver)
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        assert map_runs == [], \
+            (f"seed={SEED}: shard-owner death re-executed maps "
+             f"{map_runs} — a publish was lost in the handoff")
+        smv2 = execs[survivors[0]].executor.location_plane.shard_map_v(1)
+        assert smv2 is not None and victim_slot not in smv2[0].shard_slots, \
+            f"seed={SEED}: the dead owner still holds a shard"
+    finally:
+        done.set()
+        if killer is not None:
+            killer.join(timeout=10)
+        _shutdown(driver, execs)

@@ -1,0 +1,296 @@
+"""Partitioned metadata ownership (ROADMAP item 3): shard owners run
+the control-plane write path.
+
+Four layers, cheapest first:
+
+* ``ShardOwnerStore`` / ``ShardStandbyBuffer`` unit semantics — the
+  fence CAS, seal-then-replay handoff, forward-only generations.
+* ``ShardMap.assign`` membership policy — a DRAINING slot is never
+  handed a write-owner range.
+* The control-plane scale-out gate — ``run_ctrl_microbench`` must show
+  >= 1.5x publish throughput at 4 owners AND byte-identical resulting
+  driver state (the ISSUE acceptance bar; measured headroom is ~4x).
+* Live endpoints — publishes converge through owner batches, and
+  killing an owner mid-stage fails over via the standby log with ZERO
+  map re-executions (the driver table completes with the ORIGINAL
+  tokens).
+"""
+
+import time
+
+from sparkrdma_tpu.config import TpuShuffleConf
+from sparkrdma_tpu.parallel.membership import MembershipPlane
+from sparkrdma_tpu.shuffle import ha, shard_plane
+from sparkrdma_tpu.shuffle.ctrl_bench import run_ctrl_microbench
+from sparkrdma_tpu.shuffle.location_plane import ShardMap
+from sparkrdma_tpu.shuffle.shard_plane import (APPLIED, FENCED, NOT_OWNER,
+                                               SEALED, STALE_GEN,
+                                               ShardOwnerStore,
+                                               ShardStandbyBuffer)
+from sparkrdma_tpu.utils.ids import ExecutorId, ShuffleManagerId
+
+SID = 7
+
+
+def _entry(token, exec_index=0):
+    return shard_plane._ENTRY.pack(token, exec_index)
+
+
+# ------------------------------------------------ owner store semantics
+
+def test_owner_fence_cas_matches_driver_table():
+    """The owner-side CAS is DriverTable.publish's: older fence for the
+    same (map, exec) bounces, equal re-applies, per-exec floors are
+    independent (the fence_loser shape)."""
+    store = ShardOwnerStore()
+    gen = ha.compose_epoch(0, 1)
+    assert store.adopt(SID, 0, 0, 4, 4, gen)
+    st, rec = store.publish(SID, 0, 1, _entry(100, 0), 2, gen)
+    assert st == APPLIED and rec is not None
+    # zombie: older fence, same exec
+    st, _ = store.publish(SID, 0, 1, _entry(99, 0), 1, gen)
+    assert st == FENCED
+    # equal fence re-applies (at-least-once delivery)
+    st, _ = store.publish(SID, 0, 1, _entry(100, 0), 2, gen)
+    assert st == APPLIED
+    # another exec's fence floor is independent
+    st, _ = store.publish(SID, 0, 1, _entry(200, 1), 1, gen)
+    assert st == APPLIED
+    assert store.entries_of(SID, 0)[1] == _entry(200, 1)
+    assert store.fenced == 1 and store.applied == 3
+
+
+def test_owner_rejects_out_of_range_stale_gen_and_unowned():
+    store = ShardOwnerStore()
+    gen = ha.compose_epoch(0, 2)
+    store.adopt(SID, 1, 4, 8, 16, gen)
+    assert store.publish(SID, 1, 2, _entry(1), 1, gen)[0] == NOT_OWNER
+    assert store.publish(SID, 0, 1, _entry(1), 1, gen)[0] == NOT_OWNER
+    stale = ha.compose_epoch(0, 1)
+    assert store.publish(SID, 1, 5, _entry(1), 1, stale)[0] == STALE_GEN
+    assert store.rejected_stale == 1
+
+
+def test_seal_then_replay_handoff_preserves_entries():
+    """Seal-then-replay: the sealed owner bounces everything; the
+    successor adopts at a newer generation, replays the sealed segment,
+    and the entries survive under the new gen's log stamp."""
+    old = ShardOwnerStore()
+    gen1, gen2 = ha.compose_epoch(0, 1), ha.compose_epoch(0, 2)
+    old.adopt(SID, 0, 0, 4, 4, gen1)
+    old.publish(SID, 0, 0, _entry(500), 1, gen1)
+    old.merged(SID, 0, gen1, b"merged-blob")
+    segment = old.seal(SID, 0)
+    assert [r.kind for r in segment] == [ha.SHARD_OP_PUBLISH,
+                                         ha.SHARD_OP_MERGED]
+    assert old.publish(SID, 0, 1, _entry(501), 1, gen1)[0] == SEALED
+    assert not old.owns(SID, 0)
+
+    new = ShardOwnerStore()
+    assert new.adopt(SID, 0, 0, 4, 4, gen2,
+                     replay=[(r.kind, r.payload) for r in segment])
+    assert new.entries_of(SID, 0) == {0: _entry(500)}
+    assert new.merged_of(SID, 0) == [b"merged-blob"]
+    assert new.owns(SID, 0)
+    # fence floors replayed too: the original fence still wins
+    assert new.publish(SID, 0, 0, _entry(499), 0, gen2)[0] == FENCED
+
+
+def test_adopt_is_forward_only():
+    """A late replay of an OLD assignment must not resurrect a sealed
+    shard — adoption at a generation <= the held one is a no-op."""
+    store = ShardOwnerStore()
+    gen1, gen2 = ha.compose_epoch(0, 1), ha.compose_epoch(0, 2)
+    assert store.adopt(SID, 0, 0, 4, 4, gen2)
+    assert not store.adopt(SID, 0, 0, 4, 4, gen1)
+    assert not store.adopt(SID, 0, 0, 4, 4, gen2)
+    assert store.gen_of(SID, 0) == gen2
+    # a post-failover driver's composed gen dominates every
+    # pre-failover one regardless of its seq half
+    promoted = ha.compose_epoch(1, 1)
+    assert promoted > gen2
+    assert store.adopt(SID, 0, 0, 4, 4, promoted)
+
+
+def test_standby_buffer_forward_only_and_take():
+    sb = ShardStandbyBuffer()
+    gen = ha.compose_epoch(0, 1)
+    assert sb.ingest(SID, 0, gen, 1, ha.SHARD_OP_PUBLISH, b"a")
+    assert sb.ingest(SID, 0, gen, 2, ha.SHARD_OP_MERGED, b"b")
+    # duplicate / reordered stream entries are zombie-fenced
+    assert not sb.ingest(SID, 0, gen, 2, ha.SHARD_OP_PUBLISH, b"dup")
+    assert not sb.ingest(SID, 0, gen, 1, ha.SHARD_OP_PUBLISH, b"old")
+    assert sb.dropped_stale == 2
+    assert sb.last(SID, 0) == (gen, 2)
+    assert sb.take(SID, 0) == [(ha.SHARD_OP_PUBLISH, b"a"),
+                               (ha.SHARD_OP_MERGED, b"b")]
+    assert sb.take(SID, 0) == []  # drained
+
+
+# ------------------------------------------------ assignment policy
+
+def _plane(n):
+    plane = MembershipPlane(tombstone=ShuffleManagerId(
+        ExecutorId("", "", 0), "", 0, 0))
+    for i in range(n):
+        plane.join(ShuffleManagerId(ExecutorId(str(i), "h", 0), "h",
+                                    9000 + i, 0))
+    return plane
+
+
+def test_assign_never_picks_draining_slot():
+    """The satellite: ``ShardMap.assign`` consults the membership plane
+    directly, so a DRAINING slot — whose writes are being walked off the
+    host — is never assigned as a write owner."""
+    plane = _plane(4)
+    assert plane.begin_drain(1) is not None
+    smap = ShardMap.assign(num_maps=64, membership=plane, max_shards=4)
+    assert smap is not None
+    assert 1 not in smap.shard_slots
+    assert set(smap.shard_slots) <= {0, 2, 3}
+    # avoid= excludes the slot whose death triggered reassignment
+    smap = ShardMap.assign(num_maps=64, membership=plane, max_shards=4,
+                           avoid=(0,))
+    assert set(smap.shard_slots) == {2, 3}
+    # everyone draining/avoided -> sharding off, not a crash
+    plane.begin_drain(0)
+    plane.begin_drain(2)
+    plane.begin_drain(3)
+    assert ShardMap.assign(64, plane, 4) is None
+    # raw slot lists still accepted (model checker / bench callers)
+    assert ShardMap.assign(64, [0, 1], 2).shard_slots == [0, 1]
+
+
+# ------------------------------------------------ the scale-out gate
+
+def test_ctrl_plane_scaleout_gate():
+    """ISSUE acceptance: >= 1.5x publish throughput at 4 owners vs the
+    driver-serialized baseline, and the two modes' driver state is
+    byte-identical (table bytes, fence floors, merged directory, and
+    the SAME zombie publishes fenced). Best-of-2 rounds: the sleep-cost
+    model is noisy on loaded CI hosts; the identity check must hold on
+    EVERY round."""
+    best = 0.0
+    for _ in range(2):
+        res = run_ctrl_microbench(shards=4, num_maps=512,
+                                  op_cost_s=100e-6, batch_entries=16,
+                                  registrations=8)
+        assert res["identical"], "sharded driver state diverged"
+        assert res["fenced"] > 0, "work script exercised no zombies"
+        assert res["registrations_per_s"] > 0
+        best = max(best, res["speedup"])
+        if best >= 1.5:
+            break
+    assert best >= 1.5, f"control-plane scale-out only {best:.2f}x"
+
+
+# ------------------------------------------------ live endpoints
+
+def _cluster(n, **conf_kw):
+    from sparkrdma_tpu.parallel.endpoints import (DriverEndpoint,
+                                                  ExecutorEndpoint)
+    conf = TpuShuffleConf(connect_timeout_ms=5000,
+                          max_connection_attempts=2,
+                          metadata_shards=2, shard_ownership=True,
+                          **conf_kw)
+    driver = DriverEndpoint(conf)
+    execs = [ExecutorEndpoint("127.0.0.1", str(i), driver.address,
+                              conf=conf) for i in range(n)]
+    for ex in execs:
+        ex.start()
+    for ex in execs:
+        ex.wait_for_members(n)
+    return driver, execs
+
+
+def _stop_all(driver, execs):
+    for ex in execs:
+        ex.stop()
+    driver.stop()
+
+
+def test_endpoint_publishes_converge_through_owners():
+    """End-to-end: publishes land at shard owners (one hop), converge
+    into the driver table via owner batches, and stream to standbys."""
+    driver, execs = _cluster(3, shard_batch_entries=2)
+    try:
+        driver.register_shuffle(SID, num_maps=6)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(ex.location_plane.shard_map_v(SID) is not None
+                   for ex in execs):
+                break
+            time.sleep(0.05)
+        smap, gen = execs[0].location_plane.shard_map_v(SID)
+        assert smap.num_shards == 2 and gen > 0
+
+        for m in range(6):
+            execs[m % 3].publish_map_output(SID, m, table_token=1000 + m,
+                                            fence=1)
+        table = execs[0].get_driver_table(SID, expect_published=6,
+                                          timeout=8)
+        for m in range(6):
+            token, _ = table.entry(m)
+            assert token == 1000 + m
+        assert driver.shard_batches > 0, \
+            "publishes went driver-direct — owners never converged a batch"
+        owned = [ex.shard_owner.owned_shards(SID) for ex in execs]
+        assert sorted(s for shards in owned for s in shards) == [0, 1]
+        assert sum(ex.shard_owner.applied for ex in execs) >= 6
+        assert sum(ex.shard_standby.ingested for ex in execs) > 0, \
+            "no op records streamed to any standby"
+    finally:
+        _stop_all(driver, execs)
+
+
+def test_owner_death_fails_over_without_map_reexecution():
+    """THE handoff acceptance: kill the owner of shard 0 mid-stage with
+    unconverged applied publishes. Failover must be per-shard (standby
+    log + republish backstop) and the driver table must complete with
+    the ORIGINAL tokens — zero map re-executions."""
+    # big batch: the victim is holding applied-but-unconverged writes
+    driver, execs = _cluster(4, shard_batch_entries=64)
+    try:
+        driver.register_shuffle(SID, num_maps=8)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if all(ex.location_plane.shard_map_v(SID) is not None
+                   for ex in execs):
+                break
+            time.sleep(0.05)
+        smap, _gen = execs[0].location_plane.shard_map_v(SID)
+        victim_slot = smap.shard_slots[0]
+        victim = execs[victim_slot]
+        others = [e for i, e in enumerate(execs) if i != victim_slot]
+
+        for m in range(8):
+            others[m % len(others)].publish_map_output(
+                SID, m, table_token=1000 + m, fence=1)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and victim.shard_owner.applied == 0:
+            time.sleep(0.05)
+        assert victim.shard_owner.applied > 0, \
+            "victim never owned any publish — handoff would prove nothing"
+
+        victim.stop()  # abrupt: no batch flush, no goodbye
+        driver.remove_member(victim.manager_id)
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and driver.shard_handoffs == 0:
+            time.sleep(0.05)
+        assert driver.shard_handoffs >= 1
+
+        table = others[0].get_driver_table(SID, expect_published=8,
+                                           timeout=10)
+        for m in range(8):
+            token, _ = table.entry(m)
+            assert token == 1000 + m, \
+                f"map {m} token {token}: output lost -> re-execution"
+        smap2, gen2 = others[0].location_plane.shard_map_v(SID)
+        assert victim_slot not in smap2.shard_slots
+    finally:
+        for ex in execs:
+            try:
+                ex.stop()  # idempotent for the already-stopped victim
+            except Exception:
+                pass
+        driver.stop()
