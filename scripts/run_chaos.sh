@@ -85,6 +85,17 @@
 #                               crosses the engine's fallback-to-Python
 #                               envelope; degrades to Python where the
 #                               .so isn't built)
+#   CHAOS_COLD_MODES="0 1"      cold-tier modes to sweep (default both:
+#                               off, and CHAOS_COLD=1 so the whole
+#                               matrix runs with the disaggregated
+#                               cold tier active — push_merge forced
+#                               on, finalized segments tiering to a
+#                               blob store in the background, so
+#                               uploads, one-sided publishes, and
+#                               tombstone reaps cross every injected
+#                               fault — plus the dedicated
+#                               full-fleet-loss-restore and
+#                               store-outage-degrade scenarios)
 #   CHAOS_SHARD_MODES="0 1"     partitioned-ownership modes to sweep
 #                               (default both: off, and CHAOS_SHARD=1
 #                               so the whole matrix runs with
@@ -117,8 +128,10 @@ ELASTIC_MODES=${CHAOS_ELASTIC_MODES:-"0 1"}
 DRIVER_MODES=${CHAOS_DRIVER_MODES:-"0 1"}
 NATIVE_FETCH_MODES=${CHAOS_NATIVE_FETCH_MODES:-"0 1"}
 SHARD_MODES=${CHAOS_SHARD_MODES:-"0 1"}
+COLD_MODES=${CHAOS_COLD_MODES:-"0 1"}
 DISK=${CHAOS_DISK:-1}
 failed=()
+for cold in $COLD_MODES; do
 for shard in $SHARD_MODES; do
 for nfetch in $NATIVE_FETCH_MODES; do
 for driver in $DRIVER_MODES; do
@@ -134,7 +147,7 @@ for coalesce in $MODES; do
          "warm=${warm} skew=${skew} merge=${merge}" \
          "pushplan=${pushplan} tenant=${tenant} elastic=${elastic}" \
          "driver=${driver} nfetch=${nfetch} shard=${shard}" \
-         "disk=${DISK} ==="
+         "cold=${cold} disk=${DISK} ==="
     if ! CHAOS_SEED="${seed}" CHAOS_COALESCE="${coalesce}" \
          CHAOS_WARM="${warm}" CHAOS_SKEW="${skew}" \
          CHAOS_MERGE="${merge}" CHAOS_PUSHPLAN="${pushplan}" \
@@ -142,6 +155,7 @@ for coalesce in $MODES; do
          CHAOS_ELASTIC="${elastic}" CHAOS_DRIVER="${driver}" \
          CHAOS_NATIVE_FETCH="${nfetch}" \
          CHAOS_SHARD="${shard}" \
+         CHAOS_COLD="${cold}" \
          CHAOS_DISK="${DISK}" \
          JAX_PLATFORMS=cpu \
          python -m pytest tests/test_chaos.py -q -m chaos \
@@ -149,7 +163,8 @@ for coalesce in $MODES; do
       echo "!!! seed ${seed} coalesce=${coalesce} warm=${warm}" \
            "skew=${skew} merge=${merge} pushplan=${pushplan}" \
            "tenant=${tenant} elastic=${elastic} driver=${driver}" \
-           "nfetch=${nfetch} shard=${shard} FAILED — replay with:"
+           "nfetch=${nfetch} shard=${shard} cold=${cold} FAILED" \
+           "— replay with:"
       echo "    CHAOS_SEED=${seed} CHAOS_COALESCE=${coalesce}" \
            "CHAOS_WARM=${warm} CHAOS_SKEW=${skew}" \
          "CHAOS_MERGE=${merge} CHAOS_PUSHPLAN=${pushplan}" \
@@ -157,11 +172,13 @@ for coalesce in $MODES; do
            "CHAOS_ELASTIC=${elastic} CHAOS_DRIVER=${driver}" \
            "CHAOS_NATIVE_FETCH=${nfetch}" \
            "CHAOS_SHARD=${shard}" \
+           "CHAOS_COLD=${cold}" \
            "CHAOS_DISK=${DISK}" \
            "python -m pytest tests/test_chaos.py -m chaos"
-      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}d${driver}n${nfetch}h${shard}")
+      failed+=("${seed}/c${coalesce}w${warm}s${skew}m${merge}p${pushplan}t${tenant}e${elastic}d${driver}n${nfetch}h${shard}b${cold}")
     fi
   done
+done
 done
 done
 done
@@ -181,4 +198,5 @@ echo "chaos sweep: all seeds green on both dataplanes, both metadata" \
      "planes, both reduce-planning modes, both push-merge modes," \
      "both planned-push modes, both tenancy modes, both" \
      "elastic-membership modes, both driver-HA modes, both client" \
-     "fetch engines, both metadata-ownership modes (disk=${DISK})"
+     "fetch engines, both metadata-ownership modes, both cold-tier" \
+     "modes (disk=${DISK})"

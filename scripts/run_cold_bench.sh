@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Cold-tier sweep: the cold-tier test battery (tests/test_cold_tier.py —
+# the BlobStore round trip and CRC verify, the TieringService upload
+# discipline and ledger repay, the reducer's tiered-LAST resolve ladder,
+# recovery re-pointing cold coverage, drain-to-cold, tombstone reaping,
+# and the blob-fault matrix), the full-fleet-loss chaos scenarios, then
+# the cold-restore microbench across a set of seeds with its acceptance
+# gates: BOTH phases byte-identical, the cold phase's post-restart map
+# re-executions exactly ZERO, the baseline's exactly NUM_MAPS, and
+# ``cold_restore_speedup`` (re-execution baseline makespan over cold
+# restore makespan on the fresh fleet) >= 1.5x. A red seed replays
+# exactly:
+#
+#     python -m pytest tests/test_cold_tier.py
+#
+# Usage: scripts/run_cold_bench.sh [seed ...]
+#   COLD_SEEDS="0 1 2"   alternative way to pass the seed list
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+SEEDS=${*:-${COLD_SEEDS:-"0 7 42"}}
+failed=()
+echo "=== cold-tier test battery ==="
+if ! JAX_PLATFORMS=cpu python -m pytest tests/test_cold_tier.py -q -m '' \
+     -p no:cacheprovider -p no:randomly; then
+  failed+=("test_cold_tier")
+fi
+echo "=== full-fleet-loss chaos acceptance ==="
+if ! JAX_PLATFORMS=cpu CHAOS_COLD=1 python -m pytest tests/test_chaos.py \
+     -q -k cold -p no:cacheprovider -p no:randomly; then
+  failed+=("chaos-cold")
+fi
+
+echo "=== cold-restore microbench ==="
+for seed in $SEEDS; do
+  if ! JAX_PLATFORMS=cpu python - "$seed" <<'EOF'
+import json, sys, tempfile
+from sparkrdma_tpu.shuffle.cold_bench import run_cold_microbench
+from sparkrdma_tpu.utils.benchgate import gated_best_of
+
+seed = int(sys.argv[1])
+with tempfile.TemporaryDirectory(prefix="coldbench_") as td:
+    res = gated_best_of(lambda: run_cold_microbench(td, seed=seed))
+print(json.dumps(res))
+ok = (res["identical"] and res["reexec"]["cold"] == 0
+      and res["reexec"]["baseline"] == res["maps"]
+      and res["speedup"] >= 1.5)
+sys.exit(0 if ok else 1)
+EOF
+  then
+    failed+=("microbench-${seed}")
+  fi
+done
+
+if [ "${#failed[@]}" -gt 0 ]; then
+  echo "cold sweep: FAILED: ${failed[*]}"
+  exit 1
+fi
+echo "cold sweep: all seeds green, restore gates met (byte-identical," \
+     "zero re-executions on restore, full re-execution in the baseline)"

@@ -58,6 +58,7 @@ THREADED_MODULES = [
     "sparkrdma_tpu/shuffle/dist_cache.py",
     "sparkrdma_tpu/shuffle/planner.py",
     "sparkrdma_tpu/shuffle/push_merge.py",
+    "sparkrdma_tpu/shuffle/cold_tier.py",
     "sparkrdma_tpu/shuffle/pushed_store.py",
     "sparkrdma_tpu/shuffle/shard_plane.py",
     "sparkrdma_tpu/shuffle/tenancy.py",

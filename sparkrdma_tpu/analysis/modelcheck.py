@@ -526,6 +526,108 @@ class PushedStoreModel:
         self.rows.clear()
 
 
+class ColdTierModel:
+    """One tiering executor + the driver's TieredDirectory admission —
+    the in-memory mirror of ``cold_tier.TieringService`` (tombstone
+    refusal, charge-on-upload, reap-and-repay on drop) composed with
+    the ``endpoints`` glue: ``_on_tiered_publish``'s supersession drop,
+    the repair-publish prune (``TieredDirectory.drop_map`` + the
+    ``_tiered_superseded`` tombstone), and the unregister reap — with a
+    real :class:`TenantLedger` underneath via the world bookkeeping.
+
+    The two safety properties the ``tier_vs_*`` scenarios enumerate
+    schedules against:
+
+    * a blob whose upload raced a repair publish carries the REPLACED
+      attempt's bytes and must never become resolvable — whether its
+      publish beats the prune (``drop_map`` eats the entry) or loses
+      to it (the supersession tombstone drops the late publish);
+    * an upload racing the shuffle's death must not leak a disk-ledger
+      charge nothing will repay (tombstone refusal before the PUT,
+      reap-and-repay after it), and nothing may serve from a dead
+      shuffle's directory.
+    """
+
+    def __init__(self, world: World, tenant: int = 0):
+        self.world = world
+        self.tenant = tenant
+        self.blobs: Dict[str, int] = {}         # key -> charged bytes
+        # key -> (partition, covered maps, nbytes): the directory
+        self.directory: Dict[str, Tuple[int, frozenset, int]] = {}
+        self.superseded: set = set()            # repair-pruned map ids
+        self.dropped = False                    # shuffle-dead tombstone
+
+    def put(self, key: str, nbytes: int) -> bool:
+        """TieringService upload (PUT + tenant disk charge). A drop
+        that already landed refuses the upload outright — no blob, no
+        charge (cold_tier.TieringService._worker tombstone check)."""
+        if self.dropped:
+            return False
+        self.world.charge(self.tenant, nbytes)
+        self.blobs[key] = nbytes
+        return True
+
+    def publish(self, key: str, partition: int, covered) -> None:
+        """The one-sided TieredPublishMsg landing at the driver
+        (endpoints._on_tiered_publish), posted AFTER its put on the
+        tiering executor's own FIFO channel."""
+        nbytes = self.blobs.get(key)
+        if nbytes is None:
+            return  # the upload was refused or already reaped
+        if self.dropped:
+            # unknown shuffle at the driver: the service reaps its own
+            # blob and repays the charge (upload-races-unregister)
+            self.world.release(self.tenant, self.blobs.pop(key))
+            return
+        if any(m in self.superseded for m in covered):
+            # the blob holds a repair-superseded attempt's bytes — the
+            # supersession tombstone closes the mid-upload window
+            return
+        self.directory[key] = (partition, frozenset(covered), nbytes)
+
+    def repair(self, map_id: int) -> None:
+        """Repair-publish prune at the driver: drop every directory
+        entry covering the replaced map, then tombstone the map id so
+        a still-in-flight publish of its old bytes cannot land."""
+        for key in [k for k, v in self.directory.items()
+                    if map_id in v[1]]:
+            del self.directory[key]  # blob orphaned; reaped at drop
+        self.superseded.add(map_id)
+
+    def resolve(self, partition: int) -> set:
+        """The reducer's LAST resolve rung: whatever the directory
+        serves for ``partition`` must never name a superseded map or a
+        dead shuffle — that is the stale-blob consumption the prune and
+        tombstone exist to prevent."""
+        served = set()
+        for key, (p, covered, _nbytes) in self.directory.items():
+            if p != partition:
+                continue
+            if self.dropped:
+                self.world.problem = (
+                    "tiered-stale: dead shuffle's directory served "
+                    f"blob {key}")
+            for m in covered:
+                if m in self.superseded:
+                    self.world.problem = (
+                        f"tiered-stale: partition {p} resolved "
+                        f"superseded map {m} from blob {key}")
+                served.add(m)
+        return served
+
+    def drop(self) -> None:
+        """Unregister / TTL / EPOCH_DEAD: tombstone the shuffle, reap
+        its blobs, repay the tenant charges exactly once."""
+        if self.dropped:
+            return
+        self.dropped = True
+        for nbytes in self.blobs.values():
+            self.world.release(self.tenant, nbytes)
+        self.blobs.clear()
+        self.directory.clear()
+
+
+
 # ------------------------------------------------------------- invariants
 
 def check_invariants(world: World,
@@ -996,6 +1098,90 @@ def _build_push_vs_tombstone(sched: VirtualScheduler) -> World:
                             "staged ranges")
     sched.post("reduce.consume.p0", consume, chan="reducer",
                touches={"pushed"})
+    return world
+
+
+@scenario("tier_vs_replan",
+          "a repair publish supersedes a merged segment mid-upload: "
+          "the stale blob must never resolve, whether its publish "
+          "beats the driver's prune or loses to the supersession "
+          "tombstone; an unrelated partition's blob must survive")
+def _build_tier_vs_replan(sched: VirtualScheduler) -> World:
+    world = World(num_observers=1, num_maps=2)
+    cold = ColdTierModel(world, tenant=7)
+    world.publish(0, 500, 0, fence=1)
+    world.publish(1, 501, 1, fence=1)
+    # the tiering executor uploads the finalized partition-0 segment
+    # covering both maps: PUT then one-sided publish, FIFO on its own
+    # channel — the publish can land before OR after the repair prune
+    sched.post("tier.put.p0",
+               lambda s: cold.put("7/p0/seg_0_1", 100),
+               chan="tier0", touches={"cold"})
+    sched.post("tier.pub.p0",
+               lambda s: cold.publish("7/p0/seg_0_1", 0, {0, 1}),
+               chan="tier0", touches={"cold"})
+    # a second target's partition-1 blob covering only map 1 rides its
+    # own channel; the repair of map 0 must not take it down
+    sched.post("tier.put.p1",
+               lambda s: cold.put("7/p1/seg_1_2", 60),
+               chan="tier1", touches={"cold"})
+    sched.post("tier.pub.p1",
+               lambda s: cold.publish("7/p1/seg_1_2", 1, {1}),
+               chan="tier1", touches={"cold"})
+
+    # map 0 re-executes (corrupt-output repair) and republishes at
+    # fence 2: the driver prunes tiered entries covering it and
+    # tombstones the map id against the still-in-flight upload
+    def repair(s):
+        world.publish(0, 700, 1, fence=2)
+        cold.repair(0)
+    sched.post("repair.m0.f2", repair, chan="drv",
+               touches={"cold", "driver"})
+    # the reducer's tiered rung can fire at any point in the race;
+    # whatever it serves must never be a superseded map's old bytes
+    sched.post("reduce.resolve.p0", lambda s: cold.resolve(0),
+               chan="reducer", touches={"cold"})
+    sched.post("reduce.resolve.p1", lambda s: cold.resolve(1),
+               chan="reducer", touches={"cold"})
+    return world
+
+
+@scenario("tier_vs_unregister",
+          "an upload races EPOCH_DEAD/unregister: whichever order, "
+          "the blob is refused or reaped with its tenant charge "
+          "repaid exactly once, and nothing serves a dead shuffle's "
+          "directory")
+def _build_tier_vs_unregister(sched: VirtualScheduler) -> World:
+    world = World(num_observers=1, num_maps=2)
+    cold = ColdTierModel(world, tenant=8)
+    world.publish(0, 500, 0, fence=1)
+    # a segment upload and a drain-row upload on separate channels,
+    # each as PUT-then-publish, both racing the death broadcast
+    sched.post("tier.put.seg",
+               lambda s: cold.put("7/p0/seg_0_1", 100),
+               chan="tier0", touches={"cold"})
+    sched.post("tier.pub.seg",
+               lambda s: cold.publish("7/p0/seg_0_1", 0, {0, 1}),
+               chan="tier0", touches={"cold"})
+    sched.post("tier.put.drain",
+               lambda s: cold.put("7/p1/drain_m1_1", 60),
+               chan="tier1", touches={"cold"})
+    sched.post("tier.pub.drain",
+               lambda s: cold.publish("7/p1/drain_m1_1", 1, {1}),
+               chan="tier1", touches={"cold"})
+
+    # TTL sweep / unregister: EPOCH_DEAD rides the driver's FIFO
+    # broadcast; the tiering service drops (reap + repay) on receipt
+    def drop(s):
+        world.unregister()
+        cold.drop()
+        s.post("dead->obs0", lambda s2: world.deliver_dead(0),
+               chan="obs0.push", touches={"obs0"})
+    sched.post("bcast.drop", drop, chan="drv.bcast",
+               touches={"cold", "obs0"})
+    # a post-death resolve must serve NOTHING from the dead directory
+    sched.post("reduce.resolve.p0", lambda s: cold.resolve(0),
+               chan="reducer", touches={"cold"})
     return world
 
 

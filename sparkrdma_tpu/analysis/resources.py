@@ -52,6 +52,7 @@ LEDGER_MODULES = [
     "sparkrdma_tpu/shuffle/tenancy.py",
     "sparkrdma_tpu/shuffle/resolver.py",
     "sparkrdma_tpu/shuffle/push_merge.py",
+    "sparkrdma_tpu/shuffle/cold_tier.py",
     "sparkrdma_tpu/runtime/pool.py",
     "sparkrdma_tpu/runtime/blockserver.py",
 ]

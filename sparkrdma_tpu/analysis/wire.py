@@ -197,6 +197,18 @@ def _gen_arg(name: str, rng: random.Random):
     if name == "blob":
         # HA frames: op payload / snapshot envelope — opaque bytes
         return bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+    if name == "blob_key":
+        # cold-tier object keys: "<sid>/p<p>/<name>" path shapes, utf-8
+        # (including multi-byte chars — the length prefix counts BYTES)
+        return "/".join(
+            "".join(rng.choice("seg_dra0briefn\u00e9") for _ in
+                    range(rng.randrange(1, 10)))
+            for _ in range(rng.randrange(1, 4)))
+    if name == "nbytes":
+        # u64 blob sizes: object stores hold blobs past any i32 file
+        # domain; the max-u64 boundary rides _EXTRA_CASES too
+        return rng.choice([0, rng.randrange(1 << 31),
+                           rng.randrange(1 << 63)])
     if name in ("name", "host"):
         # lease-holder identity / standby address host
         return "".join(rng.choice("abc-xyz.0123") for _ in
@@ -312,6 +324,22 @@ _EXTRA_CASES: Dict[str, List[Callable[[], "rpc_msg.RpcMsg"]]] = {
     "ShardHandoffMsg": [
         lambda: M.ShardHandoffMsg(1, 0, 1, 2, -1),
         lambda: M.ShardHandoffMsg(1, 3, (1 << 63) - 1, 0, 5)],
+    # cold-tier corners (msgs 51-53): an EMPTY covered bitmap with an
+    # empty key (a degenerate publish must round-trip, the driver
+    # rejects it later), max-u64 blob size + max-u32 CRC together (the
+    # unsigned pack boundaries), and the dead-shuffle directory answer
+    # (STATUS_UNKNOWN_SHUFFLE + EPOCH_DEAD + empty bytes) the reducer's
+    # last resolve rung must decode without a directory present
+    "TieredPublishMsg": [
+        lambda: M.TieredPublishMsg(1, 0, "", 0, 0, b""),
+        lambda: M.TieredPublishMsg(1, 3, "9/p3/seg_2_41",
+                                   (1 << 64) - 1, (1 << 32) - 1,
+                                   b"\x07\x00\x00\x00")],
+    "FetchTieredResp": [
+        lambda: M.FetchTieredResp(1, M.STATUS_UNKNOWN_SHUFFLE,
+                                  M.EPOCH_DEAD, b""),
+        lambda: M.FetchTieredResp((1 << 62) - 1, M.STATUS_OK,
+                                  (1 << 62) - 1, b"\x00" * 21)],
 }
 
 

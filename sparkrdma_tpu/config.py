@@ -433,6 +433,36 @@ _KEYS = [
              "pushed blocks that would grow a segment past this are "
              "rejected (their maps stay per-map-fetched for that "
              "partition), bounding merge-target disk per partition."),
+    # --- cold tier (TPU-only: shuffle/cold_tier.py,
+    # docs/CONFIG.md "Cold tier")
+    _Key("cold_tier", False, "bool",
+         doc="Disaggregated cold shuffle tier (requires push_merge): "
+             "finalized merged segments upload in the background to a "
+             "blob store (whole files + their ledger CRCs; fence-"
+             "superseded ranges already excluded at finalize) and "
+             "publish into the driver's HA-replicated TieredDirectory. "
+             "Reducers resolve the TIERED location class LAST — after "
+             "pushed staging, merged replicas, and per-map, before "
+             "re-execution — so merge segments outlive the fleet: a "
+             "full-fleet restart reduces from the cold tier byte-"
+             "identically with zero map re-executions. Upload failure "
+             "degrades to hot-only serving; tiering never fails a job."),
+    _Key("cold_tier_path", "", "str",
+         doc="Root of the in-tree local-filesystem blob backend (the "
+             "BlobStore contract is shaped so an object store slots in "
+             "later). Empty = ~/.sparkrdma_cold. Must be shared "
+             "(network FS) for a restarted fleet to restore from it."),
+    _Key("tier_upload_budget", "64m", "bytes", 1 << 16, 1 << 44,
+         doc="Bound on in-flight upload BYTES in the TieringService "
+             "queue: a finalize submitted past it is SHED (the segment "
+             "simply stays hot-only) — backpressure never propagates "
+             "into the publish path."),
+    _Key("tier_retry_budget", 2, "int", 0, 64,
+         doc="Upload retries per blob PUT (restores ride "
+             "fetch_retry_budget like every read). Retries back off "
+             "exponentially from retry_backoff_base_ms up to "
+             "retry_backoff_cap_ms. Exhaustion degrades the segment to "
+             "hot-only serving."),
     # --- planned push (TPU-only: shuffle/pushed_store.py,
     # docs/CONFIG.md "Planned push")
     _Key("planned_push", False, "bool",

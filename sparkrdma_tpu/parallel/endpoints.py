@@ -201,6 +201,23 @@ class DriverEndpoint:
         self._finalize_sent: set = set()
         self.merged_publishes = 0  # audit: directory entries applied
         self.merged_zombie_drops = 0  # publishes from a DEAD slot dropped
+        # cold tier (shuffle/cold_tier.py): the driver's tiered-blob
+        # directory per shuffle — fed one-sided by TieredPublishMsg,
+        # served to reducers (FetchTieredReq), pruned on repair
+        # publishes (drop_map) but NEVER on tombstones: blobs outlive
+        # the executor that uploaded them (that is the point). Guarded
+        # by _tables_lock like every other per-shuffle table.
+        self._tiered: Dict[int, object] = {}
+        self.tiered_publishes = 0  # audit: tiered entries applied
+        self.tiered_stale_drops = 0  # publishes of superseded maps dropped
+        # (shuffle, map) pairs a repair publish superseded: an upload
+        # that was mid-flight when the repair landed publishes LATE —
+        # its blob carries the replaced attempt's bytes and must never
+        # enter the directory (modelcheck tier_vs_replan). Bounded the
+        # same two ways as the merge store's zombie markers; the race
+        # it defends against is bounded by upload latency.
+        from sparkrdma_tpu.utils.tombstones import TombstoneCache
+        self._tiered_superseded = TombstoneCache(ttl_s=30.0, cap=4096)
         self._clients = ConnectionCache(self.conf)
         # One broadcaster thread + a coalescing slot instead of a thread per
         # membership event: N executors joining produce O(N) sends of the
@@ -375,6 +392,7 @@ class DriverEndpoint:
             for sid, table in self._tables.items():
                 plan = self._plans.get(sid)
                 merged = self._merged.get(sid)
+                tiered = self._tiered.get(sid)
                 shuffles[str(sid)] = {
                     "num_maps": table.num_maps,
                     "num_partitions": self._num_partitions.get(sid, 0),
@@ -392,6 +410,8 @@ class DriverEndpoint:
                     "plan": (plan.to_bytes() if plan is not None
                              else None),
                     "merged": (merged.to_bytes() if merged is not None
+                               else None),
+                    "tiered": (tiered.to_bytes() if tiered is not None
                                else None),
                     "finalized": sid in self._finalize_sent,
                 }
@@ -469,6 +489,11 @@ class DriverEndpoint:
                 if s.get("merged") is not None:
                     self._merged[sid] = MergedDirectory.from_bytes(
                         s["merged"])
+                if s.get("tiered") is not None:
+                    from sparkrdma_tpu.shuffle.cold_tier import \
+                        TieredDirectory
+                    self._tiered[sid] = TieredDirectory.from_bytes(
+                        s["tiered"])
                 if s.get("finalized"):
                     self._finalize_sent.add(sid)
                 if self.conf.adaptive_plan and sid not in self._size_hists:
@@ -780,6 +805,7 @@ class DriverEndpoint:
             self._plans.pop(shuffle_id, None)
             self._num_partitions.pop(shuffle_id, None)
             self._merged.pop(shuffle_id, None)
+            self._tiered.pop(shuffle_id, None)
             self._finalize_sent.discard(shuffle_id)
             tenant = self._tenants.pop(shuffle_id, 0)
             self._register_times.pop(shuffle_id, None)
@@ -1137,6 +1163,94 @@ class DriverEndpoint:
                 covered.add(m)
         return covered
 
+    # -- cold-tier directory (shuffle/cold_tier.py) ----------------------
+
+    def _on_tiered_publish(self, msg: "M.TieredPublishMsg") -> None:
+        """Apply one cold-tier blob into the directory — one-sided like
+        a merged publish, but with NO zombie-slot guard: a blob
+        uploaded by a since-tombstoned executor is still durable and
+        still serves (blobs have no owner to die). Unknown-shuffle and
+        bad-partition guards stay."""
+        from sparkrdma_tpu.shuffle.cold_tier import (TieredDirectory,
+                                                     TieredEntry)
+        with self._tables_lock:
+            table = self._tables.get(msg.shuffle_id)
+            if table is None:
+                log.warning("driver: tiered publish for unknown shuffle "
+                            "%d", msg.shuffle_id)
+                return
+            parts = self._num_partitions.get(msg.shuffle_id, 0)
+            if parts and not 0 <= msg.partition_id < parts:
+                log.warning("driver: tiered publish with bad partition "
+                            "%d for shuffle %d", msg.partition_id,
+                            msg.shuffle_id)
+                return
+            table_maps = table.num_maps
+            from sparkrdma_tpu.shuffle.push_merge import bitmap_get
+            if any(bitmap_get(msg.covered, m)
+                   and (msg.shuffle_id, m) in self._tiered_superseded
+                   for m in range(table_maps)):
+                # the blob holds a repair-superseded attempt's bytes:
+                # the upload started before the repair landed, the
+                # publish arrived after drop_map pruned the directory —
+                # letting it in would resurrect the stale coverage
+                self.tiered_stale_drops += 1
+                log.info("driver: dropped tiered publish of superseded "
+                         "map for shuffle %d partition %d",
+                         msg.shuffle_id, msg.partition_id)
+                return
+            directory = self._tiered.get(msg.shuffle_id)
+            if directory is None:
+                directory = TieredDirectory()
+                self._tiered[msg.shuffle_id] = directory
+            directory.apply(TieredEntry(
+                msg.partition_id, msg.blob_key, msg.nbytes, msg.crc32,
+                msg.covered))
+            self.tiered_publishes += 1
+
+    def _on_fetch_tiered(self, msg: "M.FetchTieredReq") -> RpcMsg:
+        with self._tables_lock:
+            known = msg.shuffle_id in self._tables
+            epoch = self._epochs.get(msg.shuffle_id, 0)
+            directory = self._tiered.get(msg.shuffle_id)
+            data = directory.to_bytes() if directory is not None else b""
+        if not known:
+            return M.FetchTieredResp(msg.req_id, M.STATUS_UNKNOWN_SHUFFLE,
+                                     M.EPOCH_DEAD, b"")
+        return M.FetchTieredResp(msg.req_id, M.STATUS_OK, epoch, data)
+
+    def tiered_directory(self, shuffle_id: int):
+        """Snapshot of the shuffle's tiered directory (tests/benches
+        poll this for coverage; None = nothing tiered yet)."""
+        from sparkrdma_tpu.shuffle.cold_tier import TieredDirectory
+        with self._tables_lock:
+            directory = self._tiered.get(shuffle_id)
+            return (TieredDirectory.from_bytes(directory.to_bytes())
+                    if directory is not None else None)
+
+    def tiered_covering(self, shuffle_id: int, maps) -> set:
+        """Which of ``maps`` have EVERY reduce partition covered by the
+        cold tier — recovery's second re-point set, checked after
+        ``merged_covering``: these maps need no re-execution even when
+        no live replica holds them. Coverage is judged against the
+        UNION of a partition's blob entries (unlike merged: a reducer
+        can restore several blobs per partition — whole-segment blobs
+        and per-map drain rows compose), and there is no liveness
+        filter — blobs have no owner to exclude."""
+        from sparkrdma_tpu.shuffle.cold_tier import TieredDirectory
+        with self._tables_lock:
+            live_dir = self._tiered.get(shuffle_id)
+            parts = self._num_partitions.get(shuffle_id, 0)
+            directory = (TieredDirectory.from_bytes(live_dir.to_bytes())
+                         if live_dir is not None else None)
+        if directory is None or parts <= 0:
+            return set()
+        covered = set()
+        for m in maps:
+            if all(directory.covering(m, p) for p in range(parts)):
+                covered.add(m)
+        return covered
+
     def finalize_merge(self, shuffle_id: int) -> None:
         """Broadcast the finalize trigger for one shuffle's merge
         targets (also queued automatically when the last map publishes;
@@ -1360,7 +1474,14 @@ class DriverEndpoint:
             return []
         covered = self.merged_covering(shuffle_id, pending,
                                        exclude_slot=slot)
-        return [m for m in pending if m not in covered]
+        pending = [m for m in pending if m not in covered]
+        if pending:
+            # the cold tier counts toward the safety invariant: a blob
+            # has no slot to retire, so tiered coverage survives any
+            # drain by construction
+            cold = self.tiered_covering(shuffle_id, pending)
+            pending = [m for m in pending if m not in cold]
+        return pending
 
     def abort_drain(self, slot: int) -> bool:
         """Return a DRAINING slot to LIVE (the operator changed their
@@ -1409,6 +1530,7 @@ class DriverEndpoint:
         if (self.oplog is not None and not self._replaying
                 and isinstance(msg, (HelloMsg, M.JoinMsg, M.PublishMsg,
                                      M.MergedPublishMsg,
+                                     M.TieredPublishMsg,
                                      M.ShardBatchMsg))):
             from sparkrdma_tpu.shuffle.ha import OP_WIRE
             return self._ha_apply(OP_WIRE, msg.encode(),
@@ -1440,6 +1562,11 @@ class DriverEndpoint:
             return None
         if isinstance(msg, M.FetchMergedReq):
             return self._on_fetch_merged(msg)
+        if isinstance(msg, M.TieredPublishMsg):
+            self._on_tiered_publish(msg)
+            return None
+        if isinstance(msg, M.FetchTieredReq):
+            return self._on_fetch_tiered(msg)
         if isinstance(msg, M.GetBroadcastReq):
             with self._broadcasts_lock:
                 blob = self._broadcasts.get(msg.bcast_id)
@@ -1660,6 +1787,22 @@ class DriverEndpoint:
                     log.info("driver: merged entries covering shuffle %d "
                              "map %d dropped (repair publish)",
                              msg.shuffle_id, msg.map_id)
+                # cold blobs carrying the replaced attempt's bytes are
+                # the same conservative casualty: a blob uploaded (or
+                # still uploading) from the superseded segment must
+                # never resolve — its entry dies here and a LATE
+                # publish of it lands against this pruned state, where
+                # the reducer's resolve-order already prefers the
+                # repaired hot copy (modelcheck tier_vs_replan)
+                tiered = self._tiered.get(msg.shuffle_id)
+                if tiered is not None and tiered.drop_map(msg.map_id):
+                    log.info("driver: tiered entries covering shuffle %d "
+                             "map %d dropped (repair publish)",
+                             msg.shuffle_id, msg.map_id)
+                # and close the mid-upload window: a tiered publish of
+                # this map arriving AFTER this prune is stale by
+                # construction (its upload read the replaced bytes)
+                self._tiered_superseded.add((msg.shuffle_id, msg.map_id))
             epoch = self.bump_epoch(msg.shuffle_id,
                                     reason="repair publish") or epoch
         # push-merge: the LAST publish completes the map stage — tell
@@ -1981,6 +2124,11 @@ class ExecutorEndpoint:
         # PushedInputStore here when planned_push is on; the fetcher
         # resolves it FIRST, before merged segments and per-map pull
         self.pushed_store = None
+        # cold tier (shuffle/cold_tier.py): the manager installs a
+        # TieringService here when cold_tier is on; finalized segments
+        # tier asynchronously and the fetcher resolves the TIERED
+        # location class LAST, before re-execution
+        self.tiering = None
         # the planned pusher's plan hook (SegmentPusher.on_plan): called
         # when a ReducePlanMsg lands so submitted maps whose plan
         # arrived late (or re-planned) re-push to their planned slots
@@ -2394,7 +2542,10 @@ class ExecutorEndpoint:
         candidates = [i for i, m in enumerate(members)
                       if m != TOMBSTONE and i != my
                       and not (i < len(states) and states[i] != 0)]
-        if not candidates:
+        if not candidates and self.tiering is None:
+            # no live peers and no cold store: nowhere to put the rows.
+            # With tiering installed the drain proceeds peer-less — the
+            # scale-to-zero exit — and per-row fallback arbitrates.
             return M.STATUS_ERROR, 0, 0
         cand_set = set(candidates)
         directories: Dict[int, object] = {}
@@ -2407,6 +2558,8 @@ class ExecutorEndpoint:
                 for e in directory.entries(partition):
                     if e.slot in cand_set:
                         return e, e.slot
+            if not candidates:
+                return None, -1  # peer-less drain: tiering carries it
             return None, candidates[partition % len(candidates)]
 
         status = M.STATUS_OK
@@ -2448,6 +2601,25 @@ class ExecutorEndpoint:
             status = M.STATUS_ERROR
             return False
 
+        def route_row(sid: int, partition: int, map_id: int, fence: int,
+                      data: bytes) -> bool:
+            """Tier-first drain exit: an only-copy row goes to the cold
+            store (one durable blob, no peer involved) when tiering is
+            up; a store that is down or a dead shuffle falls back to
+            the ordinary peer push — the drain never gets CHEAPER
+            guarantees than it had before the cold tier existed."""
+            nonlocal rows_pushed, bytes_pushed
+            if self.tiering is not None:
+                if self.tiering.tier_row(sid, partition, map_id, fence,
+                                         data, map_id + 1):
+                    rows_pushed += 1
+                    bytes_pushed += len(data)
+                    return True
+                log.debug("drain tier of shuffle %d map %d p%d declined; "
+                          "falling back to peer push", sid, map_id,
+                          partition)
+            return push_row(sid, partition, map_id, fence, data)
+
         own_sids = src.local_shuffles()
         hosted_sids = (self.merge_store.hosted_shuffles()
                        if self.merge_store is not None else [])
@@ -2478,7 +2650,7 @@ class ExecutorEndpoint:
                         break
                     if data is None:
                         break  # superseded/unregistered mid-drain
-                    push_row(sid, p, m, fence, data)
+                    route_row(sid, p, m, fence, data)
         # 2) hosted merged rows: replicas OTHER maps depend on that
         # would silently die with this slot. export_rows streams the
         # payloads (one row in memory at a time) — a target hosting
@@ -2495,7 +2667,7 @@ class ExecutorEndpoint:
                 entry, _ = preferred(sid, partition)
                 if entry is not None and entry.covers(map_id):
                     continue
-                push_row(sid, partition, map_id, fence, data)
+                route_row(sid, partition, map_id, fence, data)
         return status, rows_pushed, bytes_pushed
 
     # -- connection pre-warming ------------------------------------------
@@ -2607,6 +2779,8 @@ class ExecutorEndpoint:
                 self.merge_store.note_registered(msg.shuffle_id)
             if self.pushed_store is not None:
                 self.pushed_store.note_registered(msg.shuffle_id)
+            if self.tiering is not None:
+                self.tiering.note_registered(msg.shuffle_id)
             self.location_plane.note_registered(msg.shuffle_id)
             return None
         if isinstance(msg, M.ReducePlanMsg):
@@ -2621,6 +2795,8 @@ class ExecutorEndpoint:
                 self.merge_store.note_registered(msg.shuffle_id)
             if self.pushed_store is not None:
                 self.pushed_store.note_registered(msg.shuffle_id)
+            if self.tiering is not None:
+                self.tiering.note_registered(msg.shuffle_id)
             accepted = self.location_plane.put_shard_map(
                 msg.shuffle_id, ShardMap(msg.num_maps, msg.shard_slots),
                 msg.epoch)
@@ -2771,6 +2947,11 @@ class ExecutorEndpoint:
             if self.pushed_store is not None:
                 # staged pushed ranges die with the shuffle too
                 self.pushed_store.drop_shuffle(msg.shuffle_id)
+            if self.tiering is not None:
+                # cold blobs reap through the same tombstone discipline:
+                # an upload racing this death deletes its own blob and
+                # skips the publish (modelcheck tier_vs_unregister)
+                self.tiering.drop_shuffle(msg.shuffle_id)
             src = self.data_source
             if src is not None and hasattr(src, "remove_shuffle"):
                 # shuffle TTL/GC: a driver-side unregister (explicit or
@@ -2818,6 +2999,8 @@ class ExecutorEndpoint:
         self.location_plane.note_registered(plan.shuffle_id)
         if self.merge_store is not None:
             self.merge_store.note_registered(plan.shuffle_id)
+        if self.tiering is not None:
+            self.tiering.note_registered(plan.shuffle_id)
         accepted = self.location_plane.put_plan(plan.shuffle_id, plan)
         if not accepted:
             return  # stale reordered push: must not touch warm state
@@ -3289,9 +3472,19 @@ class ExecutorEndpoint:
 
     def _publish_merged(self, msg: "M.MergedPublishMsg") -> None:
         """The merge finalizer's publish callback: owner-routed in
-        ownership mode, driver-direct otherwise."""
+        ownership mode, driver-direct otherwise. When the cold tier is
+        on, the SAME descriptor also enqueues a background upload —
+        the tiering service reads the sealed ranges back through the
+        serve path and publishes the blob one-sided when it lands."""
+        if self.tiering is not None:
+            self.tiering.submit(msg)
         if self._send_owner_merged(msg):
             return
+        self.driver.send(msg)
+
+    def _publish_tiered(self, msg: "M.TieredPublishMsg") -> None:
+        """The tiering service's publish callback: driver-direct and
+        one-sided (the directory is HA-replicated driver-side)."""
         self.driver.send(msg)
 
     def _corrupt_served(self, shuffle_id: int, map_id: int,
@@ -3769,6 +3962,32 @@ class ExecutorEndpoint:
             self.location_plane.put_merged(shuffle_id, directory,
                                            resp.epoch)
         return directory
+
+    def get_tiered_directory(self, shuffle_id: int, metrics=None):
+        """The shuffle's cold-tier directory: ONE pull from the driver
+        per resolve (no cache — the tiered rung is the last resort
+        before re-execution, consulted rarely and always wanting the
+        freshest coverage). Returns a
+        :class:`~sparkrdma_tpu.shuffle.cold_tier.TieredDirectory` or
+        None (driver unreachable / shuffle unknown / feature off)."""
+        if not self.conf.cold_tier:
+            return None
+        from sparkrdma_tpu.shuffle.cold_tier import TieredDirectory
+        try:
+            if metrics is not None:
+                metrics.record_metadata_rpc()
+                metrics.record_request()
+            resp = self.driver.request(
+                lambda c: M.FetchTieredReq(c.next_req_id(), shuffle_id),
+                timeout=self.conf.resolved_request_deadline_s())
+        except (TransportError, TimeoutError) as e:
+            log.debug("tiered-directory fetch for shuffle %d failed: %s",
+                      shuffle_id, e)
+            return None
+        assert isinstance(resp, M.FetchTieredResp)
+        if resp.status != M.STATUS_OK:
+            return None
+        return TieredDirectory.from_bytes(resp.data)
 
     # -- client-side fetch calls (used by the fetcher iterator) ----------
 

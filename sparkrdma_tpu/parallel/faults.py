@@ -483,3 +483,201 @@ def storage_corrupt(op: str, path: str) -> None:
     inj = _STORAGE
     if inj is not None:
         inj.corrupt(op, path)
+
+
+# -- blob-store faults ----------------------------------------------------
+
+# Blob fault kinds (the cold tier's failure surface — shuffle/cold_tier.py).
+BLOB_UNAVAILABLE = "unavailable"       # the op raises OSError (store down)
+BLOB_SLOW = "slow"                     # hold the op delay_s on the caller
+TORN_UPLOAD = "torn_upload"            # the put lands SHORT (torn_bytes)
+#                                        then errors — must never become
+#                                        visible (the atomicity contract)
+BLOB_CORRUPT = "corrupt_at_rest"       # flip bits in the stored blob AFTER
+#                                        the put commits (rot; the entry
+#                                        CRC owns detection on restore)
+QUOTA_EXHAUSTED = "quota_exhausted"    # the put raises OSError(EDQUOT)
+
+BLOB_KINDS = (BLOB_UNAVAILABLE, BLOB_SLOW, TORN_UPLOAD, BLOB_CORRUPT,
+              QUOTA_EXHAUSTED)
+
+# Hook-point op names (the blob contract's four verbs):
+#   put     TieringService uploads (segments + drain rows)
+#   get     reducer-side restores
+#   list    reap/GC prefix scans
+#   delete  tombstone reaps
+
+
+@dataclass
+class BlobFault:
+    """One scripted blob-store fault. Matching is AND across set
+    criteria (op name, key substring); ``after``/``times``/``prob``
+    behave as on :class:`Fault`."""
+
+    kind: str
+    op: Optional[str] = None          # None matches any op
+    key_substr: Optional[str] = None
+    after: int = 0
+    times: Optional[int] = None
+    prob: float = 1.0
+    delay_s: float = 0.0              # BLOB_SLOW
+    torn_bytes: int = 64              # TORN_UPLOAD: bytes that land
+    flip_bits: int = 1                # BLOB_CORRUPT
+    seen: int = 0
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.kind not in BLOB_KINDS:
+            raise ValueError(f"unknown blob fault kind {self.kind!r}")
+
+
+class BlobFaultInjector:
+    """Seeded chaos shim over the blob store, sibling of
+    :class:`StorageFaultInjector`: installed process-globally, the
+    :class:`~sparkrdma_tpu.shuffle.cold_tier.FSBlobStore` consults the
+    module hooks on every put/get/list/delete — a single ``is None``
+    check when no injector is active. Same ``after``/``times``/``prob``
+    windows and seeded RNG, so a failing
+    ``scripts/run_chaos.sh CHAOS_COLD=1`` sweep replays from its seed."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self._lock = threading.RLock()
+        self._faults: List[BlobFault] = []
+        self.fired: Dict[str, int] = {}
+
+    # -- scripting -------------------------------------------------------
+
+    def add(self, kind: str, **kw) -> BlobFault:
+        fault = BlobFault(kind, **kw)
+        with self._lock:
+            self._faults.append(fault)
+        return fault
+
+    def clear(self) -> None:
+        with self._lock:
+            self._faults.clear()
+
+    def fired_count(self, kind: Optional[str] = None) -> int:
+        with self._lock:
+            if kind is not None:
+                return self.fired.get(kind, 0)
+            return sum(self.fired.values())
+
+    # -- installation ----------------------------------------------------
+
+    def install(self) -> None:
+        global _BLOB
+        _BLOB = self
+
+    def uninstall(self) -> None:
+        global _BLOB
+        if _BLOB is self:
+            _BLOB = None
+
+    # -- fault application (called from the module hooks) ----------------
+
+    def check(self, op: str, key: str) -> None:
+        """Raise/stall for error-kind faults matching ``(op, key)``."""
+        import errno
+
+        fault = self._match(BLOB_SLOW, op, key)
+        if fault is not None:
+            time.sleep(fault.delay_s)
+        fault = self._match(BLOB_UNAVAILABLE, op, key)
+        if fault is not None:
+            raise OSError(errno.EIO,
+                          f"fault injection: blob store unavailable ({op})",
+                          key)
+        fault = self._match(QUOTA_EXHAUSTED, op, key)
+        if fault is not None:
+            raise OSError(errno.EDQUOT,
+                          f"fault injection: blob quota exhausted ({op})",
+                          key)
+
+    def write_cap(self, op: str, key: str, nbytes: int) -> Optional[int]:
+        """TORN_UPLOAD: how many of ``nbytes`` should land before the
+        put fails (None = no fault, write everything)."""
+        fault = self._match(TORN_UPLOAD, op, key)
+        if fault is None:
+            return None
+        return max(0, min(fault.torn_bytes, nbytes - 1))
+
+    def corrupt(self, op: str, path: str) -> bool:
+        """BLOB_CORRUPT: flip seeded bits in the committed blob file in
+        place (rot AFTER the put — the published CRC covers the clean
+        bytes, so restore-time verification owns detection). Returns
+        True if a fault fired."""
+        fault = self._match(BLOB_CORRUPT, op, path)
+        if fault is None:
+            return False
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return False
+        if size == 0:
+            return False
+        with open(path, "r+b") as f:
+            for _ in range(max(1, fault.flip_bits)):
+                with self._lock:
+                    pos = self.rng.randrange(size)
+                    bit = 1 << self.rng.randrange(8)
+                f.seek(pos)
+                b = f.read(1)
+                f.seek(pos)
+                f.write(bytes([b[0] ^ bit]))
+        log.debug("fault injection: flipped %d bit(s) in blob %s",
+                  max(1, fault.flip_bits), path)
+        return True
+
+    def _match(self, kind: str, op: str, key: str) -> Optional[BlobFault]:
+        with self._lock:
+            for fault in self._faults:
+                if fault.kind != kind:
+                    continue
+                if fault.op is not None and fault.op != op:
+                    continue
+                if (fault.key_substr is not None
+                        and fault.key_substr not in key):
+                    continue
+                fault.seen += 1
+                if fault.seen <= fault.after:
+                    continue
+                if fault.times is not None and fault.fired >= fault.times:
+                    continue
+                if fault.prob < 1.0 and self.rng.random() >= fault.prob:
+                    continue
+                fault.fired += 1
+                self.fired[kind] = self.fired.get(kind, 0) + 1
+                return fault
+        return None
+
+
+# Process-global blob injector (None = no chaos, hooks are no-ops).
+_BLOB: Optional[BlobFaultInjector] = None
+
+
+def blob_check(op: str, key: str) -> None:
+    """Production hook: raise/stall if a blob fault matches. A single
+    attribute load + ``is None`` test when no injector is installed."""
+    inj = _BLOB
+    if inj is not None:
+        inj.check(op, key)
+
+
+def blob_write_cap(op: str, key: str, nbytes: int) -> Optional[int]:
+    """Production hook for torn uploads: bytes to land before failing,
+    or None for a full write."""
+    inj = _BLOB
+    if inj is not None:
+        return inj.write_cap(op, key, nbytes)
+    return None
+
+
+def blob_corrupt(op: str, path: str) -> None:
+    """Production hook: flip bits at rest in the committed blob file if
+    a BLOB_CORRUPT fault matches (no-op otherwise)."""
+    inj = _BLOB
+    if inj is not None:
+        inj.corrupt(op, path)

@@ -1017,6 +1017,91 @@ class FetchMergedResp(RpcMsg):
 
 
 @register()
+class TieredPublishMsg(RpcMsg):
+    """Tiering executor -> driver: one cold-tier blob, one-sided like
+    ``MergedPublishMsg`` (no ack — a lost publish only costs cold
+    coverage; the hot copy still serves). ``blob_key`` names the blob
+    in the configured store, ``covered`` is the map-space bitmap the
+    blob's bytes carry for ``partition_id``, ``crc32`` the CRC32 over
+    the WHOLE blob, verified reducer-side on restore so at-rest rot in
+    the cold store degrades to the next resolve rung, never to wrong
+    bytes. ``nbytes`` is u64: object stores hold blobs bigger than any
+    one segment file. The directory it lands in is HA-replicated
+    through the op log (shuffle/ha.py), so cold locations survive
+    driver failover too."""
+
+    def __init__(self, shuffle_id: int, partition_id: int, blob_key: str,
+                 nbytes: int, crc32: int, covered: bytes):
+        self.shuffle_id = shuffle_id
+        self.partition_id = partition_id
+        self.blob_key = blob_key
+        self.nbytes = nbytes
+        self.crc32 = crc32
+        self.covered = covered
+
+    def payload(self) -> bytes:
+        key = self.blob_key.encode("utf-8")
+        return (struct.pack("<ii", self.shuffle_id, self.partition_id)
+                + struct.pack("<QI", self.nbytes, self.crc32)
+                + struct.pack("<II", len(key), len(self.covered))
+                + key + self.covered)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "TieredPublishMsg":
+        shuffle_id, partition_id = struct.unpack_from("<ii", payload, 0)
+        nbytes, crc = struct.unpack_from("<QI", payload, 8)
+        nkey, ncov = struct.unpack_from("<II", payload, 20)
+        off = 28
+        key = payload[off:off + nkey].decode("utf-8")
+        off += nkey
+        covered = payload[off:off + ncov]
+        return cls(shuffle_id, partition_id, key, nbytes, crc, covered)
+
+
+@register()
+class FetchTieredReq(RpcMsg):
+    """Reducer -> driver: pull one shuffle's cold-tier directory (the
+    LAST resolve rung — consulted only when pushed staging, merged
+    replicas, and per-map owners have all degraded)."""
+
+    def __init__(self, req_id: int, shuffle_id: int):
+        self.req_id = req_id
+        self.shuffle_id = shuffle_id
+
+    def payload(self) -> bytes:
+        return _QI.pack(self.req_id, self.shuffle_id)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchTieredReq":
+        req_id, shuffle_id = _QI.unpack_from(payload, 0)
+        return cls(req_id, shuffle_id)
+
+
+@register()
+class FetchTieredResp(RpcMsg):
+    """``data`` is ``TieredDirectory.to_bytes()`` (possibly empty —
+    nothing tiered yet); ``epoch`` stamps it with the shuffle's
+    location-state version. ``STATUS_UNKNOWN_SHUFFLE`` + ``EPOCH_DEAD``
+    when unregistered."""
+
+    def __init__(self, req_id: int, status: int, epoch: int, data: bytes):
+        self.req_id = req_id
+        self.status = status
+        self.epoch = epoch
+        self.data = data
+
+    def payload(self) -> bytes:
+        return (_QI.pack(self.req_id, self.status) + _Q.pack(self.epoch)
+                + self.data)
+
+    @classmethod
+    def from_payload(cls, payload: bytes) -> "FetchTieredResp":
+        req_id, status = _QI.unpack_from(payload, 0)
+        (epoch,) = _Q.unpack_from(payload, _QI.size)
+        return cls(req_id, status, epoch, payload[_QI.size + _Q.size:])
+
+
+@register()
 class TenantMapMsg(RpcMsg):
     """Driver -> executors push at registerShuffle time: shuffle
     ``shuffle_id`` belongs to tenant ``tenant`` (and expires

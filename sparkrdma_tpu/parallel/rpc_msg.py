@@ -90,6 +90,12 @@ WIRE_IDS: Dict[str, int] = {
     "ShardBatchMsg": 48,
     "ShardOpMsg": 49,
     "ShardHandoffMsg": 50,
+    # disaggregated cold tier (shuffle/cold_tier.py): the one-sided
+    # blob publish and the reducer's directory pull — the TIERED
+    # location class resolved last, before re-execution
+    "TieredPublishMsg": 51,
+    "FetchTieredReq": 52,
+    "FetchTieredResp": 53,
 }
 
 # Ids deliberately absent from the dense 1..max range, with the reason

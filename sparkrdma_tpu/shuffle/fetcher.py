@@ -183,6 +183,13 @@ class ReadMetrics:
     # the partitioned-ownership health signal: sustained nonzero here
     # means the shard fan-in is not actually absorbing reads
     shard_fallbacks: int = 0
+    # cold-tier dataplane: partitions restored from tiered blobs (the
+    # LAST resolve rung before re-execution), the bytes they carried,
+    # and restores that DEGRADED onward (blob missing/rotten/torn —
+    # per-partition, down to re-execution of exactly the covered maps)
+    tiered_reads: int = 0
+    tiered_bytes: int = 0
+    tiered_fallbacks: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def record_remote(self, nbytes: int, latency_s: float) -> None:
@@ -225,6 +232,15 @@ class ReadMetrics:
     def record_shard_fallback(self) -> None:
         with self._lock:
             self.shard_fallbacks += 1
+
+    def record_tiered(self, nbytes: int) -> None:
+        with self._lock:
+            self.tiered_reads += 1
+            self.tiered_bytes += nbytes
+
+    def record_tiered_fallback(self) -> None:
+        with self._lock:
+            self.tiered_fallbacks += 1
 
     def record_retry(self) -> None:
         with self._lock:
@@ -337,6 +353,9 @@ class ShuffleFetcher:
         # a partially-pushed partition ride the per-map plane instead)
         self._pushed_parts: set = set()
         self._table = None
+        # cold tier: the tiered-directory snapshot this fetch resolved
+        # against (sibling-blob fallback consults it on a failed restore)
+        self._tiered_dir = None
 
     # -- setup: plan + launch (initialize/startAsyncRemoteFetches) -------
 
@@ -368,18 +387,34 @@ class ShuffleFetcher:
         all_parts = set(range(self.start_partition, self.end_partition))
         local_maps: List[int] = []
         by_peer: Dict[int, List[int]] = {}
+        # cold tier: maps no earlier rung can serve — never published
+        # (full-fleet restart: the fresh table is empty) or published on
+        # a slot the membership has TOMBSTONED (authoritative death, not
+        # mere lag) — divert to the TIERED rung instead of escalating.
+        # Live owners never divert: tiered resolves LAST by precedence.
+        cold_maps: List[int] = []
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        cold_on = bool(self.conf.cold_tier)
+        members = self.endpoint.members() if cold_on else []
         for m in range(self.map_start, self.map_end):
             if self._skip.get(m, set()) >= all_parts:
                 continue  # every partition rides a merged segment
             entry = table.entry(m)
             if entry is None:
+                if cold_on:
+                    cold_maps.append(m)
+                    continue
                 raise FetchFailedError(self.shuffle_id, m, -1,
                                        "map output never published")
             _, exec_idx = entry
             if exec_idx == my_index:
                 local_maps.append(m)
+            elif (cold_on and exec_idx < len(members)
+                    and members[exec_idx] == TOMBSTONE):
+                cold_maps.append(m)
             else:
                 by_peer.setdefault(exec_idx, []).append(m)
+        tiered_tasks = self._resolve_tiered(cold_maps, all_parts)
 
         # Local short-circuit (:327-337): serve directly, count
         # separately — per uncovered contiguous run when merged segments
@@ -436,10 +471,20 @@ class ShuffleFetcher:
                 daemon=True,
                 name=f"fetch-merged-s{self.shuffle_id}-e{slot}")
             self._threads.append(t)
+        # Tiered-restore thread: blob reads are local-FS/object GETs with
+        # no per-peer channel to parallelize over — one thread drains the
+        # whole plan sequentially, same containment contract as a peer.
+        if tiered_tasks:
+            t = threading.Thread(
+                target=self._fetch_tiered,
+                args=(tiered_tasks, count_lock),
+                daemon=True, name=f"fetch-tiered-s{self.shuffle_id}")
+            self._threads.append(t)
         # Expected-result accounting: each peer thread registers its request
         # count before its first enqueue; the sentinel goes in when all
         # threads have finished (tracked by _peer_threads_left).
-        self._peer_threads_left = len(peers) + len(merged_by_slot)
+        self._peer_threads_left = (len(peers) + len(merged_by_slot)
+                                   + (1 if tiered_tasks else 0))
         if self._peer_threads_left == 0:
             self._results.put(FetchResult(is_sentinel=True))
         for t in self._threads:
@@ -767,6 +812,179 @@ class ShuffleFetcher:
             with count_lock:
                 self._expected_results += 1
             self._results.put(FetchResult(m, p, p + 1, data))
+
+    # -- tiered (cold) resolution: the LAST rung before re-execution -----
+
+    def _resolve_tiered(self, cold_maps: List[int], all_parts: set):
+        """Plan the TIERED rung for maps no earlier rung can serve.
+
+        Per partition, greedily pick blob entries (widest coverage
+        first) whose ENTIRE covered map set is still needed there — a
+        blob is the concatenation of all its covered maps' rows and
+        cannot be sliced to a subset, exactly like a merged segment; an
+        entry overlapping a map some earlier rung already serves is
+        unusable (precedence: live owners never resolve tiered). A
+        (map, partition) pair left uncovered escalates NOW as
+        FetchFailedError — the rung below tiered is re-execution.
+
+        Returns ``[(partition, entry, covered_maps)]`` restore tasks."""
+        if not cold_maps:
+            return []
+        directory = self.endpoint.get_tiered_directory(
+            self.shuffle_id, metrics=self.metrics)
+        self._tiered_dir = directory
+        need: Dict[int, set] = {
+            m: {p for p in all_parts if p not in self._skip.get(m, set())}
+            for m in cold_maps}
+        tasks: List = []
+        if directory is not None:
+            for p in range(self.start_partition, self.end_partition):
+                for entry in directory.entries(p):
+                    covered = entry.covered_maps(self.num_maps)
+                    if not covered:
+                        continue
+                    if any(m not in need or p not in need[m]
+                           for m in covered):
+                        continue  # overlaps a served map: unusable
+                    tasks.append((p, entry, tuple(covered)))
+                    for m in covered:
+                        need[m].discard(p)
+                        self._skip.setdefault(m, set()).add(p)
+        for m in sorted(need):
+            if need[m]:
+                raise FetchFailedError(
+                    self.shuffle_id, m, -1,
+                    "map output never published and no cold coverage "
+                    f"(partitions {sorted(need[m])})")
+        return tasks
+
+    def _blob_store(self):
+        """The blob store for restores: the installed TieringService's
+        (one handle per process) or a fresh one off the conf — a pure
+        reducer (no merge role) still restores."""
+        svc = getattr(self.endpoint, "tiering", None)
+        if svc is not None and getattr(svc, "store", None) is not None:
+            return svc.store
+        from sparkrdma_tpu.shuffle.cold_tier import open_store
+        return open_store(self.conf)
+
+    def _fetch_tiered(self, tasks: List,
+                      count_lock: threading.Lock) -> None:
+        """Drain the tiered-restore plan: one blob GET per task under
+        the bounded retry envelope, whole-blob CRC verified against the
+        ledger CRC the entry carries. A missing/rotten/torn blob first
+        tries a SIBLING blob with identical coverage (another merge
+        target's upload of the same partition), then escalates as
+        FetchFailedError blaming a covered map — the rung below is
+        re-execution of exactly that map set, never corrupt output."""
+        try:
+            store = self._blob_store()
+            if store is None:
+                raise FetchFailedError(
+                    self.shuffle_id, tasks[0][2][0] if tasks else -1, -1,
+                    "cold tier unavailable (no blob store)")
+            for p, entry, maps_served in tasks:
+                if self._aborted.is_set():
+                    raise _Aborted()
+                data = self._tiered_blob_data(store, p, entry,
+                                              maps_served)
+                self.metrics.record_tiered(len(data))
+                self.tracer.instant("fetch.tiered", "fetch",
+                                    shuffle=self.shuffle_id, partition=p,
+                                    bytes=len(data))
+                self._emit_tiered(p, data, count_lock)
+        except _Aborted:
+            pass
+        except Exception as e:  # noqa: BLE001 — same containment as the
+            # peer threads: any failure surfaces as a result, never a
+            # silent dead thread
+            failure = (e if isinstance(e, FetchFailedError) else
+                       FetchFailedError(self.shuffle_id, -3, -1,
+                                        f"{type(e).__name__}: {e}"))
+            self._results.put(FetchResult(failure=failure))
+        finally:
+            with count_lock:
+                self._peer_threads_left -= 1
+                last = self._peer_threads_left == 0
+                if last:
+                    self._results.put(FetchResult(is_sentinel=True))
+            if last and self._aborted.is_set():
+                self._drain_unconsumed()
+
+    def _tiered_blob_data(self, store, p: int, entry,
+                          maps_served) -> bytes:
+        """One task's verified bytes. Store unavailability retries with
+        backoff (the same transient envelope remote fetches get); a CRC
+        mismatch or absence moves to the next candidate immediately (a
+        re-get re-reads the same rotted bytes; absence is
+        authoritative — the blob was reaped)."""
+        import zlib
+        candidates = [entry]
+        directory = getattr(self, "_tiered_dir", None)
+        if directory is not None:
+            want = set(maps_served)
+            candidates += [
+                e for e in directory.entries(p)
+                if e.blob_key != entry.blob_key
+                and set(e.covered_maps(self.num_maps)) == want]
+        attempts = 1 + max(0, self.conf.fetch_retry_budget)
+        last_err = "no candidate blob"
+        for cand in candidates:
+            for attempt in range(attempts):
+                if self._aborted.is_set():
+                    raise _Aborted()
+                try:
+                    blob = store.get(cand.blob_key)
+                except KeyError:
+                    last_err = f"blob {cand.blob_key} absent (reaped?)"
+                    break
+                except OSError as e:
+                    last_err = f"blob {cand.blob_key} unreadable: {e}"
+                    if attempt + 1 < attempts:
+                        self.metrics.record_retry()
+                        if self._aborted.wait(self._backoff.delay(attempt)):
+                            raise _Aborted()
+                    continue
+                if (len(blob) == cand.nbytes
+                        and zlib.crc32(blob) == cand.crc32 & 0xFFFFFFFF):
+                    return blob
+                self.metrics.record_checksum_failure()
+                last_err = f"blob {cand.blob_key} failed its ledger CRC"
+                log.warning("tiered blob for shuffle %d partition %d "
+                            "failed verification (%s); degrading",
+                            self.shuffle_id, p, last_err)
+                break
+        self.metrics.record_tiered_fallback()
+        # "cold_unusable": every candidate blob for this partition was
+        # rotten, torn, or gone — recovery must NOT re-point the map
+        # back at the same directory entries (that would retry the same
+        # dead blob forever); re-executing publishes a repair, which
+        # drops the bad entries driver-side
+        raise FetchFailedError(
+            self.shuffle_id, maps_served[0], -1,
+            f"tiered restore of partition {p} failed: {last_err}",
+            verdict="cold_unusable")
+
+    def _emit_tiered(self, p: int, data: bytes,
+                     count_lock: threading.Lock) -> None:
+        """One restored partition through the ordinary pool-leased
+        landing: the blob's bytes copy into ONE RegisteredBuffer lease
+        (BufferPool accounting, tenant-charged) exactly like a vectored
+        response; no pool means plain bytes. map_id -3 marks the cold
+        dataplane (merged reads use -2)."""
+        payload, lease = data, None
+        if self.pool is not None and len(data):
+            lease = self.pool.get_registered(len(data),
+                                             tenant=self.tenant)
+            view = lease.slice(len(data))
+            view[:] = np.frombuffer(data, dtype=np.uint8)
+            payload = view
+        with count_lock:
+            self._expected_results += 1
+        self._results.put(FetchResult(-3, p, p + 1, payload,
+                                      is_local=True, lease=lease))
+        if lease is not None:
+            lease.release()
 
     # -- per-peer fetch pipeline ----------------------------------------
 
@@ -1916,7 +2134,8 @@ class ShuffleFetcher:
                 and self._consumed >= self._expected_results):
             self._reducer_bytes_recorded = True
             self.reader_stats.record_reducer_bytes(
-                self.metrics.remote_bytes + self.metrics.local_bytes)
+                self.metrics.remote_bytes + self.metrics.local_bytes
+                + self.metrics.tiered_bytes)
 
     # -- iteration (:342-382) -------------------------------------------
 

@@ -163,6 +163,19 @@ class TpuShuffleManager:
                 self.executor.merge_store = MergeStore(self.resolver,
                                                        self.conf)
                 self.merge_client = MergeClient(self.executor, self.conf)
+                if self.conf.cold_tier:
+                    # cold tier (shuffle/cold_tier.py): finalized merged
+                    # segments tier to the blob store in the background;
+                    # the publish callback rides the one-sided driver
+                    # channel like every other publish
+                    from sparkrdma_tpu.shuffle.cold_tier import (
+                        TieringService, open_store)
+                    store = open_store(self.conf)
+                    if store is not None:
+                        self.executor.tiering = TieringService(
+                            store, self.resolver, self.conf,
+                            publish=self.executor._publish_tiered,
+                            tracer=self.tracer)
             if planned:
                 # planned push (shuffle/pushed_store.py): this executor
                 # is a planned-push TARGET — staged reduce inputs the
@@ -277,6 +290,9 @@ class TpuShuffleManager:
         if self.executor is not None and self.executor.merge_store is not None:
             n += self.executor.merge_store.reap_orphans(live_shuffle_ids,
                                                         min_age_s)
+        if self.executor is not None and self.executor.tiering is not None:
+            n += self.executor.tiering.reap_orphans(live_shuffle_ids,
+                                                    min_age_s)
         return n
 
     def plan_reduce(self, handle: ShuffleHandle):
@@ -341,6 +357,8 @@ class TpuShuffleManager:
                 self.executor.merge_store.drop_shuffle(shuffle_id)
             if self.executor.pushed_store is not None:
                 self.executor.pushed_store.drop_shuffle(shuffle_id)
+            if self.executor.tiering is not None:
+                self.executor.tiering.drop_shuffle(shuffle_id)
         if self.pusher is not None:
             self.pusher.forget(shuffle_id)
         if self.resolver is not None:
@@ -377,6 +395,10 @@ class TpuShuffleManager:
             log.info("pushed store at stop: %s",
                      self.executor.pushed_store.snapshot())
             self.executor.pushed_store.stop()
+        if self.executor is not None and self.executor.tiering is not None:
+            log.info("cold tier at stop: %s",
+                     self.executor.tiering.snapshot())
+            self.executor.tiering.stop()
         if self.executor is not None:
             if self.executor.suspect_events or self.executor.checksum_failures:
                 log.warning("peer health at stop: %s (checksum failures: %d)",

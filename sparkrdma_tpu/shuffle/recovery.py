@@ -213,6 +213,22 @@ def recover_lost_maps(executors: Sequence[TpuShuffleManager],
                 lost_maps.append(m)
         if not lost_maps and failure.map_id >= 0:
             lost_maps = [failure.map_id]
+        conf = getattr(endpoint, "conf", None)
+        if conf is not None and bool(getattr(conf, "cold_tier", False)):
+            # cold-tier fleets: maps owned by ALREADY-tombstoned slots
+            # (a prior fleet) are as lost as the blamed slot's — fold
+            # them in now so one stage retry re-points (or recomputes)
+            # the whole set instead of burning a retry per map
+            from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+            members = endpoint.members()
+            for m in range(handle.num_maps):
+                if m in lost_maps:
+                    continue
+                entry = table.entry(m)
+                if (entry is not None and entry[1] < len(members)
+                        and members[entry[1]] == TOMBSTONE):
+                    lost_maps.append(m)
+            lost_maps.sort()
         # push-merge RE-POINT: a lost map whose EVERY reduce partition
         # is held by a merged replica on a surviving executor needs no
         # re-execution — the reducers' merged-segment-first resolution
@@ -247,6 +263,33 @@ def recover_lost_maps(executors: Sequence[TpuShuffleManager],
                             "re-execution)", attempt, sorted(covered),
                             handle.shuffle_id)
                 lost_maps = [m for m in lost_maps if m not in covered]
+        # COLD-TIER RE-POINT: same contract one rung down — a lost map
+        # whose every partition is covered by tiered blobs needs no
+        # re-execution either; the reducers' TIERED rung restores it
+        # from the blob store (which has no slot to die, so there is no
+        # exclude_slot). The split gate applies identically: a blob
+        # holds every covered map's rows and cannot serve a map-subset
+        # task.
+        if (lost_maps and not split_active and drv_ep is not None
+                and hasattr(drv_ep, "tiered_covering")):
+            cold = set(drv_ep.tiered_covering(handle.shuffle_id,
+                                              lost_maps))
+            if getattr(failure, "verdict", "") == "cold_unusable":
+                # the blamed map's blobs already failed restore-side
+                # verification — re-pointing it at the same entries
+                # would loop; re-execute it (the repair publish drops
+                # the bad entries at the driver)
+                cold.discard(failure.map_id)
+            if cold:
+                endpoint.tracer.instant(
+                    "recovery.repoint_cold", "fault",
+                    shuffle=handle.shuffle_id, count=len(cold),
+                    dead_slot=dead_slot)
+                log.warning("stage retry %d: re-pointing maps %s of "
+                            "shuffle %d to the cold tier (no "
+                            "re-execution)", attempt, sorted(cold),
+                            handle.shuffle_id)
+                lost_maps = [m for m in lost_maps if m not in cold]
         if not lost_maps:
             # the whole loss re-points: invalidate so the retry
             # re-syncs table + merged directory, and return — there

@@ -47,6 +47,7 @@ INSTANTS = frozenset({
     "admit.queue",
     "admit.reject",
     "autoscale.resize",
+    "cold.upload",
     "commit.fenced",
     "driver.takeover",
     "exchange.degrade",
@@ -57,6 +58,7 @@ INSTANTS = frozenset({
     "fetch.merged_fallback",
     "fetch.pushed",
     "fetch.retry",
+    "fetch.tiered",
     "member.drain",
     "member.drain_fallback",
     "member.join",
@@ -70,6 +72,7 @@ INSTANTS = frozenset({
     "push.planned_native",
     "push.superseded",
     "recovery.repoint",
+    "recovery.repoint_cold",
     "plan.coalesce",
     "plan.replan",
     "plan.split",

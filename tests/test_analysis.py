@@ -72,16 +72,18 @@ def test_wire_registry_is_dense_and_unique():
 
 
 def test_wire_density_over_full_membership_range():
-    """Msgs 46-50 (partitioned-ownership publish/batch/op-log/handoff
-    frames) closed the id space at 50: the registry + reservations
-    must tile 1..50 exactly, and every membership message must carry
+    """Msgs 51-53 (the cold tier's one-sided blob publish + directory
+    pull) closed the id space at 53: the registry + reservations must
+    tile 1..53 exactly, every membership message must carry
     _EXTRA_CASES domain corners (epoch 0, max-i64, DRAINING-only
-    vectors) so the fuzzer exercises the signed boundaries the
-    name-based generator avoids."""
+    vectors), and the tiered frames must carry theirs (empty covered
+    bitmap, max-u64 blob size, the EPOCH_DEAD directory answer) so the
+    fuzzer exercises the pack boundaries the name-based generator
+    avoids."""
     ids = [t for t, _ in wire.live_pairs()]
-    assert max(ids) == 50
+    assert max(ids) == 53
     assert set(ids) | set(wire.rpc_msg.RESERVED_WIRE_IDS) == set(
-        range(1, 51))
+        range(1, 54))
     for name in ("JoinMsg", "MembershipBumpMsg", "DrainReq", "DrainResp"):
         assert name in wire._EXTRA_CASES, name
     corners = [c() for c in wire._EXTRA_CASES["MembershipBumpMsg"]]
@@ -89,6 +91,14 @@ def test_wire_density_over_full_membership_range():
     assert any(m.epoch == (1 << 63) - 1 for m in corners)
     assert any(m.slot_states and all(s == 1 for s in m.slot_states)
                for m in corners)  # DRAINING-only fleet vector
+    for name in ("TieredPublishMsg", "FetchTieredResp"):
+        assert name in wire._EXTRA_CASES, name
+    tiered = [c() for c in wire._EXTRA_CASES["TieredPublishMsg"]]
+    assert any(m.covered == b"" for m in tiered)  # empty coverage
+    assert any(m.nbytes == (1 << 64) - 1 for m in tiered)  # u64 edge
+    dirs = [c() for c in wire._EXTRA_CASES["FetchTieredResp"]]
+    assert any(m.epoch == wire.M.EPOCH_DEAD and m.data == b""
+               for m in dirs)  # dead-shuffle directory answer
 
 
 def test_wire_doc_table_matches_registry():

@@ -127,6 +127,16 @@ NATIVE_FETCH = os.environ.get("CHAOS_NATIVE_FETCH",
 # dedicated kill-a-shard-owner scenario below runs whenever sharding
 # is on and asserts the per-shard failover costs ZERO re-executions.
 SHARD = os.environ.get("CHAOS_SHARD", "0") not in ("0", "false")
+# cold tier under chaos: 1 runs the whole matrix with the
+# disaggregated cold tier active (push_merge forced on, finalized
+# segments tiering to a blob store in the BACKGROUND of every faulted
+# scenario — uploads, publishes, and tombstone reaps cross the whole
+# fault matrix), plus the dedicated cold scenarios below: the
+# full-fleet-loss restore under a seeded blob-fault matrix, and the
+# store-outage degrade-to-hot-only acceptance. run_chaos.sh sweeps
+# both. Scenarios that pin push_merge=False keep their pin (the cold
+# tier rides the merge plane, so it is inert there).
+COLD = os.environ.get("CHAOS_COLD", "0") not in ("0", "false")
 # CHAOS_LOCKGRAPH=1: run every scenario under the lock-order shim
 # (sparkrdma_tpu/analysis/lockgraph.py) so the chaos matrix doubles as
 # race detection — faults drive the rare teardown/retry/suspect paths
@@ -186,6 +196,13 @@ def _conf(**kw):
 
 
 def _cluster(tmp_path, n=3, **kw):
+    if COLD:
+        # the cold-tier sweep dimension: finalized segments tier to a
+        # per-test blob store in the background of every scenario
+        # (explicit pins — push_merge=False wire-count scenarios — win)
+        kw.setdefault("cold_tier", True)
+        kw.setdefault("cold_tier_path", str(tmp_path / "cold"))
+        kw.setdefault("push_merge", True)
     conf = _conf(**kw)
     if DRIVER:
         driver = TpuShuffleManager(conf, is_driver=True,
@@ -1796,4 +1813,144 @@ def test_chaos_shard_owner_kill_mid_publish_zero_reexecutions(tmp_path):
         done.set()
         if killer is not None:
             killer.join(timeout=10)
+        _shutdown(driver, execs)
+
+
+# -- the cold tier: full-fleet loss under the blob-fault matrix -----------
+#
+# The disaggregated tier's acceptance scenario class (CHAOS_COLD=1): the
+# ENTIRE fleet dies after map finalize + tier upload, and a fresh fleet
+# must reduce byte-identically from the blob store — under a SEEDED
+# matrix of blob faults on both the upload path (outages, torn uploads,
+# at-rest rot — segments degrade to hot-only or publish rotten blobs
+# the restore CRC must catch) and the restore path (outages, slow
+# store). Whatever the faults ate, the answer is byte-identical: cold
+# restore where coverage survived, re-execution where it didn't.
+
+
+@pytest.mark.skipif(not COLD, reason="CHAOS_COLD=0: cold tier inert")
+def test_chaos_cold_full_fleet_loss_under_blob_faults(tmp_path):
+    from sparkrdma_tpu.parallel.faults import (BLOB_CORRUPT, BLOB_SLOW,
+                                               BLOB_UNAVAILABLE,
+                                               TORN_UPLOAD,
+                                               BlobFaultInjector)
+    from sparkrdma_tpu.shuffle.cold_tier import wait_for_tiered_coverage
+    from sparkrdma_tpu.shuffle.push_merge import wait_for_coverage
+
+    driver, execs = _cluster(tmp_path, n=3, **PY_DATAPLANE)
+    inj = BlobFaultInjector(seed=SEED)
+    inj.install()
+    fresh = []
+    counter = {}
+
+    def map_fn(writer, map_id):
+        counter[map_id] = counter.get(map_id, 0) + 1
+        _map_fn(writer, map_id)
+
+    try:
+        # upload-side faults: some puts fail outright, some land short
+        # (must never become visible), some commit then rot at rest
+        inj.add(BLOB_UNAVAILABLE, op="put", prob=0.15)
+        inj.add(TORN_UPLOAD, op="put", prob=0.1, torn_bytes=32)
+        inj.add(BLOB_CORRUPT, op="put", prob=0.15, flip_bits=3)
+
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec(
+                                             "modulo"))
+        run_map_stage(execs, handle, map_fn)
+        for ex in execs:
+            assert ex.pusher.drain(15), f"seed={SEED}"
+        assert wait_for_coverage(driver.driver, 1, 6, 4, timeout=15), \
+            f"seed={SEED}"
+        for ex in execs:
+            if ex.executor.tiering is not None:
+                assert ex.executor.tiering.drain(20), f"seed={SEED}"
+        # coverage is best-effort under upload faults — whatever tiered,
+        # tiered; the job must not care either way
+        wait_for_tiered_coverage(driver.driver, 1, 6, 4, timeout=2)
+
+        # the spot-market event: the ENTIRE fleet is gone
+        mids = [ex.executor.manager_id for ex in execs]
+        for ex in execs:
+            ex.stop()
+        for mid in mids:
+            driver.driver.remove_member(mid)
+
+        # restore-side faults: a blinking, slow store
+        inj.add(BLOB_UNAVAILABLE, op="get", prob=0.15)
+        inj.add(BLOB_SLOW, op="get", prob=0.3, delay_s=0.01)
+
+        conf = _conf(cold_tier=True,
+                     cold_tier_path=str(tmp_path / "cold"),
+                     push_merge=True, **PY_DATAPLANE)
+        fresh = [TpuShuffleManager(conf, driver_addr=driver.driver_addr,
+                                   executor_id=f"f{i}",
+                                   spill_dir=str(tmp_path / f"f{i}"))
+                 for i in range(3)]
+        from sparkrdma_tpu.parallel.endpoints import TOMBSTONE
+        for ex in fresh:
+            ex.executor.wait_for_members(6)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                members = ex.executor.members()
+                if all(members[s] == TOMBSTONE for s in range(3)):
+                    break
+                time.sleep(0.02)
+
+        got = run_reduce_with_retry(fresh, handle, map_fn, _reduce_fn,
+                                    reducer_index=0, max_stage_retries=8,
+                                    driver=driver)
+        np.testing.assert_array_equal(
+            got, _expected(6),
+            err_msg=f"seed={SEED}: cold restore diverged under blob "
+                    f"faults (fired: {dict(inj.fired)})")
+        # every map ran at least once (the original stage) and only
+        # AS re-executions where the fault matrix destroyed coverage
+        assert all(n >= 1 for n in counter.values()), \
+            f"seed={SEED}: {counter}"
+    finally:
+        inj.uninstall()
+        _shutdown(driver, fresh if fresh else execs)
+
+
+@pytest.mark.skipif(not COLD, reason="CHAOS_COLD=0: cold tier inert")
+def test_chaos_cold_store_outage_degrades_to_hot_only(tmp_path):
+    """The blob store is DOWN for the entire job: every upload fails
+    its whole retry budget, nothing tiers, and the job must not notice
+    — tiering never fails a job (the graceful-degradation half of the
+    acceptance)."""
+    from sparkrdma_tpu.parallel.faults import (BLOB_UNAVAILABLE,
+                                               BlobFaultInjector)
+
+    driver, execs = _cluster(tmp_path, n=3, **PY_DATAPLANE)
+    inj = BlobFaultInjector(seed=SEED)
+    inj.install()
+    try:
+        inj.add(BLOB_UNAVAILABLE)  # every op, every time: store DOWN
+        handle = driver.register_shuffle(1, num_maps=6, num_partitions=4,
+                                         partitioner=PartitionerSpec(
+                                             "modulo"))
+        run_map_stage(execs, handle, _map_fn)
+        for ex in execs:
+            assert ex.pusher.drain(15), f"seed={SEED}"
+        from sparkrdma_tpu.shuffle.push_merge import wait_for_coverage
+        assert wait_for_coverage(driver.driver, 1, 6, 4, timeout=15), \
+            f"seed={SEED}"
+        for ex in execs:
+            if ex.executor.tiering is not None:
+                assert ex.executor.tiering.drain(20), f"seed={SEED}"
+        got = _reduce_fn(execs[0], handle)
+        np.testing.assert_array_equal(got, _expected(6),
+                                      err_msg=f"seed={SEED}")
+        snaps = [ex.executor.tiering.snapshot() for ex in execs
+                 if ex.executor.tiering is not None]
+        assert snaps, f"seed={SEED}: no tiering service installed"
+        assert all(s["uploads_done"] == 0 for s in snaps), \
+            f"seed={SEED}: {snaps}"
+        assert sum(s["uploads_failed"] for s in snaps) > 0, \
+            f"seed={SEED}: {snaps}"
+        directory = driver.driver.tiered_directory(1)
+        assert directory is None or len(directory) == 0, f"seed={SEED}"
+    finally:
+        inj.uninstall()
         _shutdown(driver, execs)

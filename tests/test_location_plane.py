@@ -423,6 +423,21 @@ def test_sharded_table_read_serves_from_shard_hosts(sharded_cluster):
     assert sm.num_shards == 2 and sm.num_maps == 6
     for m in range(6):
         execs[m % 3].publish_map_output(7, m, table_token=100 + m)
+    # the driver's entry forwards to the shard replicas are async
+    # one-attempt pushes: a cold sync that beats them finds no replica
+    # (or a partial one) and legitimately falls back to the driver, so
+    # wait for every replica to be COMPLETE before counting frames —
+    # this test pins the steady-state serve path, not the forward race
+    def _replicas_complete():
+        for shard in range(sm.num_shards):
+            lo, hi = sm.range_of(shard)
+            host = next(ex for ex in execs if ex.manager_id ==
+                        execs[0].member_at(sm.shard_slots[shard]))
+            res = host.shard_store.read_range(7, lo, hi)
+            if res is None or res[0] < hi - lo:
+                return False
+        return True
+    assert _wait(_replicas_complete)
     # count frames at the driver vs shard hosts
     served = {"driver": 0, "shard": 0}
     orig_table = driver._on_fetch_table
